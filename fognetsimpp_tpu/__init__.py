@@ -1,0 +1,35 @@
+"""fognetsimpp_tpu — a TPU-native fog-computing simulation framework.
+
+A from-scratch reimplementation of the capabilities of FogNetSim++
+(CharafeddineMechalikh/fognetsimpp: MQTT-style IoT task offloading over an
+OMNeT++/INET discrete-event simulation) re-designed for TPU execution:
+
+  * all world state lives in fixed-shape device arrays (one pytree);
+  * one ``lax.scan`` tick advances every node, queue and in-flight message;
+  * schedulers are jit'd batched argmin kernels;
+  * ``vmap`` fans out Monte-Carlo replicas, ``pjit``/``shard_map`` shards
+    replicas and nodes over a TPU mesh;
+  * a C++ event-driven core (``fognetsimpp_tpu.native``) provides the
+    sequential-DES parity baseline the batched engine is validated against.
+
+See SURVEY.md at the repository root for the reference structural analysis
+this build follows, and README.md for usage.
+"""
+from .spec import (  # noqa: F401
+    BugCompat,
+    FogModel,
+    Mobility,
+    NodeKind,
+    Policy,
+    Stage,
+    WorldSpec,
+)
+from .state import WorldState, init_state  # noqa: F401
+from .core.engine import (  # noqa: F401
+    make_step,
+    prime_initial_advertisements,
+    run,
+    run_jit,
+)
+
+__version__ = "0.1.0"

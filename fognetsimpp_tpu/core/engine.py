@@ -1,0 +1,516 @@
+"""The batched tick engine: one `lax.scan` step advances the whole world.
+
+This is the TPU-native replacement for OMNeT++'s sequential event loop
+(SURVEY.md §7 "guiding translation").  Per tick ``[t0, t1)`` the engine runs
+a fixed phase pipeline — mobility → association → advertisement delivery →
+publish spawning → broker scheduling → fog completions → fog arrivals →
+energy/lifecycle — each phase a masked, batched array update over the task
+table and per-node state.
+
+Event-time fidelity: all task timestamps are *exact* (sums of link delays and
+service times, chained through ``busy_until``), never tick-quantised.  The
+tick size only bounds how stale a decision's *view* can be (which fog a task
+goes to, whether a server looked idle), exactly the staleness the reference
+already has through in-flight advertisement packets.  With
+``dt <= min link delay`` the decision ordering matches the event-driven
+execution (SURVEY.md §7 "hard parts" item 1).
+
+The hot path per reference trace §3.2:
+  client publish (``mqttApp2.cc:353-409``) → broker schedule
+  (``BrokerBaseApp3.cc:231-319``) → fog assign/queue
+  (``ComputeBrokerApp3.cc:269-320``) → fog release
+  (``ComputeBrokerApp3.cc:224-256``) → ack relay to client
+  (``BrokerBaseApp3.cc:164-198`` + ``mqttApp2.cc:252-296``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..net.mobility import MobilityBounds, step_mobility
+from ..net.energy import step_energy
+from ..net.topology import LinkCache, NetParams, associate, pair_delay
+from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
+from ..ops.sched import schedule_batch
+from ..spec import Policy, Stage, WorldSpec
+from ..state import WorldState
+
+
+def _fog_node_idx(spec: WorldSpec, fog: jax.Array) -> jax.Array:
+    """Map fog slot -> global node index (layout: users | fogs | broker)."""
+    return spec.n_users + jnp.clip(fog, 0, spec.n_fogs - 1)
+
+
+def _svc_time(spec: WorldSpec, mips_req: jax.Array, fog_mips: jax.Array) -> jax.Array:
+    """Fog-side service time: requiredMIPS / MIPS (ComputeBrokerApp3.cc:276)."""
+    return mips_req / jnp.maximum(fog_mips, 1e-9)
+
+
+# ----------------------------------------------------------------------
+# phases
+# ----------------------------------------------------------------------
+
+def _phase_adverts(state: WorldState, t1: jax.Array) -> WorldState:
+    """Deliver in-flight MIPS advertisements whose arrival time has passed.
+
+    Mirrors the broker's AdvertiseMIPS branch updating ``brokers[j]``
+    (``BrokerBaseApp3.cc:123-136``) — latest-wins overwrite.
+    """
+    b = state.broker
+    arrived = b.adv_arrive_t <= t1
+    broker = b.replace(
+        view_mips=jnp.where(arrived, b.adv_val_mips, b.view_mips),
+        view_busy=jnp.where(arrived, b.adv_val_busy, b.view_busy),
+        adv_arrive_t=jnp.where(arrived, jnp.inf, b.adv_arrive_t),
+    )
+    return state.replace(broker=broker)
+
+
+def _phase_spawn(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    t0: jax.Array, t1: jax.Array,
+) -> WorldState:
+    """Users whose send timer fired publish one task (mqttApp2.cc:353-409).
+
+    Task slot ``u * max_sends + send_count[u]`` is claimed; MIPSRequired ~
+    U[200, 900] via the kernel PRNG (fixing the reference's wall-clock
+    ``rand()`` nondeterminism, SURVEY.md App. B item 5).  The publish's
+    arrival at the broker is stamped immediately:
+    ``t_at_broker = t_create + delay(user, broker)``.
+    """
+    U, T, S = spec.n_users, spec.task_capacity, spec.max_sends_per_user
+    users, tasks = state.users, state.tasks
+    uidx = jnp.arange(U, dtype=jnp.int32)
+    alive_u = state.nodes.alive[uidx]
+
+    due = alive_u & users.connected & (users.next_send < t1) & (users.send_count < S)
+    t_create = jnp.maximum(users.next_send, t0)  # missed-while-dead resume
+
+    key, k_mips, k_jit = jax.random.split(state.key, 3)
+    if spec.fixed_mips_required is not None:
+        mips_req = jnp.full((U,), float(spec.fixed_mips_required), jnp.float32)
+    else:
+        mips_req = jax.random.randint(
+            k_mips, (U,), spec.mips_required_min, spec.mips_required_max + 1
+        ).astype(jnp.float32)
+
+    broker_node = jnp.full((U,), spec.broker_index, jnp.int32)
+    d_ub = pair_delay(net, cache, uidx, broker_node)  # (U,)
+    slot = jnp.where(due, uidx * S + users.send_count, T)
+
+    def scat(col, val):
+        return col.at[slot].set(jnp.where(due, val, col[jnp.clip(slot, 0, T - 1)]), mode="drop")
+
+    tasks = tasks.replace(
+        stage=tasks.stage.at[slot].set(jnp.int8(int(Stage.PUB_INFLIGHT)), mode="drop"),
+        mips_req=scat(tasks.mips_req, mips_req),
+        t_create=scat(tasks.t_create, t_create),
+        t_at_broker=scat(tasks.t_at_broker, t_create + d_ub),
+    )
+    interval = users.send_interval
+    if spec.send_interval_jitter > 0:
+        interval = interval * jax.random.uniform(
+            k_jit, (U,), minval=1.0 - spec.send_interval_jitter,
+            maxval=1.0 + spec.send_interval_jitter,
+        )
+    users = users.replace(
+        next_send=jnp.where(due, t_create + interval, users.next_send),
+        send_count=jnp.where(due, users.send_count + 1, users.send_count),
+    )
+    metrics = state.metrics.replace(
+        n_published=state.metrics.n_published + jnp.sum(due.astype(jnp.int32))
+    )
+    return state.replace(users=users, tasks=tasks, metrics=metrics, key=key)
+
+
+def _phase_broker(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    t1: jax.Array,
+) -> WorldState:
+    """Broker decides every publish that has arrived (BrokerBaseApp3.cc:231-319).
+
+    All arrivals in the window see the same view snapshot — faithful, since
+    the reference's view is only refreshed by advertisement arrivals, never
+    by its own assignments.  Emits the forwarded status-4 ack
+    (``BrokerBaseApp3.cc:146-150``) whose client-side arrival becomes the
+    latencyH1 signal (``mqttApp2.cc:269-277``).
+    """
+    tasks, b = state.tasks, state.broker
+    T = spec.task_capacity
+    mask = (tasks.stage == int(Stage.PUB_INFLIGHT)) & (tasks.t_at_broker <= t1)
+
+    any_fog = jnp.any(b.registered)
+    key, k_sched = jax.random.split(state.key)
+    fog_nodes = jnp.arange(spec.n_fogs, dtype=jnp.int32) + spec.n_users
+    broker_node_f = jnp.full((spec.n_fogs,), spec.broker_index, jnp.int32)
+    rtt_bf = 2.0 * pair_delay(net, cache, broker_node_f, fog_nodes)
+    fog_alive = state.nodes.alive[fog_nodes]
+    fog_efrac = state.nodes.energy[fog_nodes] / jnp.maximum(
+        state.nodes.energy_capacity[fog_nodes], 1e-12
+    )
+
+    choice, rr_new = schedule_batch(
+        spec.policy, mask, tasks.mips_req, b.view_busy, b.view_mips,
+        b.registered, fog_alive, fog_efrac, rtt_bf, b.rr_next, k_sched,
+        spec.bug_compat.mips0_divisor,
+    )
+
+    fog_node = _fog_node_idx(spec, choice)
+    broker_node = jnp.full((T,), spec.broker_index, jnp.int32)
+    user_node = tasks.user
+    d_bf = pair_delay(net, cache, broker_node, fog_node)
+    d_bu = pair_delay(net, cache, broker_node, user_node)
+
+    sched = mask & any_fog
+    no_res = mask & ~any_fog  # "no compute resource available" (:306-319)
+    tasks = tasks.replace(
+        stage=jnp.where(
+            sched, jnp.int8(int(Stage.TASK_INFLIGHT)),
+            jnp.where(no_res, jnp.int8(int(Stage.NO_RESOURCE)), tasks.stage),
+        ),
+        fog=jnp.where(sched, choice, tasks.fog),
+        t_at_fog=jnp.where(sched, tasks.t_at_broker + d_bf, tasks.t_at_fog),
+        t_ack4_fwd=jnp.where(mask, tasks.t_at_broker + d_bu, tasks.t_ack4_fwd),
+    )
+    metrics = state.metrics.replace(
+        n_scheduled=state.metrics.n_scheduled + jnp.sum(sched.astype(jnp.int32)),
+        n_no_resource=state.metrics.n_no_resource + jnp.sum(no_res.astype(jnp.int32)),
+    )
+    return state.replace(
+        tasks=tasks, broker=b.replace(rr_next=rr_new), metrics=metrics, key=key
+    )
+
+
+def _phase_completions(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    t1: jax.Array,
+) -> WorldState:
+    """Fog nodes whose in-service task finished release it (releaseResource,
+    ``ComputeBrokerApp3.cc:224-256``): status-6 ack relayed to the client
+    (taskTime signal), busyTime decremented by the task's service time, FIFO
+    head promoted (queueTime signal), next release scheduled exactly at
+    ``busy_until + svc``, and a fresh advertisement put in flight.
+    """
+    tasks, fogs, b = state.tasks, state.fogs, state.broker
+    F = spec.n_fogs
+    fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
+    fog_alive = state.nodes.alive[fog_nodes]
+
+    comp = (fogs.current_task != NO_TASK) & (fogs.busy_until <= t1) & fog_alive
+    done_task = jnp.where(comp, fogs.current_task, T_SENTINEL := spec.task_capacity)
+    t_done = fogs.busy_until  # exact completion times per fog
+
+    # ack6 path: fog -> broker -> client (relay, BrokerBaseApp3.cc:164-175)
+    user_of = tasks.user[jnp.clip(done_task, 0, spec.task_capacity - 1)]
+    broker_node_f = jnp.full((F,), spec.broker_index, jnp.int32)
+    d_fb = pair_delay(net, cache, fog_nodes, broker_node_f)
+    d_bu = pair_delay(net, cache, broker_node_f, user_of)
+    t_ack6 = t_done + d_fb + d_bu
+
+    svc_done = _svc_time(
+        spec, tasks.mips_req[jnp.clip(done_task, 0, spec.task_capacity - 1)], fogs.mips
+    )
+
+    tasks = tasks.replace(
+        stage=tasks.stage.at[done_task].set(jnp.int8(int(Stage.DONE)), mode="drop"),
+        t_complete=tasks.t_complete.at[done_task].set(
+            jnp.where(comp, t_done, 0), mode="drop"
+        ),
+        t_ack6=tasks.t_ack6.at[done_task].set(jnp.where(comp, t_ack6, 0), mode="drop"),
+    )
+    # busyTime -= currentTask.requiredTime (== its tskTime, set at accept:
+    # ComputeBrokerApp3.cc:296,232)
+    busy_time = jnp.where(comp, fogs.busy_time - svc_done, fogs.busy_time)
+
+    # promote FIFO head (ComputeBrokerApp3.cc:236-252)
+    head, q_head, q_len = batched_pop(fogs.queue, fogs.q_head, fogs.q_len, comp)
+    promoted = comp & (head != NO_TASK)
+    head_c = jnp.clip(head, 0, spec.task_capacity - 1)
+    svc_new = _svc_time(spec, tasks.mips_req[head_c], fogs.mips)
+    tasks = tasks.replace(
+        stage=tasks.stage.at[jnp.where(promoted, head, spec.task_capacity)].set(
+            jnp.int8(int(Stage.RUNNING)), mode="drop"
+        ),
+        t_service_start=tasks.t_service_start.at[
+            jnp.where(promoted, head, spec.task_capacity)
+        ].set(jnp.where(comp, t_done, 0), mode="drop"),
+        queue_time_ms=tasks.queue_time_ms.at[
+            jnp.where(promoted, head, spec.task_capacity)
+        ].set(
+            jnp.where(promoted, (t_done - tasks.t_q_enter[head_c]) * 1e3, 0),
+            mode="drop",
+        ),
+    )
+    fogs = fogs.replace(
+        busy_time=busy_time,
+        current_task=jnp.where(comp, jnp.where(promoted, head, NO_TASK), fogs.current_task),
+        busy_until=jnp.where(
+            comp, jnp.where(promoted, t_done + svc_new, jnp.inf), fogs.busy_until
+        ),
+        queue=fogs.queue,
+        q_head=q_head,
+        q_len=q_len,
+    )
+    # advertisement in flight: advertiseMIPS() at end of releaseResource
+    # (ComputeBrokerApp3.cc:254); latest-wins single slot per fog.
+    b = b.replace(
+        adv_val_mips=jnp.where(comp, fogs.mips, b.adv_val_mips),
+        adv_val_busy=jnp.where(comp, busy_time, b.adv_val_busy),
+        adv_arrive_t=jnp.where(comp, t_done + d_fb, b.adv_arrive_t),
+    )
+    metrics = state.metrics.replace(
+        n_completed=state.metrics.n_completed + jnp.sum(comp.astype(jnp.int32))
+    )
+    return state.replace(tasks=tasks, fogs=fogs, broker=b, metrics=metrics)
+
+
+def _phase_fog_arrivals(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    t1: jax.Array,
+) -> WorldState:
+    """Tasks reaching their fog node are assigned or queued
+    (``ComputeBrokerApp3.cc:269-320``).
+
+    busyTime += tskTime for *every* arrival (accepted or queued, ``:279``);
+    an idle fog takes the earliest arrival (status-5 "assigned" ack → the
+    client's latency signal); the rest enter the FIFO in arrival order
+    (status-4 "queued" ack → a second latencyH1 sample at the client).
+    """
+    tasks, fogs = state.tasks, state.fogs
+    T, F = spec.task_capacity, spec.n_fogs
+    fog_nodes_all = jnp.arange(F, dtype=jnp.int32) + spec.n_users
+    fog_alive = state.nodes.alive[fog_nodes_all]
+
+    arr = (tasks.stage == int(Stage.TASK_INFLIGHT)) & (tasks.t_at_fog <= t1)
+    dead_dst = arr & ~fog_alive[jnp.clip(tasks.fog, 0, F - 1)]
+    arr = arr & ~dead_dst  # packets to a dead node are lost
+
+    svc = _svc_time(spec, tasks.mips_req, fogs.mips[jnp.clip(tasks.fog, 0, F - 1)])
+    add_busy = jnp.zeros((F + 1,), jnp.float32).at[
+        jnp.where(arr, tasks.fog, F)
+    ].add(jnp.where(arr, svc, 0.0), mode="drop")[:F]
+
+    idle = fogs.current_task == NO_TASK
+    plan = plan_arrivals(arr, tasks.fog, tasks.t_at_fog, F, idle)
+
+    # --- immediate assignment on idle fogs ---
+    a_task = plan.assign_task  # (F,) task id or NO_TASK
+    assigned = a_task != NO_TASK
+    a_c = jnp.clip(a_task, 0, T - 1)
+    t_start = tasks.t_at_fog[a_c]
+    svc_a = _svc_time(spec, tasks.mips_req[a_c], fogs.mips)
+    broker_node_f = jnp.full((F,), spec.broker_index, jnp.int32)
+    d_fb = pair_delay(net, cache, fog_nodes_all, broker_node_f)
+    d_bu_a = pair_delay(net, cache, broker_node_f, tasks.user[a_c])
+    t_ack5 = t_start + d_fb + d_bu_a
+
+    scat_a = jnp.where(assigned, a_task, T)
+    tasks = tasks.replace(
+        stage=tasks.stage.at[scat_a].set(jnp.int8(int(Stage.RUNNING)), mode="drop"),
+        t_service_start=tasks.t_service_start.at[scat_a].set(
+            jnp.where(assigned, t_start, 0), mode="drop"
+        ),
+        t_ack5=tasks.t_ack5.at[scat_a].set(jnp.where(assigned, t_ack5, 0), mode="drop"),
+    )
+    fogs = fogs.replace(
+        current_task=jnp.where(assigned, a_task, fogs.current_task),
+        busy_until=jnp.where(assigned, t_start + svc_a, fogs.busy_until),
+        busy_time=fogs.busy_time + add_busy,
+    )
+
+    # --- queue the rest (rank shifts by 1 where the head got assigned) ---
+    got_head = assigned[jnp.clip(tasks.fog, 0, F - 1)] & idle[jnp.clip(tasks.fog, 0, F - 1)]
+    eff_rank = jnp.where(arr, plan.rank - got_head.astype(jnp.int32), -1)
+    to_queue = arr & (eff_rank >= 0) & (
+        jnp.arange(T, dtype=jnp.int32) != a_task[jnp.clip(tasks.fog, 0, F - 1)]
+    )
+    queue, q_len, enq_ok, dropped = batched_enqueue(
+        fogs.queue, fogs.q_head, fogs.q_len, to_queue, tasks.fog, eff_rank
+    )
+    d_bu_q = pair_delay(
+        net, cache, jnp.full((T,), spec.broker_index, jnp.int32), tasks.user
+    )
+    d_fb_q = d_fb[jnp.clip(tasks.fog, 0, F - 1)]
+    tasks = tasks.replace(
+        stage=jnp.where(
+            enq_ok, jnp.int8(int(Stage.QUEUED)),
+            jnp.where(
+                to_queue & ~enq_ok, jnp.int8(int(Stage.DROPPED)),
+                jnp.where(dead_dst, jnp.int8(int(Stage.DROPPED)), tasks.stage),
+            ),
+        ),
+        t_q_enter=jnp.where(enq_ok, tasks.t_at_fog, tasks.t_q_enter),
+        t_ack4_queued=jnp.where(
+            enq_ok, tasks.t_at_fog + d_fb_q + d_bu_q, tasks.t_ack4_queued
+        ),
+    )
+    fogs = fogs.replace(queue=queue, q_len=q_len, q_drops=fogs.q_drops + dropped)
+    metrics = state.metrics.replace(
+        n_dropped=state.metrics.n_dropped
+        + jnp.sum((to_queue & ~enq_ok).astype(jnp.int32))
+        + jnp.sum(dead_dst.astype(jnp.int32))
+    )
+    return state.replace(tasks=tasks, fogs=fogs, metrics=metrics)
+
+
+def _phase_periodic_adverts(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    t0: jax.Array, t1: jax.Array,
+) -> WorldState:
+    """v1/v2 fogs re-advertise every ``adv_interval`` (ComputeBrokerApp2.cc:219).
+
+    Fired on the tick containing each multiple of the interval; the
+    advertisement carries the fog's *current* (MIPS, busyTime) and lands at
+    the broker after the fog->broker delay.
+    """
+    F = spec.n_fogs
+    fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
+    alive = state.nodes.alive[fog_nodes]
+    k0 = jnp.floor(t0 / spec.adv_interval)
+    k1 = jnp.floor(t1 / spec.adv_interval)
+    fire = (k1 > k0) & alive
+    t_fire = (k0 + 1.0) * spec.adv_interval
+    d_fb = pair_delay(
+        net, cache, fog_nodes, jnp.full((F,), spec.broker_index, jnp.int32)
+    )
+    b = state.broker
+    b = b.replace(
+        adv_val_mips=jnp.where(fire, state.fogs.mips, b.adv_val_mips),
+        adv_val_busy=jnp.where(fire, state.fogs.busy_time, b.adv_val_busy),
+        adv_arrive_t=jnp.where(fire, t_fire + d_fb, b.adv_arrive_t),
+    )
+    return state.replace(broker=b)
+
+
+def prime_initial_advertisements(
+    spec: WorldSpec, state: WorldState, net: NetParams, t_adv: float = 0.01
+) -> WorldState:
+    """Put each fog's first advertisement in flight at t=t_adv.
+
+    Mirrors the connack handler scheduling ADVERTISEMIPS at +0.01 s
+    (``ComputeBrokerApp3.cc:261-267``); until it lands the broker's view has
+    MIPS=0 (registration default, ``BrokerBaseApp3.cc:104``) and the
+    scheduler's estimates are +inf, exactly like the reference's first
+    decisions.  Scenario builders call this after placing nodes.
+    """
+    cache = associate(net, state.nodes.pos, state.nodes.alive)
+    F = spec.n_fogs
+    fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
+    d_fb = pair_delay(
+        net, cache, fog_nodes, jnp.full((F,), spec.broker_index, jnp.int32)
+    )
+    b = state.broker.replace(
+        adv_val_mips=state.fogs.mips,
+        adv_val_busy=state.fogs.busy_time,
+        adv_arrive_t=jnp.asarray(t_adv, jnp.float32) + d_fb,
+    )
+    return state.replace(broker=b)
+
+
+# ----------------------------------------------------------------------
+# the tick
+# ----------------------------------------------------------------------
+
+def make_step(
+    spec: WorldSpec,
+) -> Callable[[WorldState, NetParams, MobilityBounds], WorldState]:
+    """Build the jit-compiled single-tick transition for ``spec``."""
+    spec.validate()
+
+    def step(state: WorldState, net: NetParams, bounds: MobilityBounds) -> WorldState:
+        t0 = state.tick.astype(jnp.float32) * spec.dt
+        t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
+
+        # 1. mobility (positions at end-of-tick; delays in this tick use them)
+        pos, vel = step_mobility(state.nodes, bounds, t1, spec.dt)
+        nodes = state.nodes.replace(pos=pos, vel=vel)
+        state = state.replace(nodes=nodes)
+
+        # 2. connectivity / association snapshot for this tick
+        cache = associate(net, pos, nodes.alive)
+
+        # 3-7. protocol phases
+        state = _phase_adverts(state, t1)
+        if spec.adv_periodic:
+            state = _phase_periodic_adverts(spec, state, net, cache, t0, t1)
+        state = _phase_spawn(spec, state, net, cache, t0, t1)
+        state = _phase_broker(spec, state, net, cache, t1)
+        if spec.n_fogs > 0:  # a fog-less world exercises only the
+            # "no compute resource available" branch (BrokerBaseApp3.cc:306)
+            for _ in range(spec.completions_per_tick):
+                state = _phase_completions(spec, state, net, cache, t1)
+            state = _phase_fog_arrivals(spec, state, net, cache, t1)
+
+        # 8. energy + lifecycle
+        if spec.energy_enabled:
+            N = spec.n_nodes
+            fog_nodes = jnp.arange(spec.n_fogs, dtype=jnp.int32) + spec.n_users
+            computing = jnp.zeros((N,), bool).at[fog_nodes].set(
+                state.fogs.current_task != NO_TASK
+            )
+            tx = jnp.zeros((N,), jnp.int32)
+            rx = jnp.zeros((N,), jnp.int32)
+            energy, alive = step_energy(
+                spec, state.nodes.energy, state.nodes.energy_capacity,
+                state.nodes.has_energy, state.nodes.alive, t1, tx, rx, computing,
+            )
+            state = state.replace(
+                nodes=state.nodes.replace(energy=energy, alive=alive)
+            )
+
+        return state.replace(
+            t=t1, tick=state.tick + 1
+        )
+
+    return step
+
+
+def run(
+    spec: WorldSpec,
+    state: WorldState,
+    net: NetParams,
+    bounds: Optional[MobilityBounds] = None,
+    n_ticks: Optional[int] = None,
+) -> Tuple[WorldState, Optional[dict]]:
+    """Run ``n_ticks`` (default: spec horizon) under one `lax.scan`.
+
+    Returns (final_state, series) where ``series`` holds per-tick vectors
+    (queue lengths, busy times, alive count) when
+    ``spec.record_tick_series`` — the ``.vec``-file analog (SURVEY.md §5
+    tracing).
+    """
+    if bounds is None:
+        from ..net.mobility import default_bounds
+
+        bounds = default_bounds()
+    n = spec.n_ticks if n_ticks is None else n_ticks
+    step = make_step(spec)
+
+    def body(carry, _):
+        s = step(carry, net, bounds)
+        if spec.record_tick_series:
+            out = {
+                "t": s.t,
+                "busy_time": s.fogs.busy_time,
+                "q_len": s.fogs.q_len,
+                "n_alive": jnp.sum(s.nodes.alive.astype(jnp.int32)),
+                "energy_mean": jnp.mean(s.nodes.energy),
+            }
+        else:
+            out = None
+        return s, out
+
+    final, series = jax.lax.scan(body, state, None, length=n)
+    return final, series
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def run_jit(
+    spec: WorldSpec, state: WorldState, net: NetParams, bounds: MobilityBounds
+) -> WorldState:
+    """Whole-run jit entry (spec static): scan over the full horizon."""
+    final, _ = run(spec, state, net, bounds)
+    return final

@@ -1,0 +1,67 @@
+"""Energy + lifecycle: INET's battery/management framework, batched.
+
+The reference wires SimpleEpEnergyStorage + StateBasedEpEnergyConsumer +
+SimpleEpEnergyManagement + AlternatingEpEnergyGenerator per node in the ini
+(``simulations/testing/wireless5.ini:150-166``): radios drain the battery,
+the management module shuts a node down below ``nodeShutdownCapacity`` (10%)
+and restarts it above ``nodeStartCapacity`` (50%), a generator alternates
+harvesting and sleeping.  This *is* the reference's fault-injection mechanism
+(SURVEY.md §5) — energy-driven churn of nodes.
+
+Here the whole framework is one masked vector update per tick: idle drain +
+per-message tx/rx energy + compute drain for busy fog nodes, square-wave
+harvesting, and hysteresis thresholds flipping the ``alive`` mask.  Apps
+react exactly like ``handleNodeShutdown``/``handleNodeStart``
+(``mqttApp2.cc:471-492``): dead users stop publishing (their send timer is
+effectively cancelled), dead fog nodes stop advertising and serving.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..spec import WorldSpec
+
+
+def step_energy(
+    spec: WorldSpec,
+    energy: jax.Array,  # (N,) f32 joules
+    capacity: jax.Array,  # (N,) f32
+    has_energy: jax.Array,  # (N,) bool — node participates in the model
+    alive: jax.Array,  # (N,) bool
+    t: jax.Array,  # () f32 tick start
+    tx_count: jax.Array,  # (N,) i32 messages sent by node this tick
+    rx_count: jax.Array,  # (N,) i32 messages received this tick
+    computing: jax.Array,  # (N,) bool — fog node actively serving
+) -> Tuple[jax.Array, jax.Array]:
+    """One energy tick. Returns (energy', alive').
+
+    Nodes outside the model (``has_energy`` False) are always alive-eligible;
+    the alive mask for them is left untouched.
+    """
+    dt = spec.dt
+    drain = (
+        spec.idle_power_w * dt
+        + spec.tx_energy_j * tx_count.astype(jnp.float32)
+        + spec.rx_energy_j * rx_count.astype(jnp.float32)
+        + jnp.where(computing, spec.compute_power_w * dt, 0.0)
+    )
+    # AlternatingEpEnergyGenerator: square wave, harvest for `duty` fraction
+    # of each period (wireless5.ini:163-166).
+    phase = jnp.mod(t, spec.harvest_period_s) / spec.harvest_period_s
+    harvesting = phase < spec.harvest_duty
+    gain = jnp.where(harvesting, spec.harvest_power_w * dt, 0.0)
+
+    e = jnp.where(
+        has_energy,
+        jnp.clip(energy - jnp.where(alive, drain, 0.0) + gain, 0.0, capacity),
+        energy,
+    )
+    frac = e / jnp.maximum(capacity, 1e-12)
+    # SimpleEpEnergyManagement hysteresis (wireless5.ini:159-161)
+    shut = has_energy & alive & (frac <= spec.shutdown_frac)
+    boot = has_energy & ~alive & (frac >= spec.start_frac)
+    alive2 = jnp.where(shut, False, jnp.where(boot, True, alive))
+    return e.astype(jnp.float32), alive2

@@ -1,0 +1,209 @@
+"""Network model: the simulated UDP/IP/802.11 stack collapsed into data.
+
+The reference gets its network effects (link delay, queueing, 802.11
+contention, AP handover) emergently from INET's per-packet stack traversal
+(SURVEY.md §2.2).  The TPU-native design replaces packet traversal with a
+*delay model*: every message's travel time is a pure function of (src, dst,
+time), composed of
+
+  ``delay(a, b, t) = wacc(a, t) + core[attach(a, t), attach(b, t)] + wacc(b, t)``
+
+where ``core`` is a small all-pairs base-delay matrix over *infrastructure
+attach points* (wired hosts, APs, routers — shortest path over link
+propagation + serialization + mean queueing, Floyd–Warshall at build time),
+``attach`` maps a node to its attach point (itself if wired, its associated
+AP if wireless — association is argmin distance within range, recomputed
+every tick so handover is emergent, mirroring INET's 802.11 mgmt), and
+``wacc`` is the wireless access delay (base MAC+serialization plus a
+contention term linear in the AP's current station count — the calibrated
+approximation of 802.11 EDCA noted in SURVEY.md §7 "hard parts").
+
+Scales to 10k+ nodes because the dense matrix is only over the ~dozens of
+infrastructure nodes; per-node state is O(N).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class NetParams:
+    """Static-per-scenario network data (device arrays, shapes fixed)."""
+
+    core_delay: jax.Array  # (I, I) f32 — base path delay between attach pts
+    node_attach: jax.Array  # (N,) i32 — wired attach point per node (or -1)
+    is_wireless: jax.Array  # (N,) bool
+    ap_nodes: jax.Array  # (A,) i32 node indices of APs (A >= 1 if any wireless)
+    ap_attach: jax.Array  # (A,) i32 infra index of each AP
+    ap_range: jax.Array  # (A,) f32 metres
+    w_base: jax.Array  # () f32 wireless per-hop base delay (s)
+    w_prop: jax.Array  # () f32 propagation s/m
+    w_contention: jax.Array  # () f32 extra delay per associated station (s)
+
+
+@struct.dataclass
+class LinkCache:
+    """Per-tick derived connectivity (recomputed after mobility)."""
+
+    assoc: jax.Array  # (N,) i32 — associated AP slot per node (-1 = none)
+    n_assoc: jax.Array  # (A,) i32 — stations per AP
+    attach_now: jax.Array  # (N,) i32 — current infra attach point per node
+    acc_delay: jax.Array  # (N,) f32 — current wireless access delay per node
+    reachable: jax.Array  # (N,) bool — node currently has connectivity
+
+
+def associate(
+    net: NetParams, pos: jax.Array, alive: jax.Array
+) -> LinkCache:
+    """Recompute AP association + access delays for the current positions.
+
+    Association = nearest alive AP within range (INET's 802.11 mgmt
+    association, made explicit).  Handover between APs as a node moves is
+    emergent, as in the reference's wireless4/wireless5 scenarios
+    (``simulations/testing/wireless4.ini``).
+    """
+    N = pos.shape[0]
+    A = net.ap_nodes.shape[0]
+    if A == 0:
+        attach_now = net.node_attach
+        return LinkCache(
+            assoc=jnp.full((N,), -1, jnp.int32),
+            n_assoc=jnp.zeros((0,), jnp.int32),
+            attach_now=attach_now,
+            acc_delay=jnp.zeros((N,), jnp.float32),
+            reachable=attach_now >= 0,
+        )
+    ap_pos = pos[net.ap_nodes]  # (A, 2)
+    ap_ok = alive[net.ap_nodes]  # (A,)
+    d2 = jnp.sum((pos[:, None, :] - ap_pos[None, :, :]) ** 2, axis=-1)  # (N, A)
+    d2 = jnp.where(ap_ok[None, :], d2, jnp.inf)
+    nearest = jnp.argmin(d2, axis=1).astype(jnp.int32)  # (N,)
+    ndist = jnp.sqrt(jnp.take_along_axis(d2, nearest[:, None], axis=1)[:, 0])
+    in_range = ndist <= net.ap_range[nearest]
+    assoc = jnp.where(net.is_wireless & in_range & alive, nearest, -1)
+
+    n_assoc = jnp.zeros((A + 1,), jnp.int32).at[
+        jnp.where(assoc >= 0, assoc, A)
+    ].add(1, mode="drop")[:A]
+
+    attach_now = jnp.where(
+        net.is_wireless,
+        jnp.where(assoc >= 0, net.ap_attach[jnp.clip(assoc, 0, A - 1)], -1),
+        net.node_attach,
+    )
+    acc = jnp.where(
+        net.is_wireless & (assoc >= 0),
+        net.w_base
+        + net.w_prop * ndist
+        + net.w_contention * n_assoc[jnp.clip(assoc, 0, A - 1)].astype(jnp.float32),
+        0.0,
+    )
+    return LinkCache(
+        assoc=assoc,
+        n_assoc=n_assoc,
+        attach_now=attach_now,
+        acc_delay=acc.astype(jnp.float32),
+        reachable=attach_now >= 0,
+    )
+
+
+def pair_delay(
+    net: NetParams, cache: LinkCache, src: jax.Array, dst: jax.Array
+) -> jax.Array:
+    """Vectorized message delay between node index arrays src/dst.
+
+    Unreachable endpoints (wireless node out of AP range, dead AP) yield
+    +inf — the message is lost, like a packet that never associates in INET.
+    """
+    I = net.core_delay.shape[0]
+    a = cache.attach_now[src]
+    b = cache.attach_now[dst]
+    core = net.core_delay[jnp.clip(a, 0, I - 1), jnp.clip(b, 0, I - 1)]
+    d = cache.acc_delay[src] + core + cache.acc_delay[dst]
+    ok = (a >= 0) & (b >= 0)
+    return jnp.where(ok, d, jnp.inf)
+
+
+# ----------------------------------------------------------------------
+# Host-side builders (numpy; run once per scenario)
+# ----------------------------------------------------------------------
+
+def build_core_delay(
+    n_infra: int,
+    links: Sequence[Tuple[int, int, float, float]],
+    packet_bytes: int = 128,
+) -> np.ndarray:
+    """All-pairs base delay over infrastructure attach points.
+
+    ``links`` entries are (i, j, datarate_bps, prop_delay_s) — the NED
+    channel parameters (e.g. 100 Mbps / 0.1 us links,
+    ``testing/wireless5.ned:37-42``).  Per-hop cost = prop +
+    serialization(packet_bytes).  Floyd–Warshall shortest path stands in for
+    IPv4NetworkConfigurator's static routing (SURVEY.md §2.2).
+    """
+    d = np.full((n_infra, n_infra), np.inf, np.float64)
+    np.fill_diagonal(d, 0.0)
+    for i, j, rate, prop in links:
+        cost = prop + (packet_bytes * 8.0) / rate
+        d[i, j] = min(d[i, j], cost)
+        d[j, i] = min(d[j, i], cost)
+    for k in range(n_infra):
+        d = np.minimum(d, d[:, k : k + 1] + d[k : k + 1, :])
+    return d.astype(np.float32)
+
+
+def make_net_params(
+    n_nodes: int,
+    core_delay: np.ndarray,
+    node_attach: np.ndarray,
+    is_wireless: np.ndarray,
+    ap_nodes: Sequence[int] = (),
+    ap_attach: Sequence[int] = (),
+    ap_range: float | Sequence[float] = 400.0,
+    w_base: float = 2e-3,
+    w_prop: float = 3.336e-9,
+    w_contention: float = 1.5e-3,
+) -> NetParams:
+    """Assemble a :class:`NetParams` pytree from host-side arrays."""
+    A = len(ap_nodes)
+    ap_range_arr = (
+        np.full((A,), ap_range, np.float32)
+        if np.isscalar(ap_range)
+        else np.asarray(ap_range, np.float32)
+    )
+    return NetParams(
+        core_delay=jnp.asarray(core_delay, jnp.float32),
+        node_attach=jnp.asarray(node_attach, jnp.int32),
+        is_wireless=jnp.asarray(is_wireless, bool),
+        ap_nodes=jnp.asarray(np.asarray(ap_nodes, np.int32)),
+        ap_attach=jnp.asarray(np.asarray(ap_attach, np.int32)),
+        ap_range=jnp.asarray(ap_range_arr),
+        w_base=jnp.asarray(w_base, jnp.float32),
+        w_prop=jnp.asarray(w_prop, jnp.float32),
+        w_contention=jnp.asarray(w_contention, jnp.float32),
+    )
+
+
+def wired_star(n_nodes: int, link_delay: float = 1e-4, rate: float = 100e6,
+               packet_bytes: int = 128) -> NetParams:
+    """Convenience: all nodes wired to one switch (the smoke-test shape).
+
+    Approximates ``simulations/testing/network.ned:27-69`` where users, fog
+    nodes and the broker hang off one router with identical channels.
+    """
+    links: List[Tuple[int, int, float, float]] = []
+    switch = n_nodes  # extra infra node for the switch
+    for i in range(n_nodes):
+        links.append((i, switch, rate, link_delay))
+    core = build_core_delay(n_nodes + 1, links, packet_bytes)
+    return make_net_params(
+        n_nodes=n_nodes,
+        core_delay=core,
+        node_attach=np.arange(n_nodes, dtype=np.int32),
+        is_wireless=np.zeros((n_nodes,), bool),
+    )

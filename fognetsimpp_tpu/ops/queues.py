@@ -1,0 +1,138 @@
+"""Batched ring-buffer FIFO ops for fog-node queues.
+
+The reference keeps one unbounded ``std::vector<Request*>`` per fog node and
+mutates it one message at a time (``src/mqttapp/ComputeBrokerApp3.cc:304-314``
+push, ``:236-252`` pop-front).  Here every fog node's FIFO is one row of a
+fixed-capacity ``(F, Q)`` ring buffer and *all* fog nodes enqueue/dequeue in
+one batched, jit-compiled operation per tick — including the case of many
+tasks arriving at the same fog node in the same tick, which is resolved by an
+in-tick stable sort (arrival time, then task id) so FIFO order matches the
+event-driven execution.
+
+In-tick write conflicts (two tasks -> one fog) are the batched analog of the
+data races a threaded DES would have; they are resolved *by construction*
+with rank-computation + scatter, never by locking (SURVEY.md §5 "race
+detection" note).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NO_TASK = -1
+
+
+class ArrivalPlan(NamedTuple):
+    """Result of planning same-tick arrivals at all fog nodes at once.
+
+    Attributes:
+      assign_task: (F,) i32 — task id to assign to each *idle* fog node now
+        (NO_TASK where no arrival / fog busy).  This is the arrival that the
+        sequential DES would have served first (min arrival time, ties by
+        task id).
+      rank: (T,) i32 — within-fog arrival rank of every masked-in task
+        (0 = first); -1 for masked-out tasks.
+      counts: (F,) i32 — number of masked-in arrivals per fog.
+    """
+
+    assign_task: jax.Array
+    rank: jax.Array
+    counts: jax.Array
+
+
+def plan_arrivals(
+    mask: jax.Array,  # (T,) bool — tasks arriving at a fog this tick
+    fog: jax.Array,  # (T,) i32 — destination fog per task
+    t_arrive: jax.Array,  # (T,) f32 — exact arrival time
+    n_fogs: int,
+    fog_idle: jax.Array,  # (F,) bool — fog can take a task immediately
+) -> ArrivalPlan:
+    """Compute per-fog arrival order for a batch of same-tick arrivals.
+
+    Sorts (fog, t_arrive, id) lexicographically, then derives each task's
+    rank within its fog segment with a cumulative-max trick — O(T log T),
+    no host round-trips, fully fused by XLA.
+    """
+    T = mask.shape[0]
+    ids = jnp.arange(T, dtype=jnp.int32)
+    f_key = jnp.where(mask, fog, n_fogs).astype(jnp.int32)
+    # lexsort: last key is primary
+    order = jnp.lexsort((ids, t_arrive, f_key))
+    f_sorted = f_key[order]
+    valid_sorted = mask[order]
+
+    idx = jnp.arange(T, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), f_sorted[1:] != f_sorted[:-1]]
+    )
+    seg_start = jax.lax.associative_scan(jnp.maximum, jnp.where(is_start, idx, 0))
+    rank_sorted = jnp.where(valid_sorted, idx - seg_start, -1)
+
+    rank = jnp.zeros((T,), jnp.int32).at[order].set(rank_sorted)
+
+    counts = (
+        jnp.zeros((n_fogs + 1,), jnp.int32).at[f_key].add(mask.astype(jnp.int32))
+    )[:n_fogs]
+
+    # first arrival per fog -> candidate for immediate assignment
+    first = jnp.full((n_fogs + 1,), NO_TASK, jnp.int32)
+    scatter_f = jnp.where(valid_sorted & (rank_sorted == 0), f_sorted, n_fogs)
+    first = first.at[scatter_f].set(order.astype(jnp.int32), mode="drop")
+    # `set` with duplicate index n_fogs is fine — we slice it off
+    assign_task = jnp.where(fog_idle, first[:n_fogs], NO_TASK)
+    return ArrivalPlan(assign_task=assign_task, rank=rank, counts=counts)
+
+
+def batched_enqueue(
+    queue: jax.Array,  # (F, Q) i32
+    q_head: jax.Array,  # (F,) i32
+    q_len: jax.Array,  # (F,) i32
+    mask: jax.Array,  # (T,) bool — tasks to enqueue
+    fog: jax.Array,  # (T,) i32
+    eff_rank: jax.Array,  # (T,) i32 — slot offset within this tick's batch
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Enqueue a batch of tasks into their fog rings at ``head+len+rank``.
+
+    Returns (queue, q_len, enq_mask, n_dropped).  Tasks whose slot would
+    exceed capacity are dropped (``enq_mask`` False) — the reference cannot
+    drop (unbounded vector); size Q generously and watch the drop counter.
+    """
+    F, Q = queue.shape
+    slot = q_head[jnp.clip(fog, 0, F - 1)] + q_len[jnp.clip(fog, 0, F - 1)] + eff_rank
+    fits = mask & (q_len[jnp.clip(fog, 0, F - 1)] + eff_rank < Q) & (eff_rank >= 0)
+    flat_idx = jnp.where(fits, jnp.clip(fog, 0, F - 1) * Q + slot % Q, F * Q)
+    ids = jnp.arange(mask.shape[0], dtype=jnp.int32)
+    flat = queue.reshape(F * Q)
+    flat = flat.at[flat_idx].set(ids, mode="drop")
+    queue = flat.reshape(F, Q)
+
+    added = jnp.zeros((F + 1,), jnp.int32).at[
+        jnp.where(fits, fog, F)
+    ].add(1, mode="drop")[:F]
+    dropped_per_fog = jnp.zeros((F + 1,), jnp.int32).at[
+        jnp.where(mask & ~fits, fog, F)
+    ].add(1, mode="drop")[:F]
+    q_len = q_len + added
+    return queue, q_len, fits, dropped_per_fog
+
+
+def batched_pop(
+    queue: jax.Array,  # (F, Q) i32
+    q_head: jax.Array,  # (F,) i32
+    q_len: jax.Array,  # (F,) i32
+    pop_mask: jax.Array,  # (F,) bool — fogs that pop their FIFO head now
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pop the head of each masked fog ring. Returns (task, q_head, q_len).
+
+    ``task`` is NO_TASK where ``pop_mask`` is False or the ring is empty.
+    Mirrors ``requests.erase(requests.begin())`` after the head is promoted
+    to ``currentTask`` (``ComputeBrokerApp3.cc:240-246``).
+    """
+    F, Q = queue.shape
+    can = pop_mask & (q_len > 0)
+    head_task = jnp.where(can, jnp.take_along_axis(queue, (q_head % Q)[:, None], axis=1)[:, 0], NO_TASK)
+    q_head = jnp.where(can, (q_head + 1) % Q, q_head)
+    q_len = jnp.where(can, q_len - 1, q_len)
+    return head_task, q_head, q_len

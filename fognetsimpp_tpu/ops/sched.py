@@ -1,0 +1,105 @@
+"""Scheduler kernels: the base broker's placement decision, batched.
+
+The reference makes one decision per publish arrival with an O(F) scan
+(``src/mqttapp/BrokerBaseApp3.cc:267-281``).  Here a whole tick's worth of
+arrivals is decided in one (T, F) score matrix + row argmin — the op the MXU
+was built for.  Crucially this batching is *faithful*: the reference broker
+does NOT update its ``brokers[]`` busy view after assigning (the view is only
+refreshed by in-flight advertisements, ``BrokerBaseApp3.cc:123-136``), so
+same-window arrivals all see the same snapshot there too.
+
+Policies beyond MIN_BUSY realise the dead ``algo`` parameter
+(``BrokerBaseApp3.ned:26``, SURVEY.md App. B item 4) as live kernels; they
+share the same signature so the policy axis is sweepable (vmap/pjit over
+policy ids — SURVEY.md §2.3 "expert parallelism" row).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..spec import Policy
+
+_BIG = jnp.float32(3.4e38)
+
+
+def _safe_div(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a / b with b==0 -> +inf (matches C++ double division by zero).
+
+    The broker registers fog nodes with MIPS=0 (``BrokerBaseApp3.cc:104``)
+    until the first advertisement arrives, so early estimates are +inf in the
+    reference as well.
+    """
+    return jnp.where(b > 0, a / jnp.where(b > 0, b, 1.0), jnp.inf)
+
+
+def schedule_batch(
+    policy: int,  # static
+    mask: jax.Array,  # (T,) bool — publishes being decided this tick
+    mips_req: jax.Array,  # (T,) f32
+    view_busy: jax.Array,  # (F,) f32 broker's stale busyTime view
+    view_mips: jax.Array,  # (F,) f32 broker's stale MIPS view
+    registered: jax.Array,  # (F,) bool
+    fog_alive: jax.Array,  # (F,) bool — used by ENERGY_AWARE / RANDOM only
+    fog_energy_frac: jax.Array,  # (F,) f32 in [0,1]
+    rtt_broker_fog: jax.Array,  # (F,) f32 — 2*d(B,f), for MIN_LATENCY
+    rr_cursor: jax.Array,  # () i32
+    key: jax.Array,  # PRNG key for RANDOM
+    mips0_divisor: bool,  # static bug-compat switch (SURVEY App. B item 1)
+) -> Tuple[jax.Array, jax.Array]:
+    """Pick a fog node for every masked task. Returns ((T,) i32 fog, rr').
+
+    MIN_BUSY reproduces ``BrokerBaseApp3.cc:267-281`` exactly, including the
+    first-wins tie-break of the ``<`` comparison and (optionally) the bug of
+    dividing every candidate's estimate by ``brokers[0]``'s MIPS.
+    """
+    T = mask.shape[0]
+    F = view_busy.shape[0]
+    if F == 0:
+        # no fog nodes exist: every decision is "no compute resource
+        # available" (BrokerBaseApp3.cc:306-319); caller handles the ack
+        return jnp.full((T,), -1, jnp.int32), rr_cursor
+    avail = registered  # reference never evicts dead fogs (App. B item 7)
+
+    divisor = view_mips[0] if mips0_divisor else view_mips  # (|) or (F,)
+    est = _safe_div(mips_req[:, None], jnp.broadcast_to(divisor, (F,))[None, :])
+
+    if policy == int(Policy.MIN_BUSY) or policy == int(Policy.LOCAL_FIRST):
+        # LOCAL_FIRST's offload branch is v1's, which is the same argmin
+        # (BrokerBaseApp.cc:173-189).
+        scores = view_busy[None, :] + est
+    elif policy == int(Policy.MIN_LATENCY):
+        scores = rtt_broker_fog[None, :] + view_busy[None, :] + est
+    elif policy == int(Policy.ENERGY_AWARE):
+        # prefer energy-rich fogs; dead fogs are unusable
+        scores = view_busy[None, :] + est + 10.0 * (1.0 - fog_energy_frac)[None, :]
+        avail = avail & fog_alive
+    elif policy == int(Policy.ROUND_ROBIN):
+        # k-th masked task of this tick gets fog (rr + k) % F among avail
+        k = jnp.cumsum(mask.astype(jnp.int32)) - 1  # rank within batch
+        n_avail = jnp.maximum(jnp.sum(avail.astype(jnp.int32)), 1)
+        slot = (rr_cursor + k) % n_avail
+        # map slot -> index of the slot-th available fog
+        avail_rank = jnp.cumsum(avail.astype(jnp.int32)) - 1  # (F,)
+        fog_of_slot = jnp.zeros((F,), jnp.int32).at[
+            jnp.where(avail, avail_rank, F)
+        ].set(jnp.arange(F, dtype=jnp.int32), mode="drop")
+        choice = fog_of_slot[slot]
+        rr_new = (rr_cursor + jnp.sum(mask.astype(jnp.int32))) % n_avail
+        return jnp.where(mask, choice, -1).astype(jnp.int32), rr_new
+    elif policy == int(Policy.RANDOM):
+        ok = avail & fog_alive
+        logits = jnp.where(ok, 0.0, -jnp.inf)
+        choice = jax.random.categorical(key, logits, shape=(T,))
+        return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
+    else:
+        raise ValueError(f"unknown policy {policy}")
+
+    scores = jnp.where(avail[None, :], scores, _BIG)
+    # all-inf rows (early publishes before any advertisement, with the
+    # MIPS=0 registration) must still pick fog 0, like the C++ `<` scan
+    scores = jnp.nan_to_num(scores, posinf=_BIG)
+    choice = jnp.argmin(scores, axis=1).astype(jnp.int32)
+    return jnp.where(mask, choice, -1), rr_cursor

@@ -1,0 +1,70 @@
+"""Wired smoke scenario: 2 users, 2 fog nodes, 1 base broker.
+
+The batched-engine rendition of the reference's wired integration smoke test
+``simulations/testing/omnetpp.ini:2`` -> network ``Network``
+(``simulations/testing/network.ned:27-69``): users and fog nodes hang off one
+router over identical 100 Mbps Ethernet links, clients publish compute tasks
+to the base broker which offloads to the least-busy fog node.
+
+Also the "minimum end-to-end slice" of SURVEY.md §7 and the shape used by the
+C++-DES parity gate (tests/test_parity.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import prime_initial_advertisements
+from ..net.mobility import MobilityBounds, default_bounds
+from ..net.topology import NetParams, wired_star
+from ..spec import Policy, WorldSpec
+from ..state import WorldState, init_state
+
+
+def build(
+    n_users: int = 2,
+    n_fogs: int = 2,
+    fog_mips: Sequence[float] = (1000.0, 2000.0),
+    send_interval: float = 0.05,
+    horizon: float = 3.35,
+    dt: float = 1e-3,
+    link_delay: float = 1e-4,
+    policy: int = int(Policy.MIN_BUSY),
+    seed: int = 0,
+    max_sends_per_user: Optional[int] = None,
+    **spec_overrides,
+):
+    """Returns (spec, state, net, bounds) for the wired smoke world."""
+    if max_sends_per_user is None:
+        max_sends_per_user = int(horizon / send_interval) + 4
+    spec = WorldSpec(
+        n_users=n_users,
+        n_fogs=n_fogs,
+        send_interval=send_interval,
+        horizon=horizon,
+        dt=dt,
+        policy=policy,
+        max_sends_per_user=max_sends_per_user,
+        **spec_overrides,
+    ).validate()
+
+    state = init_state(spec, jax.random.PRNGKey(seed))
+    # heterogeneous fog MIPS like wireless5.ini:116-119
+    mips = jnp.asarray(
+        [fog_mips[i % len(fog_mips)] for i in range(n_fogs)], jnp.float32
+    )
+    state = state.replace(
+        fogs=state.fogs.replace(mips=mips, pool_avail=mips)
+    )
+    # spread nodes on a line (positions irrelevant for wired delay)
+    n = spec.n_nodes
+    pos = jnp.stack(
+        [jnp.linspace(0.0, 100.0, n), jnp.zeros((n,))], axis=-1
+    ).astype(jnp.float32)
+    state = state.replace(nodes=state.nodes.replace(pos=pos))
+
+    net = wired_star(spec.n_nodes, link_delay=link_delay, packet_bytes=spec.task_bytes)
+    state = prime_initial_advertisements(spec, state, net)
+    return spec, state, net, default_bounds(1000.0)

@@ -1,0 +1,236 @@
+"""Static world specification for the batched tick engine.
+
+A :class:`WorldSpec` is the hashable, static-shape description of one
+simulated world: how many nodes of each kind exist, the capacities of the
+fixed-shape task/queue arrays, the tick size, the application generation
+(v1/v2/v3 of the reference apps) and the scheduling policy.
+
+Everything here is *static* under ``jax.jit`` — the dynamic quantities
+(positions, busy times, task tables, energies) live in
+:mod:`fognetsimpp_tpu.state`.
+
+Reference parity notes (citations into /root/reference):
+  * Node roles mirror the reference's node NED wrappers
+    (``src/node/compute/*.ned``, user wrappers in ``fognetsim.zip``) on top
+    of INET host types; here a role is just an integer kind plus per-node
+    parameter arrays.
+  * App generations v1/v2/v3 correspond to
+    ``src/mqttapp/{mqttApp,BrokerBaseApp,ComputeBrokerApp}[23]?.cc`` — see
+    SURVEY.md Appendix A for the capability matrix.
+  * Bug-compatibility switches replicate the reference's quirks listed in
+    SURVEY.md Appendix B (e.g. the scheduler dividing by ``brokers[0]``'s
+    MIPS, ``src/mqttapp/BrokerBaseApp3.cc:268,273,275``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+
+class NodeKind(enum.IntEnum):
+    """Role of a simulated node.
+
+    The reference distinguishes user hosts, compute brokers (fog nodes), the
+    base broker, access points and routers at the NED level
+    (``src/node/compute/*.ned``, ``simulations/testing/*.ned``).
+    """
+
+    USER = 0
+    FOG = 1
+    BROKER = 2
+    AP = 3
+    ROUTER = 4
+
+
+class Stage(enum.IntEnum):
+    """Lifecycle stage of an offloaded task.
+
+    Mirrors the status codes of the reference's ack chain
+    (``src/mqttapp/BrokerBaseApp3.cc:149`` status 4 = forwarded,
+    ``src/mqttapp/ComputeBrokerApp3.cc:287`` status 5 = assigned,
+    ``:312`` status 4 = queued, ``:231`` status 6 = performed), plus the
+    in-flight hops made explicit by the tick engine.
+    """
+
+    UNUSED = 0
+    PUB_INFLIGHT = 1  # publish travelling client -> base broker
+    TASK_INFLIGHT = 2  # FognetMsgTask travelling broker -> fog node
+    QUEUED = 3  # sitting in a fog node's FIFO queue
+    RUNNING = 4  # being served by a fog node
+    DONE = 5  # completed; status-6 ack recorded
+    NO_RESOURCE = 6  # broker had no fog nodes (BrokerBaseApp3.cc:306-319)
+    DROPPED = 7  # queue overflow (no reference analog: vectors are unbounded)
+    LOCAL_RUN = 8  # executed locally on the base broker (v1 path,
+    #                BrokerBaseApp.cc:169-189)
+
+
+class Policy(enum.IntEnum):
+    """Scheduling policy run by the base broker per publish arrival.
+
+    ``MIN_BUSY`` is the exact v3 policy (argmin of busyTime + estimated
+    service time, ``src/mqttapp/BrokerBaseApp3.cc:267-281``).  The others
+    realise the reference's dead ``algo`` parameter
+    (``src/mqttapp/BrokerBaseApp3.ned:26``, read but never branched on —
+    SURVEY.md Appendix B item 4) as live policies.
+    """
+
+    MIN_BUSY = 0
+    ROUND_ROBIN = 1
+    MIN_LATENCY = 2
+    ENERGY_AWARE = 3
+    RANDOM = 4
+    LOCAL_FIRST = 5  # v1 hybrid: local if MIPSRequired < broker MIPS
+
+
+class FogModel(enum.IntEnum):
+    """Fog-node resource model.
+
+    ``FIFO`` is v3's single-server queue (``ComputeBrokerApp3.cc:258-314``);
+    ``POOL`` is v1/v2's MIPS-pool accounting (subtract on accept, reject when
+    exhausted — ``ComputeBrokerApp2.cc:272,300``).
+    """
+
+    FIFO = 0
+    POOL = 1
+
+
+class Mobility(enum.IntEnum):
+    """Per-node mobility model (INET equivalents cited).
+
+    STATIONARY: INET StationaryMobility.  LINEAR: LinearMobility with speed +
+    angle + reflective bounds (``testing/wireless5.ini:23-50``).  CIRCLE:
+    CircleMobility around (cx, cy) with radius r and speed
+    (``example/wirelessNet.ini:13-29``).
+    """
+
+    STATIONARY = 0
+    LINEAR = 1
+    CIRCLE = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class BugCompat:
+    """Replicate-or-fix switches for the reference's quirks (SURVEY.md App. B).
+
+    Attributes:
+      mips0_divisor: scheduler estimates service time with ``brokers[0]``'s
+        MIPS for *every* candidate (``BrokerBaseApp3.cc:268,273,275``).  When
+        False, each candidate's own advertised MIPS is used.
+      zero_initial_view_mips: fog nodes register with MIPS=0 in the broker's
+        table (``BrokerBaseApp3.cc:104``) so estimates are +inf until the
+        first advertisement lands.  When False, the true MIPS is known at
+        registration.
+    """
+
+    mips0_divisor: bool = True
+    zero_initial_view_mips: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldSpec:
+    """Hashable static description of a simulated world.
+
+    Array-capacity fields size the fixed-shape state arrays:
+      * tasks capacity T = ``n_users * max_sends_per_user`` — task slots are
+        statically owned by (user, send-index) pairs so no dynamic allocation
+        is ever needed on device.
+      * each fog node owns a ring-buffer FIFO of ``queue_capacity`` slots.
+    """
+
+    # --- population ---------------------------------------------------
+    n_users: int
+    n_fogs: int
+    n_aps: int = 0
+    n_routers: int = 0
+    # there is exactly one base broker (single point of failure in the
+    # reference too — SURVEY.md §5 "no broker failover logic exists")
+
+    # --- capacities ---------------------------------------------------
+    max_sends_per_user: int = 64
+    queue_capacity: int = 64
+
+    # --- time ---------------------------------------------------------
+    dt: float = 1e-3  # tick length (s); keep <= min link delay for fidelity
+    horizon: float = 3.35  # simulated seconds (example run: BASELINE.md)
+    completions_per_tick: int = 2  # inner completion phases per tick
+
+    # --- application behaviour (mqttApp2.cc:353-409) -------------------
+    app_gen: int = 3
+    send_interval: float = 0.05  # example/wirelessNet.ini publish interval
+    send_interval_jitter: float = 0.0  # >0 resamples per send (volatile par)
+    start_time_min: float = 0.0
+    start_time_max: float = 0.0  # sends start uniform in [min, max]
+    mips_required_min: int = 200  # mqttApp2.cc:370: 200 + rand() % 701
+    mips_required_max: int = 900
+    required_time: float = 0.01  # mqttApp2.cc:372
+    task_bytes: int = 128  # mqttApp2.cc:379
+    fixed_mips_required: Optional[int] = None  # v1: 100 (mqttApp.cc:330)
+
+    # --- scheduling / fog model ---------------------------------------
+    policy: int = int(Policy.MIN_BUSY)
+    fog_model: int = int(FogModel.FIFO)
+    adv_interval: float = 0.01  # v1/v2 periodic re-advertise
+    adv_on_completion: bool = True  # v3 (ComputeBrokerApp3.cc:254)
+    adv_periodic: bool = False  # v1/v2 (ComputeBrokerApp2.cc:219)
+    broker_mips: float = 0.0  # broker's own pool for LOCAL_FIRST (v1)
+
+    # --- energy (testing/wireless5.ini:150-166) ------------------------
+    energy_enabled: bool = False
+    energy_capacity_j: float = 0.05
+    idle_power_w: float = 2e-3
+    tx_energy_j: float = 2e-4
+    rx_energy_j: float = 1e-4
+    compute_power_w: float = 5e-3  # fog drain while serving
+    harvest_power_w: float = 5e-3
+    harvest_period_s: float = 1.0  # generation/sleep alternation period
+    harvest_duty: float = 0.5
+    shutdown_frac: float = 0.10  # nodeShutdownCapacity = 10% (ini:160)
+    start_frac: float = 0.50  # nodeStartCapacity = 50% (ini:161)
+
+    # --- misc ----------------------------------------------------------
+    bug_compat: BugCompat = BugCompat()
+    record_tick_series: bool = False  # emit per-tick vectors from the scan
+
+    # ------------------------------------------------------------------
+    @property
+    def n_nodes(self) -> int:
+        return self.n_users + self.n_fogs + 1 + self.n_aps + self.n_routers
+
+    @property
+    def task_capacity(self) -> int:
+        return self.n_users * self.max_sends_per_user
+
+    @property
+    def n_ticks(self) -> int:
+        return int(round(self.horizon / self.dt))
+
+    # node index layout: [users | fogs | broker | aps | routers]
+    @property
+    def user_slice(self) -> Tuple[int, int]:
+        return (0, self.n_users)
+
+    @property
+    def fog_slice(self) -> Tuple[int, int]:
+        return (self.n_users, self.n_users + self.n_fogs)
+
+    @property
+    def broker_index(self) -> int:
+        return self.n_users + self.n_fogs
+
+    @property
+    def ap_slice(self) -> Tuple[int, int]:
+        a = self.n_users + self.n_fogs + 1
+        return (a, a + self.n_aps)
+
+    def user_index(self, u: int) -> int:
+        return u
+
+    def fog_index(self, f: int) -> int:
+        return self.n_users + f
+
+    def validate(self) -> "WorldSpec":
+        assert self.n_users >= 0 and self.n_fogs >= 0
+        assert self.max_sends_per_user > 0 and self.queue_capacity > 0
+        assert self.dt > 0 and self.horizon > 0
+        return self

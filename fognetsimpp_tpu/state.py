@@ -1,0 +1,278 @@
+"""Device-resident world state: one pytree of fixed-shape arrays.
+
+This is the TPU-native reformulation of the reference's heap-allocated
+bookkeeping: the broker's ``clients[] / brokers[] / requests[]`` vectors
+(``src/mqttapp/BrokerBaseApp3.h:26-63``), each fog node's ``requests[]``
+FIFO + ``currentTask`` (``src/mqttapp/ComputeBrokerApp3.h:26-88``) and each
+client's ``uploadedTasks[]`` table (``src/mqttapp/mqttApp2.h``) all become
+columns of dense arrays indexed by integer ids.
+
+Checkpoint/resume — absent from the reference (SURVEY.md §5) — is trivial
+here: the whole world is this one pytree; snapshot = save it plus the spec.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .spec import NodeKind, Stage, WorldSpec
+
+# Sentinel for "no task": valid task ids are [0, T).
+NO_TASK = -1
+INF = jnp.inf
+
+
+@struct.dataclass
+class NodeState:
+    """Per-node physical/platform state, length ``spec.n_nodes``.
+
+    Layout along the node axis: [users | fogs | broker | aps | routers]
+    (see :class:`~fognetsimpp_tpu.spec.WorldSpec` index helpers).
+    """
+
+    kind: jax.Array  # (N,) int8 NodeKind
+    pos: jax.Array  # (N, 2) f32 metres
+    alive: jax.Array  # (N,) bool — lifecycle status (wireless5.ini:153)
+    # mobility (net/mobility.py)
+    mobility: jax.Array  # (N,) int8 Mobility enum
+    vel: jax.Array  # (N, 2) f32 m/s (LINEAR)
+    circle_center: jax.Array  # (N, 2) f32 (CIRCLE)
+    circle_radius: jax.Array  # (N,) f32
+    circle_omega: jax.Array  # (N,) f32 rad/s (speed / radius)
+    circle_phase: jax.Array  # (N,) f32 rad
+    # energy (net/energy.py; SimpleEpEnergyStorage per wireless5.ini:156)
+    energy: jax.Array  # (N,) f32 joules
+    energy_capacity: jax.Array  # (N,) f32 joules
+    has_energy: jax.Array  # (N,) bool — node participates in energy model
+
+
+@struct.dataclass
+class UserState:
+    """Per-user application state (mqttApp2 equivalents), length U."""
+
+    next_send: jax.Array  # (U,) f32 next publish time (selfMsg MQTTDATA)
+    send_count: jax.Array  # (U,) i32 messageCount (mqttApp2.cc:355)
+    send_interval: jax.Array  # (U,) f32 per-user interval (volatile par)
+    connected: jax.Array  # (U,) bool got Connack (mqttApp2.cc:244-251)
+
+
+@struct.dataclass
+class FogState:
+    """Per-fog-node (compute broker) state, length F.
+
+    v3 single-server FIFO semantics (``ComputeBrokerApp3.cc:258-314``):
+    ``current_task``/``busy_until`` model the in-service task, ``queue`` the
+    ``requests[]`` vector as a ring buffer, ``busy_time`` the advertised
+    backlog scalar.
+    """
+
+    mips: jax.Array  # (F,) f32 par("MIPS")
+    busy_time: jax.Array  # (F,) f32 fog's own busyTime accumulator
+    current_task: jax.Array  # (F,) i32 task id or NO_TASK
+    busy_until: jax.Array  # (F,) f32 absolute finish time of current task
+    queue: jax.Array  # (F, Q) i32 task ids (ring buffer)
+    q_head: jax.Array  # (F,) i32
+    q_len: jax.Array  # (F,) i32
+    q_drops: jax.Array  # (F,) i32 overflow counter (no reference analog)
+    # v1/v2 MIPS-pool model (ComputeBrokerApp2.cc:272-310)
+    pool_avail: jax.Array  # (F,) f32 remaining MIPS in the pool
+
+
+@struct.dataclass
+class BrokerView:
+    """The base broker's (possibly stale) table of fog nodes.
+
+    Mirrors ``brokers[]`` (``BrokerBaseApp3.cc:104,123-136``): entries are
+    refreshed only when a ``FognetMsgAdvertiseMIPS`` *arrives*; between
+    advertisements the scheduler argmin runs on stale data.  In-flight
+    advertisements are modelled as one pending (value, arrival-time) slot per
+    fog node: latest-wins, matching the overwrite-on-arrival semantics.
+    """
+
+    view_mips: jax.Array  # (F,) f32 broker's last-seen MIPS per fog
+    view_busy: jax.Array  # (F,) f32 broker's last-seen busyTime per fog
+    registered: jax.Array  # (F,) bool fog sent its Connect yet
+    adv_val_mips: jax.Array  # (F,) f32 in-flight advertisement payload
+    adv_val_busy: jax.Array  # (F,) f32
+    adv_arrive_t: jax.Array  # (F,) f32 arrival time (+inf = none in flight)
+    rr_next: jax.Array  # () i32 round-robin cursor (Policy.ROUND_ROBIN)
+    local_pool: jax.Array  # () f32 broker's own MIPS pool (v1 LOCAL_FIRST)
+
+
+@struct.dataclass
+class TaskState:
+    """Task lifecycle table, capacity T = U * max_sends_per_user.
+
+    Slot ``u * max_sends_per_user + k`` is statically owned by user ``u``'s
+    ``k``-th publish, so allocation is a pure index computation.  The time
+    columns hold *exact* event times (sums of link delays and service times),
+    not tick-quantised values; the tick only controls when state transitions
+    are observed.  Ack-time columns become the reference's client signals:
+    latencyH1/latency/taskTime in milliseconds (``mqttApp2.cc:256-291``),
+    queueTime at the fog (``ComputeBrokerApp3.cc:238``), and the broker's
+    ``delay`` signal (``BrokerBaseApp3.cc:143``).
+    """
+
+    stage: jax.Array  # (T,) int8 Stage
+    user: jax.Array  # (T,) i32 originating user index
+    fog: jax.Array  # (T,) i32 assigned fog index (NO_TASK before)
+    mips_req: jax.Array  # (T,) f32 MIPSRequired
+    t_create: jax.Array  # (T,) f32 publish creation time
+    t_at_broker: jax.Array  # (T,) f32 publish arrival at base broker
+    t_at_fog: jax.Array  # (T,) f32 FognetMsgTask arrival at fog
+    t_service_start: jax.Array  # (T,) f32
+    t_complete: jax.Array  # (T,) f32
+    t_q_enter: jax.Array  # (T,) f32 queueStartTime (ComputeBrokerApp3.cc:306)
+    # client-side ack arrival times (absolute seconds; +inf = not received)
+    t_ack4_fwd: jax.Array  # (T,) broker's own "forwarded" status-4
+    t_ack4_queued: jax.Array  # (T,) relayed fog "queued" status-4
+    t_ack5: jax.Array  # (T,) relayed "assigned" status-5
+    t_ack6: jax.Array  # (T,) relayed "performed" status-6
+    queue_time_ms: jax.Array  # (T,) f32 fog queueTime signal (ms)
+
+
+@struct.dataclass
+class Metrics:
+    """Running counters (the reference's WATCH/numSent/numEchoed analogs)."""
+
+    n_published: jax.Array  # () i32 total publishes sent
+    n_scheduled: jax.Array  # () i32 broker scheduling decisions
+    n_completed: jax.Array  # () i32 tasks completed
+    n_dropped: jax.Array  # () i32 queue overflows
+    n_no_resource: jax.Array  # () i32 publishes with no fog registered
+
+
+@struct.dataclass
+class WorldState:
+    """The full world: one pytree. ``t`` is the tick-boundary clock."""
+
+    t: jax.Array  # () f32 current time (start of tick)
+    tick: jax.Array  # () i32
+    key: jax.Array  # PRNG key
+    nodes: NodeState
+    users: UserState
+    fogs: FogState
+    broker: BrokerView
+    tasks: TaskState
+    metrics: Metrics
+
+
+def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
+    """Build the t=0 world for ``spec`` with default placements.
+
+    Scenario builders (:mod:`fognetsimpp_tpu.scenarios`) refine positions,
+    mobility, MIPS and energy after calling this.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    N, U, F, T, Q = (
+        spec.n_nodes,
+        spec.n_users,
+        spec.n_fogs,
+        spec.task_capacity,
+        spec.queue_capacity,
+    )
+    f32 = jnp.float32
+
+    kind = jnp.zeros((N,), jnp.int8)
+    kind = kind.at[spec.fog_slice[0] : spec.fog_slice[1]].set(int(NodeKind.FOG))
+    kind = kind.at[spec.broker_index].set(int(NodeKind.BROKER))
+    if spec.n_aps:
+        kind = kind.at[spec.ap_slice[0] : spec.ap_slice[1]].set(int(NodeKind.AP))
+    if spec.n_routers:
+        kind = kind.at[spec.ap_slice[1] :].set(int(NodeKind.ROUTER))
+
+    nodes = NodeState(
+        kind=kind,
+        pos=jnp.zeros((N, 2), f32),
+        alive=jnp.ones((N,), bool),
+        mobility=jnp.zeros((N,), jnp.int8),
+        vel=jnp.zeros((N, 2), f32),
+        circle_center=jnp.zeros((N, 2), f32),
+        circle_radius=jnp.zeros((N,), f32),
+        circle_omega=jnp.zeros((N,), f32),
+        circle_phase=jnp.zeros((N,), f32),
+        energy=jnp.full((N,), spec.energy_capacity_j, f32),
+        energy_capacity=jnp.full((N,), spec.energy_capacity_j, f32),
+        has_energy=jnp.zeros((N,), bool),
+    )
+
+    key, k_start = jax.random.split(key)
+    start = jax.random.uniform(
+        k_start,
+        (U,),
+        f32,
+        minval=spec.start_time_min,
+        maxval=max(spec.start_time_max, spec.start_time_min + 1e-9),
+    )
+    users = UserState(
+        next_send=start,
+        send_count=jnp.zeros((U,), jnp.int32),
+        send_interval=jnp.full((U,), spec.send_interval, f32),
+        connected=jnp.ones((U,), bool),
+    )
+
+    fogs = FogState(
+        mips=jnp.full((F,), 1000.0, f32),
+        busy_time=jnp.zeros((F,), f32),
+        current_task=jnp.full((F,), NO_TASK, jnp.int32),
+        busy_until=jnp.full((F,), jnp.inf, f32),
+        queue=jnp.full((F, Q), NO_TASK, jnp.int32),
+        q_head=jnp.zeros((F,), jnp.int32),
+        q_len=jnp.zeros((F,), jnp.int32),
+        q_drops=jnp.zeros((F,), jnp.int32),
+        pool_avail=jnp.full((F,), 1000.0, f32),
+    )
+
+    view_mips0 = 0.0 if spec.bug_compat.zero_initial_view_mips else 1000.0
+    broker = BrokerView(
+        view_mips=jnp.full((F,), view_mips0, f32),
+        view_busy=jnp.zeros((F,), f32),
+        registered=jnp.ones((F,), bool),
+        adv_val_mips=jnp.zeros((F,), f32),
+        adv_val_busy=jnp.zeros((F,), f32),
+        adv_arrive_t=jnp.full((F,), jnp.inf, f32),
+        rr_next=jnp.zeros((), jnp.int32),
+        local_pool=jnp.asarray(spec.broker_mips, f32),
+    )
+
+    tasks = TaskState(
+        stage=jnp.zeros((T,), jnp.int8),
+        user=jnp.repeat(jnp.arange(U, dtype=jnp.int32), spec.max_sends_per_user),
+        fog=jnp.full((T,), NO_TASK, jnp.int32),
+        mips_req=jnp.zeros((T,), f32),
+        t_create=jnp.full((T,), jnp.inf, f32),
+        t_at_broker=jnp.full((T,), jnp.inf, f32),
+        t_at_fog=jnp.full((T,), jnp.inf, f32),
+        t_service_start=jnp.full((T,), jnp.inf, f32),
+        t_complete=jnp.full((T,), jnp.inf, f32),
+        t_q_enter=jnp.full((T,), jnp.inf, f32),
+        t_ack4_fwd=jnp.full((T,), jnp.inf, f32),
+        t_ack4_queued=jnp.full((T,), jnp.inf, f32),
+        t_ack5=jnp.full((T,), jnp.inf, f32),
+        t_ack6=jnp.full((T,), jnp.inf, f32),
+        queue_time_ms=jnp.full((T,), jnp.nan, f32),
+    )
+
+    metrics = Metrics(
+        n_published=jnp.zeros((), jnp.int32),
+        n_scheduled=jnp.zeros((), jnp.int32),
+        n_completed=jnp.zeros((), jnp.int32),
+        n_dropped=jnp.zeros((), jnp.int32),
+        n_no_resource=jnp.zeros((), jnp.int32),
+    )
+
+    return WorldState(
+        t=jnp.zeros((), f32),
+        tick=jnp.zeros((), jnp.int32),
+        key=key,
+        nodes=nodes,
+        users=users,
+        fogs=fogs,
+        broker=broker,
+        tasks=tasks,
+        metrics=metrics,
+    )

@@ -1,0 +1,14 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run on
+``xla_force_host_platform_device_count=8`` virtual CPU devices, the pattern
+the driver's ``dryrun_multichip`` also uses.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,99 @@
+"""Unit tests for the batched FIFO ring-buffer ops."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fognetsimpp_tpu.ops.queues import (
+    NO_TASK,
+    batched_enqueue,
+    batched_pop,
+    plan_arrivals,
+)
+
+
+def test_plan_arrivals_ranks_and_assignment():
+    # 6 tasks, 2 fogs. tasks 0,2,4 -> fog 0; 1,3 -> fog 1; 5 masked out
+    mask = jnp.array([1, 1, 1, 1, 1, 0], bool)
+    fog = jnp.array([0, 1, 0, 1, 0, 0], jnp.int32)
+    t = jnp.array([0.3, 0.1, 0.1, 0.2, 0.2, 0.0], jnp.float32)
+    idle = jnp.array([True, False])
+    plan = plan_arrivals(mask, fog, t, 2, idle)
+    # fog0 arrival order by time: task2 (0.1), task4 (0.2), task0 (0.3)
+    np.testing.assert_array_equal(np.asarray(plan.rank)[[2, 4, 0]], [0, 1, 2])
+    # fog1 order: task1 (0.1), task3 (0.2)
+    np.testing.assert_array_equal(np.asarray(plan.rank)[[1, 3]], [0, 1])
+    # only fog0 is idle -> gets its first arrival, fog1 gets none
+    np.testing.assert_array_equal(np.asarray(plan.assign_task), [2, NO_TASK])
+    np.testing.assert_array_equal(np.asarray(plan.counts), [3, 2])
+
+
+def test_plan_arrivals_tie_breaks_by_task_id():
+    mask = jnp.ones((3,), bool)
+    fog = jnp.zeros((3,), jnp.int32)
+    t = jnp.array([0.5, 0.5, 0.5], jnp.float32)  # simultaneous
+    plan = plan_arrivals(mask, fog, t, 1, jnp.array([True]))
+    assert int(plan.assign_task[0]) == 0  # lowest id wins, like FIFO insert
+    np.testing.assert_array_equal(np.asarray(plan.rank), [0, 1, 2])
+
+
+def test_enqueue_then_pop_fifo_order():
+    F, Q, T = 2, 4, 6
+    queue = jnp.full((F, Q), NO_TASK, jnp.int32)
+    q_head = jnp.zeros((F,), jnp.int32)
+    q_len = jnp.zeros((F,), jnp.int32)
+    mask = jnp.array([1, 1, 1, 0, 1, 0], bool)
+    fog = jnp.array([0, 0, 1, 0, 0, 0], jnp.int32)
+    rank = jnp.array([0, 1, 0, -1, 2, -1], jnp.int32)
+    queue, q_len, ok, drops = batched_enqueue(queue, q_head, q_len, mask, fog, rank)
+    np.testing.assert_array_equal(np.asarray(q_len), [3, 1])
+    assert bool(jnp.all(ok == mask))
+    assert int(drops.sum()) == 0
+
+    # pop fog0 twice -> tasks 0 then 1
+    t1, q_head, q_len = batched_pop(queue, q_head, q_len, jnp.array([True, False]))
+    np.testing.assert_array_equal(np.asarray(t1), [0, NO_TASK])
+    t2, q_head, q_len = batched_pop(queue, q_head, q_len, jnp.array([True, True]))
+    np.testing.assert_array_equal(np.asarray(t2), [1, 2])
+    np.testing.assert_array_equal(np.asarray(q_len), [1, 0])
+    t3, q_head, q_len = batched_pop(queue, q_head, q_len, jnp.array([True, True]))
+    np.testing.assert_array_equal(np.asarray(t3), [4, NO_TASK])
+
+
+def test_enqueue_overflow_drops():
+    F, Q = 1, 2
+    queue = jnp.full((F, Q), NO_TASK, jnp.int32)
+    q_head = jnp.zeros((F,), jnp.int32)
+    q_len = jnp.zeros((F,), jnp.int32)
+    mask = jnp.ones((4,), bool)
+    fog = jnp.zeros((4,), jnp.int32)
+    rank = jnp.arange(4, dtype=jnp.int32)
+    queue, q_len, ok, drops = batched_enqueue(queue, q_head, q_len, mask, fog, rank)
+    assert int(q_len[0]) == 2
+    assert int(drops[0]) == 2
+    np.testing.assert_array_equal(np.asarray(ok), [True, True, False, False])
+
+
+def test_ring_wraparound():
+    F, Q = 1, 3
+    queue = jnp.full((F, Q), NO_TASK, jnp.int32)
+    q_head = jnp.array([2], jnp.int32)  # head mid-ring
+    q_len = jnp.array([1], jnp.int32)
+    queue = queue.at[0, 2].set(7)
+    mask = jnp.array([True, True], bool)
+    fog = jnp.zeros((2,), jnp.int32)
+    rank = jnp.array([0, 1], jnp.int32)
+    queue, q_len, ok, _ = batched_enqueue(queue, q_head, q_len, mask, fog, rank)
+    assert int(q_len[0]) == 3
+    order = []
+    for _ in range(3):
+        t, q_head, q_len = batched_pop(queue, q_head, q_len, jnp.array([True]))
+        order.append(int(t[0]))
+    assert order == [7, 0, 1]
+
+
+def test_ops_jit_compile():
+    f = jax.jit(lambda m, g, t, i: plan_arrivals(m, g, t, 4, i))
+    m = jnp.ones((8,), bool)
+    g = jnp.arange(8, dtype=jnp.int32) % 4
+    t = jnp.arange(8, dtype=jnp.float32)
+    f(m, g, t, jnp.ones((4,), bool))  # must trace without error

@@ -1,0 +1,103 @@
+"""Unit tests for the scheduler kernels (policy parity + the extra policies)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fognetsimpp_tpu.ops.sched import schedule_batch
+from fognetsimpp_tpu.spec import Policy
+
+
+def _call(policy, mask, mips_req, busy, vmips, mips0=True, rr=0, key=None,
+          alive=None, efrac=None, rtt=None):
+    F = busy.shape[0]
+    if alive is None:
+        alive = jnp.ones((F,), bool)
+    if efrac is None:
+        efrac = jnp.ones((F,), jnp.float32)
+    if rtt is None:
+        rtt = jnp.zeros((F,), jnp.float32)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    return schedule_batch(
+        int(policy), mask, mips_req, busy, vmips, jnp.ones((F,), bool),
+        alive, efrac, rtt, jnp.asarray(rr, jnp.int32), key, mips0,
+    )
+
+
+def test_min_busy_matches_reference_argmin():
+    """Exact v3 semantics: argmin of busy + req/MIPS[0], first-wins ties
+    (BrokerBaseApp3.cc:267-281)."""
+    busy = jnp.array([0.5, 0.2, 0.2, 0.9], jnp.float32)
+    vmips = jnp.array([1000.0, 2000.0, 500.0, 100.0], jnp.float32)
+    mask = jnp.array([True, True], bool)
+    req = jnp.array([400.0, 800.0], jnp.float32)
+    choice, _ = _call(Policy.MIN_BUSY, mask, req, busy, vmips)
+    # with the MIPS[0] bug the estimate term is constant -> pure argmin(busy),
+    # tie between fogs 1 and 2 broken toward the lower index
+    np.testing.assert_array_equal(np.asarray(choice), [1, 1])
+
+
+def test_min_busy_without_bug_uses_per_fog_mips():
+    busy = jnp.array([0.0, 0.0], jnp.float32)
+    vmips = jnp.array([100.0, 10000.0], jnp.float32)
+    mask = jnp.array([True], bool)
+    req = jnp.array([500.0], jnp.float32)
+    choice, _ = _call(Policy.MIN_BUSY, mask, req, busy, vmips, mips0=False)
+    assert int(choice[0]) == 1  # 500/10000 << 500/100
+
+
+def test_min_busy_zero_mips_view_picks_first():
+    """Before the first advertisement the broker's view has MIPS=0
+    (BrokerBaseApp3.cc:104): estimates are +inf and the C++ `<` scan keeps
+    index 0."""
+    busy = jnp.zeros((3,), jnp.float32)
+    vmips = jnp.zeros((3,), jnp.float32)
+    mask = jnp.array([True], bool)
+    req = jnp.array([500.0], jnp.float32)
+    choice, _ = _call(Policy.MIN_BUSY, mask, req, busy, vmips)
+    assert int(choice[0]) == 0
+
+
+def test_round_robin_cycles():
+    busy = jnp.zeros((3,), jnp.float32)
+    vmips = jnp.full((3,), 1000.0, jnp.float32)
+    mask = jnp.ones((5,), bool)
+    req = jnp.full((5,), 100.0, jnp.float32)
+    choice, rr = _call(Policy.ROUND_ROBIN, mask, req, busy, vmips, rr=1)
+    np.testing.assert_array_equal(np.asarray(choice), [1, 2, 0, 1, 2])
+    assert int(rr) == (1 + 5) % 3
+
+
+def test_energy_aware_avoids_dead_and_drained():
+    busy = jnp.zeros((3,), jnp.float32)
+    vmips = jnp.full((3,), 1000.0, jnp.float32)
+    mask = jnp.array([True], bool)
+    req = jnp.array([100.0], jnp.float32)
+    alive = jnp.array([False, True, True])
+    efrac = jnp.array([1.0, 0.05, 0.9], jnp.float32)
+    choice, _ = _call(
+        Policy.ENERGY_AWARE, mask, req, busy, vmips, alive=alive, efrac=efrac
+    )
+    assert int(choice[0]) == 2
+
+
+def test_min_latency_includes_rtt():
+    busy = jnp.array([0.0, 0.0], jnp.float32)
+    vmips = jnp.full((2,), 1000.0, jnp.float32)
+    rtt = jnp.array([0.5, 0.001], jnp.float32)
+    mask = jnp.array([True], bool)
+    req = jnp.array([100.0], jnp.float32)
+    choice, _ = _call(Policy.MIN_LATENCY, mask, req, busy, vmips, rtt=rtt)
+    assert int(choice[0]) == 1
+
+
+def test_random_only_picks_alive():
+    busy = jnp.zeros((4,), jnp.float32)
+    vmips = jnp.full((4,), 1000.0, jnp.float32)
+    mask = jnp.ones((64,), bool)
+    req = jnp.full((64,), 100.0, jnp.float32)
+    alive = jnp.array([False, True, False, True])
+    choice, _ = _call(Policy.RANDOM, mask, req, busy, vmips, alive=alive,
+                      key=jax.random.PRNGKey(3))
+    got = set(np.asarray(choice).tolist())
+    assert got <= {1, 3} and len(got) == 2
