@@ -2,18 +2,26 @@
 
 This is the TPU-native replacement for OMNeT++'s sequential event loop
 (SURVEY.md §7 "guiding translation").  Per tick ``[t0, t1)`` the engine runs
-a fixed phase pipeline — mobility → association → advertisement delivery →
-publish spawning → broker scheduling → fog completions → fog arrivals →
-energy/lifecycle — each phase a masked, batched array update over the task
-table and per-node state.
+a fixed phase pipeline — mobility → association → connect/registration →
+advertisement delivery → publish spawning → broker scheduling (+ topic
+fan-out) → fog completions → fog arrivals → energy/lifecycle — each phase a
+masked, batched array update over the task table and per-node state.
 
 Event-time fidelity: all task timestamps are *exact* (sums of link delays and
-service times, chained through ``busy_until``), never tick-quantised.  The
-tick size only bounds how stale a decision's *view* can be (which fog a task
-goes to, whether a server looked idle), exactly the staleness the reference
-already has through in-flight advertisement packets.  With
-``dt <= min link delay`` the decision ordering matches the event-driven
+service times, chained through ``busy_until``/``free_since``), never
+tick-quantised.  The tick size only bounds how stale a decision's *view* can
+be (which fog a task goes to, whether a server looked idle), exactly the
+staleness the reference already has through in-flight advertisement packets.
+With ``dt <= min link delay`` the decision ordering matches the event-driven
 execution (SURVEY.md §7 "hard parts" item 1).
+
+Compaction: the two hot phases (broker scheduling, fog arrivals) gather the
+masked task rows into a fixed ``spec.window``-sized buffer before sorting /
+scoring, so their cost is O(K log K + K·F) instead of O(T log T + T·F).
+When more than K tasks mature in one tick the excess rows simply keep their
+in-flight stage and are picked up next tick (conservation holds; ordering
+degrades only under that overflow, and the selection is by task id, not
+arrival time — size K at the expected per-tick arrival rate plus slack).
 
 The hot path per reference trace §3.2:
   client publish (``mqttApp2.cc:353-409``) → broker schedule
@@ -21,11 +29,15 @@ The hot path per reference trace §3.2:
   (``ComputeBrokerApp3.cc:269-320``) → fog release
   (``ComputeBrokerApp3.cc:224-256``) → ack relay to client
   (``BrokerBaseApp3.cc:164-198`` + ``mqttApp2.cc:252-296``).
+
+v1/v2 semantics (POOL fog model, LOCAL_FIRST/MAX_MIPS policies) follow
+``BrokerBaseApp.cc:160-260`` and ``ComputeBrokerApp2.cc:246-320``; see
+:class:`~fognetsimpp_tpu.spec.FogModel` and the phase docstrings.
 """
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,8 +47,23 @@ from ..net.energy import step_energy
 from ..net.topology import LinkCache, NetParams, associate, pair_delay
 from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
 from ..ops.sched import schedule_batch
-from ..spec import Policy, Stage, WorldSpec
+from ..spec import FogModel, Policy, Stage, WorldSpec
 from ..state import WorldState
+
+
+class TickBuf(NamedTuple):
+    """Per-tick message-count accumulators feeding the energy model.
+
+    The radio tx/rx energy of INET's StateBasedEpEnergyConsumer
+    (``testing/wireless5.ini:156-157``) becomes per-message joule costs
+    multiplied by these counts (ADVICE r1: previously hardwired zeros).
+    Counts are booked in the tick where the send/receive is *decided*; the
+    at-most-one-tick skew vs the exact event time is far below the energy
+    model's own granularity.
+    """
+
+    tx: jax.Array  # (N,) i32
+    rx: jax.Array  # (N,) i32
 
 
 def _fog_node_idx(spec: WorldSpec, fog: jax.Array) -> jax.Array:
@@ -49,9 +76,82 @@ def _svc_time(spec: WorldSpec, mips_req: jax.Array, fog_mips: jax.Array) -> jax.
     return mips_req / jnp.maximum(fog_mips, 1e-9)
 
 
+def _compact(mask: jax.Array, K: int, T: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Gather the indices of up to K set bits of ``mask`` (length T).
+
+    Returns (idx, idx_clipped, valid): ``idx`` is (K,) int32 padded with T,
+    ``valid`` marks real entries.  Scatters back with ``.at[idx]`` +
+    ``mode='drop'``; gathers with ``idx_clipped``.
+    """
+    idx = jnp.nonzero(mask, size=K, fill_value=T)[0].astype(jnp.int32)
+    return idx, jnp.minimum(idx, T - 1), idx < T
+
+
 # ----------------------------------------------------------------------
 # phases
 # ----------------------------------------------------------------------
+
+def _phase_connect(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t0: jax.Array, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """MQTT connect handshake: Connect → broker registration → Connack.
+
+    Users: ``processStart`` sends MqttMsgConnect at the app start time
+    (``mqttApp2.cc:165-233``); the broker registers the client and replies
+    Connack (``BrokerBaseApp3.cc:109-120``); on Connack the client issues its
+    first publish and its subscriptions (``processConSubAck``,
+    ``mqttApp2.cc:319-351``).  Fog registrations (``isBroker`` connects,
+    ``BrokerBaseApp3.cc:102-107``) were stamped ahead of time by
+    :func:`prime_initial_advertisements`; here they just mature.
+    """
+    users, b = state.users, state.broker
+    U = spec.n_users
+    uidx = jnp.arange(U, dtype=jnp.int32)
+    broker_node = jnp.full((U,), spec.broker_index, jnp.int32)
+
+    # (a) fog registrations mature (brokers.push_back at Connect arrival)
+    b = b.replace(registered=b.register_t <= t1)
+
+    # (b) users whose start fired send Connect; stamp the Connack round-trip
+    pending = (
+        state.nodes.alive[uidx]
+        & ~users.connected
+        & jnp.isinf(users.connack_at)
+        & (users.start_t < t1)
+    )
+    d_ub = pair_delay(net, cache, uidx, broker_node)
+    t_send = jnp.maximum(users.start_t, t0)
+    connack_at = jnp.where(pending, t_send + 2.0 * d_ub, users.connack_at)
+
+    # (c) Connacks that arrived: connected; first publish fires immediately
+    #     (processConSubAck publishes then subscribes, mqttApp2.cc:319-351)
+    acked = ~users.connected & (connack_at <= t1)
+    n_subs = jnp.sum(users.sub_mask.astype(jnp.int32), axis=1)  # (U,)
+    users = users.replace(
+        connected=users.connected | acked,
+        connack_at=connack_at,
+        next_send=jnp.where(acked, connack_at, users.next_send),
+    )
+    # message accounting: Connect + per-topic Subscribe from the user;
+    # Connack + per-topic Suback from the broker
+    up_msgs = pending.astype(jnp.int32) + jnp.where(acked, n_subs, 0)
+    down_msgs = acked.astype(jnp.int32) * (1 + n_subs)
+    tx = buf.tx.at[uidx].add(up_msgs)
+    tx = tx.at[spec.broker_index].add(jnp.sum(down_msgs))
+    rx = buf.rx.at[uidx].add(down_msgs)
+    rx = rx.at[spec.broker_index].add(jnp.sum(up_msgs))
+
+    metrics = state.metrics.replace(
+        n_connected=state.metrics.n_connected + jnp.sum(acked.astype(jnp.int32)),
+        n_subscribed=state.metrics.n_subscribed
+        + jnp.sum(jnp.where(acked, n_subs, 0)),
+    )
+    return (
+        state.replace(users=users, broker=b, metrics=metrics),
+        TickBuf(tx=tx, rx=rx),
+    )
+
 
 def _phase_adverts(state: WorldState, t1: jax.Array) -> WorldState:
     """Deliver in-flight MIPS advertisements whose arrival time has passed.
@@ -71,8 +171,8 @@ def _phase_adverts(state: WorldState, t1: jax.Array) -> WorldState:
 
 def _phase_spawn(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    t0: jax.Array, t1: jax.Array,
-) -> WorldState:
+    buf: TickBuf, t0: jax.Array, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
     """Users whose send timer fired publish one task (mqttApp2.cc:353-409).
 
     Task slot ``u * max_sends + send_count[u]`` is claimed; MIPSRequired ~
@@ -86,7 +186,13 @@ def _phase_spawn(
     uidx = jnp.arange(U, dtype=jnp.int32)
     alive_u = state.nodes.alive[uidx]
 
-    due = alive_u & users.connected & (users.next_send < t1) & (users.send_count < S)
+    due = (
+        alive_u
+        & users.connected
+        & users.publisher
+        & (users.next_send < t1)
+        & (users.send_count < S)
+    )
     t_create = jnp.maximum(users.next_send, t0)  # missed-while-dead resume
 
     key, k_mips, k_jit = jax.random.split(state.key, 3)
@@ -102,10 +208,11 @@ def _phase_spawn(
     slot = jnp.where(due, uidx * S + users.send_count, T)
 
     def scat(col, val):
-        return col.at[slot].set(jnp.where(due, val, col[jnp.clip(slot, 0, T - 1)]), mode="drop")
+        return col.at[slot].set(jnp.where(due, val, 0), mode="drop")
 
     tasks = tasks.replace(
         stage=tasks.stage.at[slot].set(jnp.int8(int(Stage.PUB_INFLIGHT)), mode="drop"),
+        topic=tasks.topic.at[slot].set(users.pub_topic, mode="drop"),
         mips_req=scat(tasks.mips_req, mips_req),
         t_create=scat(tasks.t_create, t_create),
         t_at_broker=scat(tasks.t_at_broker, t_create + d_ub),
@@ -123,13 +230,14 @@ def _phase_spawn(
     metrics = state.metrics.replace(
         n_published=state.metrics.n_published + jnp.sum(due.astype(jnp.int32))
     )
-    return state.replace(users=users, tasks=tasks, metrics=metrics, key=key)
+    buf = buf._replace(tx=buf.tx.at[uidx].add(due.astype(jnp.int32)))
+    return state.replace(users=users, tasks=tasks, metrics=metrics, key=key), buf
 
 
 def _phase_broker(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    t1: jax.Array,
-) -> WorldState:
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
     """Broker decides every publish that has arrived (BrokerBaseApp3.cc:231-319).
 
     All arrivals in the window see the same view snapshot — faithful, since
@@ -137,58 +245,174 @@ def _phase_broker(
     by its own assignments.  Emits the forwarded status-4 ack
     (``BrokerBaseApp3.cc:146-150``) whose client-side arrival becomes the
     latencyH1 signal (``mqttApp2.cc:269-277``).
+
+    Additional branches here:
+      * topic fan-out (``publishAll``, ``BrokerBaseApp3.cc:365-385``,
+        upgraded from dormant to live per SURVEY §3.4): every arrival is
+        duplicated to all subscribers of its topic — one (U × topics) @
+        (topics,) matmul per tick.
+      * LOCAL_FIRST local execution (``BrokerBaseApp.cc:196-224``): tasks
+        with ``MIPSRequired < pool`` run on the broker itself; the pool is
+        debited sequentially in arrival order (exact, via a tiny lax.scan
+        over the compact window).
+      * MAX_MIPS / LOCAL_FIRST offload guard (``BrokerBaseApp.cc:244``):
+        a task whose MIPSRequired >= the winner's advertised MIPS is never
+        sent anywhere → Stage.REJECTED.
     """
     tasks, b = state.tasks, state.broker
-    T = spec.task_capacity
-    mask = (tasks.stage == int(Stage.PUB_INFLIGHT)) & (tasks.t_at_broker <= t1)
+    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    mask = (tasks.stage == jnp.int8(int(Stage.PUB_INFLIGHT))) & (
+        tasks.t_at_broker <= t1
+    )
+    idx, idxc, valid = _compact(mask, K, T)
 
+    mips_g = tasks.mips_req[idxc]
+    user_g = tasks.user[idxc]
+    topic_g = tasks.topic[idxc]
+    t_ab_g = tasks.t_at_broker[idxc]
+
+    rx = buf.rx.at[spec.broker_index].add(jnp.sum(valid.astype(jnp.int32)))
+    tx = buf.tx
+
+    # ---- topic fan-out (publishAll as a live feature) -----------------
+    metrics = state.metrics
+    users = state.users
+    if spec.fanout_enabled:
+        per_topic = jnp.zeros((spec.n_topics,), jnp.float32).at[
+            jnp.where(valid, topic_g, spec.n_topics)
+        ].add(1.0, mode="drop")
+        deliveries = (users.sub_mask.astype(jnp.float32) @ per_topic).astype(
+            jnp.int32
+        )  # (U,)
+        n_del = jnp.sum(deliveries)
+        users = users.replace(n_delivered=users.n_delivered + deliveries)
+        metrics = metrics.replace(n_fanout=metrics.n_fanout + n_del)
+        tx = tx.at[spec.broker_index].add(n_del)
+        rx = rx.at[jnp.arange(spec.n_users, dtype=jnp.int32)].add(deliveries)
+
+    # ---- LOCAL_FIRST: debit the broker's own pool in arrival order ----
+    local = jnp.zeros((K,), bool)
+    local_first = spec.policy == int(Policy.LOCAL_FIRST)
+    if local_first:
+        order = jnp.lexsort((idx, jnp.where(valid, t_ab_g, jnp.inf)))
+        mips_sorted = mips_g[order]
+        valid_sorted = valid[order]
+
+        def body(pool, xs):
+            m, v = xs
+            take = v & (m < pool)  # strict <, BrokerBaseApp.cc:171
+            return pool - jnp.where(take, m, 0.0), take
+
+        pool_after, local_sorted = jax.lax.scan(
+            body, b.local_pool, (mips_sorted, valid_sorted)
+        )
+        local = jnp.zeros((K,), bool).at[order].set(local_sorted)
+        b = b.replace(local_pool=pool_after)
+
+    # ---- offload scheduling ------------------------------------------
     any_fog = jnp.any(b.registered)
     key, k_sched = jax.random.split(state.key)
-    fog_nodes = jnp.arange(spec.n_fogs, dtype=jnp.int32) + spec.n_users
-    broker_node_f = jnp.full((spec.n_fogs,), spec.broker_index, jnp.int32)
+    fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
+    broker_node_f = jnp.full((F,), spec.broker_index, jnp.int32)
     rtt_bf = 2.0 * pair_delay(net, cache, broker_node_f, fog_nodes)
     fog_alive = state.nodes.alive[fog_nodes]
     fog_efrac = state.nodes.energy[fog_nodes] / jnp.maximum(
         state.nodes.energy_capacity[fog_nodes], 1e-12
     )
 
+    offl = valid & ~local
     choice, rr_new = schedule_batch(
-        spec.policy, mask, tasks.mips_req, b.view_busy, b.view_mips,
+        spec.policy, offl, mips_g, b.view_busy, b.view_mips,
         b.registered, fog_alive, fog_efrac, rtt_bf, b.rr_next, k_sched,
-        spec.bug_compat.mips0_divisor,
+        spec.bug_compat.mips0_divisor, spec.bug_compat.v1_max_scan,
     )
+    choice_ok = choice >= 0
+    guard_fail = jnp.zeros((K,), bool)
+    if spec.policy in (int(Policy.MAX_MIPS), int(Policy.LOCAL_FIRST)) and F > 0:
+        # per-task guard: MIPSRequired < winner's advertised MIPS, else the
+        # task is silently never sent (BrokerBaseApp.cc:244-252)
+        win_mips = b.view_mips[jnp.clip(choice, 0, F - 1)]
+        guard_fail = choice_ok & ~(mips_g < win_mips)
 
     fog_node = _fog_node_idx(spec, choice)
-    broker_node = jnp.full((T,), spec.broker_index, jnp.int32)
-    user_node = tasks.user
-    d_bf = pair_delay(net, cache, broker_node, fog_node)
-    d_bu = pair_delay(net, cache, broker_node, user_node)
+    d_bf = pair_delay(
+        net, cache, jnp.full((K,), spec.broker_index, jnp.int32), fog_node
+    )
+    d_bu = pair_delay(
+        net, cache, jnp.full((K,), spec.broker_index, jnp.int32), user_g
+    )
 
-    sched = mask & any_fog
-    no_res = mask & ~any_fog  # "no compute resource available" (:306-319)
-    tasks = tasks.replace(
-        stage=jnp.where(
-            sched, jnp.int8(int(Stage.TASK_INFLIGHT)),
-            jnp.where(no_res, jnp.int8(int(Stage.NO_RESOURCE)), tasks.stage),
+    # partition the decided arrivals: scheduled / locally run / rejected by
+    # the v1 guard / no resource (no registered fog, or a policy-level
+    # "no usable fog" -1, e.g. ENERGY_AWARE with every fog dead)
+    sched = offl & any_fog & choice_ok & ~guard_fail
+    rejected = offl & any_fog & guard_fail
+    no_res = offl & (~any_fog | (~choice_ok & ~guard_fail))
+
+    new_stage = jnp.where(
+        sched,
+        jnp.int8(int(Stage.TASK_INFLIGHT)),
+        jnp.where(
+            local,
+            jnp.int8(int(Stage.LOCAL_RUN)),
+            jnp.where(
+                rejected,
+                jnp.int8(int(Stage.REJECTED)),
+                jnp.int8(int(Stage.NO_RESOURCE)),
+            ),
         ),
-        fog=jnp.where(sched, choice, tasks.fog),
-        t_at_fog=jnp.where(sched, tasks.t_at_broker + d_bf, tasks.t_at_fog),
-        t_ack4_fwd=jnp.where(mask, tasks.t_at_broker + d_bu, tasks.t_ack4_fwd),
     )
-    metrics = state.metrics.replace(
-        n_scheduled=state.metrics.n_scheduled + jnp.sum(sched.astype(jnp.int32)),
-        n_no_resource=state.metrics.n_no_resource + jnp.sum(no_res.astype(jnp.int32)),
+    # v3 emits the forwarded status-4 for every QoS-1 publish; v1's local
+    # branch instead acks status-3 "processing" (BrokerBaseApp.cc:200-212)
+    tasks = tasks.replace(
+        stage=tasks.stage.at[idx].set(new_stage, mode="drop"),
+        fog=tasks.fog.at[idx].set(jnp.where(sched, choice, NO_TASK), mode="drop"),
+        t_at_fog=tasks.t_at_fog.at[idx].set(
+            jnp.where(sched, t_ab_g + d_bf, jnp.inf), mode="drop"
+        ),
+        t_ack4_fwd=tasks.t_ack4_fwd.at[idx].set(
+            jnp.where(~local, t_ab_g + d_bu, jnp.inf), mode="drop"
+        ),
+        t_ack3=tasks.t_ack3.at[idx].set(
+            jnp.where(local, t_ab_g + d_bu, jnp.inf), mode="drop"
+        ),
     )
-    return state.replace(
-        tasks=tasks, broker=b.replace(rr_next=rr_new), metrics=metrics, key=key
+    if local_first:
+        tasks = tasks.replace(
+            t_service_start=tasks.t_service_start.at[idx].set(
+                jnp.where(local, t_ab_g, jnp.inf), mode="drop"
+            ),
+            t_complete=tasks.t_complete.at[idx].set(
+                jnp.where(local, t_ab_g + spec.required_time, jnp.inf),
+                mode="drop",
+            ),
+        )
+    i32 = jnp.int32
+    metrics = metrics.replace(
+        n_scheduled=metrics.n_scheduled + jnp.sum(sched.astype(i32)),
+        n_no_resource=metrics.n_no_resource + jnp.sum(no_res.astype(i32)),
+        n_rejected=metrics.n_rejected + jnp.sum(rejected.astype(i32)),
+        n_local=metrics.n_local + jnp.sum(local.astype(i32)),
+    )
+    # broker sends: FognetMsgTask per scheduled + one ack per decided task
+    tx = tx.at[spec.broker_index].add(
+        jnp.sum(sched.astype(i32)) + jnp.sum(valid.astype(i32))
+    )
+    rx = rx.at[user_g].add(valid.astype(i32), mode="drop")  # ack arrives
+    return (
+        state.replace(
+            tasks=tasks, users=users, broker=b.replace(rr_next=rr_new),
+            metrics=metrics, key=key,
+        ),
+        TickBuf(tx=tx, rx=rx),
     )
 
 
 def _phase_completions(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    t1: jax.Array,
-) -> WorldState:
-    """Fog nodes whose in-service task finished release it (releaseResource,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """FIFO fogs whose in-service task finished release it (releaseResource,
     ``ComputeBrokerApp3.cc:224-256``): status-6 ack relayed to the client
     (taskTime signal), busyTime decremented by the task's service time, FIFO
     head promoted (queueTime signal), next release scheduled exactly at
@@ -196,16 +420,17 @@ def _phase_completions(
     """
     tasks, fogs, b = state.tasks, state.fogs, state.broker
     F = spec.n_fogs
-    fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
+    i32 = jnp.int32
+    fog_nodes = jnp.arange(F, dtype=i32) + spec.n_users
     fog_alive = state.nodes.alive[fog_nodes]
 
     comp = (fogs.current_task != NO_TASK) & (fogs.busy_until <= t1) & fog_alive
-    done_task = jnp.where(comp, fogs.current_task, T_SENTINEL := spec.task_capacity)
+    done_task = jnp.where(comp, fogs.current_task, spec.task_capacity)
     t_done = fogs.busy_until  # exact completion times per fog
 
     # ack6 path: fog -> broker -> client (relay, BrokerBaseApp3.cc:164-175)
     user_of = tasks.user[jnp.clip(done_task, 0, spec.task_capacity - 1)]
-    broker_node_f = jnp.full((F,), spec.broker_index, jnp.int32)
+    broker_node_f = jnp.full((F,), spec.broker_index, i32)
     d_fb = pair_delay(net, cache, fog_nodes, broker_node_f)
     d_bu = pair_delay(net, cache, broker_node_f, user_of)
     t_ack6 = t_done + d_fb + d_bu
@@ -250,28 +475,39 @@ def _phase_completions(
         busy_until=jnp.where(
             comp, jnp.where(promoted, t_done + svc_new, jnp.inf), fogs.busy_until
         ),
-        queue=fogs.queue,
+        # an idle server's next arrival cannot start before this completion
+        # (ADVICE r1: same-tick arrival-after-completion overlap)
+        free_since=jnp.where(comp & ~promoted, t_done, fogs.free_since),
         q_head=q_head,
         q_len=q_len,
     )
     # advertisement in flight: advertiseMIPS() at end of releaseResource
     # (ComputeBrokerApp3.cc:254); latest-wins single slot per fog.
-    b = b.replace(
-        adv_val_mips=jnp.where(comp, fogs.mips, b.adv_val_mips),
-        adv_val_busy=jnp.where(comp, busy_time, b.adv_val_busy),
-        adv_arrive_t=jnp.where(comp, t_done + d_fb, b.adv_arrive_t),
+    if spec.adv_on_completion:
+        b = b.replace(
+            adv_val_mips=jnp.where(comp, fogs.mips, b.adv_val_mips),
+            adv_val_busy=jnp.where(comp, busy_time, b.adv_val_busy),
+            adv_arrive_t=jnp.where(comp, t_done + d_fb, b.adv_arrive_t),
+        )
+    n_comp = jnp.sum(comp.astype(i32))
+    metrics = state.metrics.replace(n_completed=state.metrics.n_completed + n_comp)
+    # fog sends ack6 (+ advert); broker relays to the user
+    n_adv = n_comp if spec.adv_on_completion else 0
+    tx = buf.tx.at[fog_nodes].add(comp.astype(i32) * (2 if spec.adv_on_completion else 1))
+    tx = tx.at[spec.broker_index].add(n_comp)
+    rx = buf.rx.at[spec.broker_index].add(n_comp + n_adv)
+    rx = rx.at[user_of].add(comp.astype(i32), mode="drop")
+    return (
+        state.replace(tasks=tasks, fogs=fogs, broker=b, metrics=metrics),
+        TickBuf(tx=tx, rx=rx),
     )
-    metrics = state.metrics.replace(
-        n_completed=state.metrics.n_completed + jnp.sum(comp.astype(jnp.int32))
-    )
-    return state.replace(tasks=tasks, fogs=fogs, broker=b, metrics=metrics)
 
 
 def _phase_fog_arrivals(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    t1: jax.Array,
-) -> WorldState:
-    """Tasks reaching their fog node are assigned or queued
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Tasks reaching their FIFO fog node are assigned or queued
     (``ComputeBrokerApp3.cc:269-320``).
 
     busyTime += tskTime for *every* arrival (accepted or queued, ``:279``);
@@ -280,31 +516,45 @@ def _phase_fog_arrivals(
     (status-4 "queued" ack → a second latencyH1 sample at the client).
     """
     tasks, fogs = state.tasks, state.fogs
-    T, F = spec.task_capacity, spec.n_fogs
-    fog_nodes_all = jnp.arange(F, dtype=jnp.int32) + spec.n_users
+    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    i32 = jnp.int32
+    fog_nodes_all = jnp.arange(F, dtype=i32) + spec.n_users
     fog_alive = state.nodes.alive[fog_nodes_all]
 
-    arr = (tasks.stage == int(Stage.TASK_INFLIGHT)) & (tasks.t_at_fog <= t1)
-    dead_dst = arr & ~fog_alive[jnp.clip(tasks.fog, 0, F - 1)]
-    arr = arr & ~dead_dst  # packets to a dead node are lost
+    arr_full = (tasks.stage == jnp.int8(int(Stage.TASK_INFLIGHT))) & (
+        tasks.t_at_fog <= t1
+    )
+    idx, idxc, valid = _compact(arr_full, K, T)
+    fog_g = tasks.fog[idxc]  # (K,)
+    fog_gc = jnp.clip(fog_g, 0, F - 1)
+    t_af_g = tasks.t_at_fog[idxc]
+    mips_g = tasks.mips_req[idxc]
+    user_g = tasks.user[idxc]
 
-    svc = _svc_time(spec, tasks.mips_req, fogs.mips[jnp.clip(tasks.fog, 0, F - 1)])
+    dead_dst = valid & ~fog_alive[fog_gc]  # packets to a dead node are lost
+    arr = valid & ~dead_dst
+
+    svc_g = _svc_time(spec, mips_g, fogs.mips[fog_gc])
     add_busy = jnp.zeros((F + 1,), jnp.float32).at[
-        jnp.where(arr, tasks.fog, F)
-    ].add(jnp.where(arr, svc, 0.0), mode="drop")[:F]
+        jnp.where(arr, fog_g, F)
+    ].add(jnp.where(arr, svc_g, 0.0), mode="drop")[:F]
 
     idle = fogs.current_task == NO_TASK
-    plan = plan_arrivals(arr, tasks.fog, tasks.t_at_fog, F, idle)
+    plan = plan_arrivals(arr, fog_g, t_af_g, F, idle)
 
     # --- immediate assignment on idle fogs ---
-    a_task = plan.assign_task  # (F,) task id or NO_TASK
-    assigned = a_task != NO_TASK
-    a_c = jnp.clip(a_task, 0, T - 1)
-    t_start = tasks.t_at_fog[a_c]
-    svc_a = _svc_time(spec, tasks.mips_req[a_c], fogs.mips)
-    broker_node_f = jnp.full((F,), spec.broker_index, jnp.int32)
+    a_pos = plan.assign_task  # (F,) position in the K-buffer or NO_TASK
+    assigned = a_pos != NO_TASK
+    a_posc = jnp.clip(a_pos, 0, K - 1)
+    a_task = jnp.where(assigned, idx[a_posc], NO_TASK)  # global task id
+    a_taskc = jnp.clip(a_task, 0, T - 1)
+    # service starts when the task arrives — or when the server actually
+    # became free, if that was later within this same tick (free_since fix)
+    t_start = jnp.maximum(tasks.t_at_fog[a_taskc], fogs.free_since)
+    svc_a = _svc_time(spec, tasks.mips_req[a_taskc], fogs.mips)
+    broker_node_f = jnp.full((F,), spec.broker_index, i32)
     d_fb = pair_delay(net, cache, fog_nodes_all, broker_node_f)
-    d_bu_a = pair_delay(net, cache, broker_node_f, tasks.user[a_c])
+    d_bu_a = pair_delay(net, cache, broker_node_f, tasks.user[a_taskc])
     t_ack5 = t_start + d_fb + d_bu_a
 
     scat_a = jnp.where(assigned, a_task, T)
@@ -322,38 +572,269 @@ def _phase_fog_arrivals(
     )
 
     # --- queue the rest (rank shifts by 1 where the head got assigned) ---
-    got_head = assigned[jnp.clip(tasks.fog, 0, F - 1)] & idle[jnp.clip(tasks.fog, 0, F - 1)]
-    eff_rank = jnp.where(arr, plan.rank - got_head.astype(jnp.int32), -1)
-    to_queue = arr & (eff_rank >= 0) & (
-        jnp.arange(T, dtype=jnp.int32) != a_task[jnp.clip(tasks.fog, 0, F - 1)]
-    )
+    got_head = assigned[fog_gc] & idle[fog_gc]
+    eff_rank = jnp.where(arr, plan.rank - got_head.astype(i32), -1)
+    to_queue = arr & (eff_rank >= 0) & (idx != a_task[fog_gc])
     queue, q_len, enq_ok, dropped = batched_enqueue(
-        fogs.queue, fogs.q_head, fogs.q_len, to_queue, tasks.fog, eff_rank
+        fogs.queue, fogs.q_head, fogs.q_len, to_queue, fog_g, eff_rank, idx
     )
     d_bu_q = pair_delay(
-        net, cache, jnp.full((T,), spec.broker_index, jnp.int32), tasks.user
+        net, cache, jnp.full((K,), spec.broker_index, i32), user_g
     )
-    d_fb_q = d_fb[jnp.clip(tasks.fog, 0, F - 1)]
-    tasks = tasks.replace(
-        stage=jnp.where(
-            enq_ok, jnp.int8(int(Stage.QUEUED)),
-            jnp.where(
-                to_queue & ~enq_ok, jnp.int8(int(Stage.DROPPED)),
-                jnp.where(dead_dst, jnp.int8(int(Stage.DROPPED)), tasks.stage),
-            ),
+    d_fb_q = d_fb[fog_gc]
+    stage_k = jnp.where(
+        enq_ok,
+        jnp.int8(int(Stage.QUEUED)),
+        jnp.where(
+            (to_queue & ~enq_ok) | dead_dst,
+            jnp.int8(int(Stage.DROPPED)),
+            tasks.stage[idxc],
         ),
-        t_q_enter=jnp.where(enq_ok, tasks.t_at_fog, tasks.t_q_enter),
-        t_ack4_queued=jnp.where(
-            enq_ok, tasks.t_at_fog + d_fb_q + d_bu_q, tasks.t_ack4_queued
+    )
+    tasks = tasks.replace(
+        stage=tasks.stage.at[idx].set(stage_k, mode="drop"),
+        t_q_enter=tasks.t_q_enter.at[idx].set(
+            jnp.where(enq_ok, t_af_g, jnp.inf), mode="drop"
+        ),
+        t_ack4_queued=tasks.t_ack4_queued.at[idx].set(
+            jnp.where(enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf), mode="drop"
         ),
     )
     fogs = fogs.replace(queue=queue, q_len=q_len, q_drops=fogs.q_drops + dropped)
     metrics = state.metrics.replace(
         n_dropped=state.metrics.n_dropped
-        + jnp.sum((to_queue & ~enq_ok).astype(jnp.int32))
-        + jnp.sum(dead_dst.astype(jnp.int32))
+        + jnp.sum((to_queue & ~enq_ok).astype(i32))
+        + jnp.sum(dead_dst.astype(i32))
     )
-    return state.replace(tasks=tasks, fogs=fogs, metrics=metrics)
+    # every live arrival is a fog rx + one ack (assigned/queued) relayed
+    # through the broker to the user
+    acked = (assigned[fog_gc] & (idx == a_task[fog_gc])) | enq_ok
+    tx = buf.tx.at[fog_nodes_all].add(
+        jnp.zeros((F + 1,), i32).at[jnp.where(arr, fog_g, F)].add(1, mode="drop")[:F]
+    )
+    tx = tx.at[spec.broker_index].add(jnp.sum(acked.astype(i32)))
+    rx = buf.rx.at[fog_nodes_all].add(
+        jnp.zeros((F + 1,), i32).at[jnp.where(arr, fog_g, F)].add(1, mode="drop")[:F]
+    )
+    rx = rx.at[spec.broker_index].add(jnp.sum(acked.astype(i32)))
+    rx = rx.at[user_g].add(acked.astype(i32), mode="drop")
+    return (
+        state.replace(tasks=tasks, fogs=fogs, metrics=metrics),
+        TickBuf(tx=tx, rx=rx),
+    )
+
+
+# ----------------------------------------------------------------------
+# v1/v2 POOL fog model (ComputeBrokerApp2.cc:246-320)
+# ----------------------------------------------------------------------
+
+def _phase_pool_completions(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Pool tasks whose requiredTime expired release their MIPS.
+
+    ``releaseResource`` (``ComputeBrokerApp2.cc:222-245``): pool += MIPS,
+    status-6 Puback to the broker, which relays it to the client and erases
+    the request (``BrokerBaseApp2.cc:143-153``).  The reference releases at
+    most one expired task per timer tick (shared-selfMsg quirk, SURVEY App. B
+    item 8); the batched engine releases all expired tasks — the exact timer
+    dance lives in the C++ parity core, and the deviation is bounded by one
+    0.01 s advert period per extra concurrent expiry.
+
+    v1 fogs ack completion with FognetMsgTaskAck, which the v1 broker logs
+    and drops (``BrokerBaseApp.cc:142-147``) — the client never learns;
+    ``app_gen == 1`` therefore records no t_ack6.
+    """
+    tasks = state.tasks
+    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    i32 = jnp.int32
+    comp_full = (
+        (tasks.stage == jnp.int8(int(Stage.RUNNING)))
+        & (tasks.fog >= 0)
+        & (tasks.t_complete <= t1)
+    )
+    idx, idxc, valid = _compact(comp_full, K, T)
+    fog_g = jnp.clip(tasks.fog[idxc], 0, F - 1)
+    mips_g = tasks.mips_req[idxc]
+    user_g = tasks.user[idxc]
+    t_done = tasks.t_complete[idxc]
+
+    pool_avail = state.fogs.pool_avail.at[jnp.where(valid, fog_g, F)].add(
+        jnp.where(valid, mips_g, 0.0), mode="drop"
+    )
+
+    fog_nodes = jnp.arange(F, dtype=i32) + spec.n_users
+    broker_node_f = jnp.full((F,), spec.broker_index, i32)
+    d_fb_all = pair_delay(net, cache, fog_nodes, broker_node_f)
+    d_fb = d_fb_all[fog_g]
+    d_bu = pair_delay(
+        net, cache, jnp.full((K,), spec.broker_index, i32), user_g
+    )
+    t_ack6 = t_done + d_fb + d_bu
+
+    tasks = tasks.replace(
+        stage=tasks.stage.at[idx].set(jnp.int8(int(Stage.DONE)), mode="drop"),
+    )
+    if spec.app_gen >= 2:
+        tasks = tasks.replace(
+            t_ack6=tasks.t_ack6.at[idx].set(
+                jnp.where(valid, t_ack6, jnp.inf), mode="drop"
+            ),
+        )
+    n_comp = jnp.sum(valid.astype(i32))
+    metrics = state.metrics.replace(n_completed=state.metrics.n_completed + n_comp)
+    per_fog = jnp.zeros((F + 1,), i32).at[jnp.where(valid, fog_g, F)].add(
+        1, mode="drop"
+    )[:F]
+    tx = buf.tx.at[fog_nodes].add(per_fog)
+    rx = buf.rx.at[spec.broker_index].add(n_comp)
+    if spec.app_gen >= 2:
+        tx = tx.at[spec.broker_index].add(n_comp)
+        rx = rx.at[user_g].add(valid.astype(i32), mode="drop")
+    return (
+        state.replace(
+            tasks=tasks, fogs=state.fogs.replace(pool_avail=pool_avail),
+            metrics=metrics,
+        ),
+        TickBuf(tx=tx, rx=rx),
+    )
+
+
+def _phase_pool_arrivals(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Pool fogs accept/reject arriving tasks against their MIPS pool.
+
+    ``ComputeBrokerApp2.cc:258-310``: accept iff ``requiredMIPS < MIPS``
+    (strict), pool -= MIPS, expiry at ``now + requiredTime``; else TaskAck
+    (status=false) which every broker generation ignores → Stage.REJECTED.
+
+    Same-tick arrivals at one fog are pool-checked strictly in arrival
+    order: rank r of each fog's batch is processed in sub-phase r (unrolled
+    ``spec.pool_phases`` times — exact up to that depth; deeper arrivals
+    stay TASK_INFLIGHT and are re-ranked next tick).
+    """
+    tasks = state.tasks
+    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    i32 = jnp.int32
+    fog_nodes_all = jnp.arange(F, dtype=i32) + spec.n_users
+    fog_alive = state.nodes.alive[fog_nodes_all]
+
+    arr_full = (tasks.stage == jnp.int8(int(Stage.TASK_INFLIGHT))) & (
+        tasks.t_at_fog <= t1
+    )
+    idx, idxc, valid = _compact(arr_full, K, T)
+    fog_g = tasks.fog[idxc]
+    fog_gc = jnp.clip(fog_g, 0, F - 1)
+    t_af_g = tasks.t_at_fog[idxc]
+    mips_g = tasks.mips_req[idxc]
+    user_g = tasks.user[idxc]
+
+    dead_dst = valid & ~fog_alive[fog_gc]
+    arr = valid & ~dead_dst
+    plan = plan_arrivals(arr, fog_g, t_af_g, F, jnp.ones((F,), bool))
+
+    pool = state.fogs.pool_avail
+    accept = jnp.zeros((K,), bool)
+    reject = jnp.zeros((K,), bool)
+    for r in range(spec.pool_phases):
+        sel = arr & (plan.rank == r)
+        req_f = jnp.zeros((F + 1,), jnp.float32).at[
+            jnp.where(sel, fog_g, F)
+        ].add(jnp.where(sel, mips_g, 0.0), mode="drop")[:F]
+        has_f = jnp.zeros((F + 1,), bool).at[jnp.where(sel, fog_g, F)].set(
+            True, mode="drop"
+        )[:F]
+        acc_f = has_f & (req_f < pool)  # strict <, ComputeBrokerApp2.cc:269
+        pool = pool - jnp.where(acc_f, req_f, 0.0)
+        accept = accept | (sel & acc_f[fog_gc])
+        reject = reject | (sel & has_f[fog_gc] & ~acc_f[fog_gc])
+
+    stage_k = jnp.where(
+        accept,
+        jnp.int8(int(Stage.RUNNING)),
+        jnp.where(
+            reject,
+            jnp.int8(int(Stage.REJECTED)),
+            jnp.where(dead_dst, jnp.int8(int(Stage.DROPPED)), tasks.stage[idxc]),
+        ),
+    )
+    tasks = tasks.replace(
+        stage=tasks.stage.at[idx].set(stage_k, mode="drop"),
+        t_service_start=tasks.t_service_start.at[idx].set(
+            jnp.where(accept, t_af_g, jnp.inf), mode="drop"
+        ),
+        t_complete=tasks.t_complete.at[idx].set(
+            jnp.where(accept, t_af_g + spec.required_time, jnp.inf), mode="drop"
+        ),
+    )
+    fogs = state.fogs.replace(pool_avail=pool)
+    metrics = state.metrics.replace(
+        n_rejected=state.metrics.n_rejected + jnp.sum(reject.astype(i32)),
+        n_dropped=state.metrics.n_dropped + jnp.sum(dead_dst.astype(i32)),
+    )
+    # arrivals are fog rx; each decided arrival sends a TaskAck to the broker
+    decided = accept | reject
+    per_fog_rx = jnp.zeros((F + 1,), i32).at[jnp.where(arr, fog_g, F)].add(
+        1, mode="drop"
+    )[:F]
+    per_fog_tx = jnp.zeros((F + 1,), i32).at[jnp.where(decided, fog_g, F)].add(
+        1, mode="drop"
+    )[:F]
+    tx = buf.tx.at[fog_nodes_all].add(per_fog_tx)
+    rx = buf.rx.at[fog_nodes_all].add(per_fog_rx)
+    rx = rx.at[spec.broker_index].add(jnp.sum(decided.astype(i32)))
+    return (
+        state.replace(tasks=tasks, fogs=fogs, metrics=metrics),
+        TickBuf(tx=tx, rx=rx),
+    )
+
+
+def _phase_local_completions(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Broker-local tasks expire: status-6 straight to the client.
+
+    ``BrokerBaseApp.cc:369-394`` releaseResource: pool refund + status-6
+    Puback directly to the stored client address.  The refund is gated on
+    ``not bug_compat.local_pool_leak`` — the reference never actually stores
+    the request (``:208`` commented out), so its pool only ever shrinks.
+    """
+    tasks = state.tasks
+    T, K = spec.task_capacity, spec.window
+    i32 = jnp.int32
+    comp_full = (tasks.stage == jnp.int8(int(Stage.LOCAL_RUN))) & (
+        tasks.t_complete <= t1
+    )
+    idx, idxc, valid = _compact(comp_full, K, T)
+    user_g = tasks.user[idxc]
+    t_done = tasks.t_complete[idxc]
+    d_bu = pair_delay(
+        net, cache, jnp.full((K,), spec.broker_index, i32), user_g
+    )
+    tasks = tasks.replace(
+        stage=tasks.stage.at[idx].set(jnp.int8(int(Stage.DONE)), mode="drop"),
+        t_ack6=tasks.t_ack6.at[idx].set(
+            jnp.where(valid, t_done + d_bu, jnp.inf), mode="drop"
+        ),
+    )
+    b = state.broker
+    if not spec.bug_compat.local_pool_leak:
+        b = b.replace(
+            local_pool=b.local_pool
+            + jnp.sum(jnp.where(valid, tasks.mips_req[idxc], 0.0))
+        )
+    n_comp = jnp.sum(valid.astype(i32))
+    metrics = state.metrics.replace(n_completed=state.metrics.n_completed + n_comp)
+    tx = buf.tx.at[spec.broker_index].add(n_comp)
+    rx = buf.rx.at[user_g].add(valid.astype(i32), mode="drop")
+    return (
+        state.replace(tasks=tasks, broker=b, metrics=metrics),
+        TickBuf(tx=tx, rx=rx),
+    )
 
 
 def _phase_periodic_adverts(
@@ -363,8 +844,9 @@ def _phase_periodic_adverts(
     """v1/v2 fogs re-advertise every ``adv_interval`` (ComputeBrokerApp2.cc:219).
 
     Fired on the tick containing each multiple of the interval; the
-    advertisement carries the fog's *current* (MIPS, busyTime) and lands at
-    the broker after the fog->broker delay.
+    advertisement carries the fog's *current* MIPS — which in the POOL model
+    is the remaining pool (the reference mutates ``MIPS`` itself,
+    ``ComputeBrokerApp2.cc:272``) — and lands after the fog->broker delay.
     """
     F = spec.n_fogs
     fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
@@ -376,9 +858,14 @@ def _phase_periodic_adverts(
     d_fb = pair_delay(
         net, cache, fog_nodes, jnp.full((F,), spec.broker_index, jnp.int32)
     )
+    adv_mips = (
+        state.fogs.pool_avail
+        if spec.fog_model == int(FogModel.POOL)
+        else state.fogs.mips
+    )
     b = state.broker
     b = b.replace(
-        adv_val_mips=jnp.where(fire, state.fogs.mips, b.adv_val_mips),
+        adv_val_mips=jnp.where(fire, adv_mips, b.adv_val_mips),
         adv_val_busy=jnp.where(fire, state.fogs.busy_time, b.adv_val_busy),
         adv_arrive_t=jnp.where(fire, t_fire + d_fb, b.adv_arrive_t),
     )
@@ -386,15 +873,17 @@ def _phase_periodic_adverts(
 
 
 def prime_initial_advertisements(
-    spec: WorldSpec, state: WorldState, net: NetParams, t_adv: float = 0.01
+    spec: WorldSpec, state: WorldState, net: NetParams, t_adv: float = 0.01,
+    fog_start_t: float = 0.0,
 ) -> WorldState:
-    """Put each fog's first advertisement in flight at t=t_adv.
+    """Stamp fog registration + first advertisement arrival times.
 
-    Mirrors the connack handler scheduling ADVERTISEMIPS at +0.01 s
-    (``ComputeBrokerApp3.cc:261-267``); until it lands the broker's view has
-    MIPS=0 (registration default, ``BrokerBaseApp3.cc:104``) and the
-    scheduler's estimates are +inf, exactly like the reference's first
-    decisions.  Scenario builders call this after placing nodes.
+    Mirrors the fog boot sequence: Connect at ``fog_start_t`` arrives at the
+    broker one hop later (registration, ``BrokerBaseApp3.cc:102-107``,
+    MIPS=0 in the view until the first advert); Connack returns; the fog
+    schedules ADVERTISEMIPS at +``t_adv`` (``ComputeBrokerApp3.cc:261-267``)
+    whose packet lands another hop later.  Scenario builders call this after
+    placing nodes.  In the POOL model the advertised value is the pool.
     """
     cache = associate(net, state.nodes.pos, state.nodes.alive)
     F = spec.n_fogs
@@ -402,10 +891,25 @@ def prime_initial_advertisements(
     d_fb = pair_delay(
         net, cache, fog_nodes, jnp.full((F,), spec.broker_index, jnp.int32)
     )
+    adv_mips = (
+        state.fogs.pool_avail
+        if spec.fog_model == int(FogModel.POOL)
+        else state.fogs.mips
+    )
+    register_t = jnp.asarray(fog_start_t, jnp.float32) + d_fb
+    connack_at_fog = jnp.asarray(fog_start_t, jnp.float32) + 2.0 * d_fb
     b = state.broker.replace(
-        adv_val_mips=state.fogs.mips,
+        register_t=register_t if spec.connect_gating else state.broker.register_t,
+        registered=(
+            jnp.zeros((F,), bool) if spec.connect_gating else state.broker.registered
+        ),
+        adv_val_mips=adv_mips,
         adv_val_busy=state.fogs.busy_time,
-        adv_arrive_t=jnp.asarray(t_adv, jnp.float32) + d_fb,
+        adv_arrive_t=(
+            (connack_at_fog if spec.connect_gating else 0.0)
+            + jnp.asarray(t_adv, jnp.float32)
+            + d_fb
+        ),
     )
     return state.replace(broker=b)
 
@@ -423,6 +927,10 @@ def make_step(
     def step(state: WorldState, net: NetParams, bounds: MobilityBounds) -> WorldState:
         t0 = state.tick.astype(jnp.float32) * spec.dt
         t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
+        buf = TickBuf(
+            tx=jnp.zeros((spec.n_nodes,), jnp.int32),
+            rx=jnp.zeros((spec.n_nodes,), jnp.int32),
+        )
 
         # 1. mobility (positions at end-of-tick; delays in this tick use them)
         pos, vel = step_mobility(state.nodes, bounds, t1, spec.dt)
@@ -433,37 +941,46 @@ def make_step(
         cache = associate(net, pos, nodes.alive)
 
         # 3-7. protocol phases
+        if spec.connect_gating:
+            state, buf = _phase_connect(spec, state, net, cache, buf, t0, t1)
         state = _phase_adverts(state, t1)
         if spec.adv_periodic:
             state = _phase_periodic_adverts(spec, state, net, cache, t0, t1)
-        state = _phase_spawn(spec, state, net, cache, t0, t1)
-        state = _phase_broker(spec, state, net, cache, t1)
+        state, buf = _phase_spawn(spec, state, net, cache, buf, t0, t1)
+        state, buf = _phase_broker(spec, state, net, cache, buf, t1)
         if spec.n_fogs > 0:  # a fog-less world exercises only the
             # "no compute resource available" branch (BrokerBaseApp3.cc:306)
-            for _ in range(spec.completions_per_tick):
-                state = _phase_completions(spec, state, net, cache, t1)
-            state = _phase_fog_arrivals(spec, state, net, cache, t1)
+            if spec.fog_model == int(FogModel.POOL):
+                state, buf = _phase_pool_completions(
+                    spec, state, net, cache, buf, t1
+                )
+                state, buf = _phase_pool_arrivals(spec, state, net, cache, buf, t1)
+            else:
+                for _ in range(spec.completions_per_tick):
+                    state, buf = _phase_completions(spec, state, net, cache, buf, t1)
+                state, buf = _phase_fog_arrivals(spec, state, net, cache, buf, t1)
+        if spec.policy == int(Policy.LOCAL_FIRST):
+            state, buf = _phase_local_completions(spec, state, net, cache, buf, t1)
 
         # 8. energy + lifecycle
         if spec.energy_enabled:
             N = spec.n_nodes
             fog_nodes = jnp.arange(spec.n_fogs, dtype=jnp.int32) + spec.n_users
-            computing = jnp.zeros((N,), bool).at[fog_nodes].set(
-                state.fogs.current_task != NO_TASK
-            )
-            tx = jnp.zeros((N,), jnp.int32)
-            rx = jnp.zeros((N,), jnp.int32)
+            if spec.fog_model == int(FogModel.POOL):
+                fog_busy = state.fogs.pool_avail < state.fogs.mips
+            else:
+                fog_busy = state.fogs.current_task != NO_TASK
+            computing = jnp.zeros((N,), bool).at[fog_nodes].set(fog_busy)
             energy, alive = step_energy(
                 spec, state.nodes.energy, state.nodes.energy_capacity,
-                state.nodes.has_energy, state.nodes.alive, t1, tx, rx, computing,
+                state.nodes.has_energy, state.nodes.alive, t1,
+                buf.tx, buf.rx, computing,
             )
             state = state.replace(
                 nodes=state.nodes.replace(energy=energy, alive=alive)
             )
 
-        return state.replace(
-            t=t1, tick=state.tick + 1
-        )
+        return state.replace(t=t1, tick=state.tick + 1)
 
     return step
 

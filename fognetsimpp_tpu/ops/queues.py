@@ -89,9 +89,11 @@ def batched_enqueue(
     queue: jax.Array,  # (F, Q) i32
     q_head: jax.Array,  # (F,) i32
     q_len: jax.Array,  # (F,) i32
-    mask: jax.Array,  # (T,) bool — tasks to enqueue
-    fog: jax.Array,  # (T,) i32
-    eff_rank: jax.Array,  # (T,) i32 — slot offset within this tick's batch
+    mask: jax.Array,  # (K,) bool — tasks to enqueue
+    fog: jax.Array,  # (K,) i32
+    eff_rank: jax.Array,  # (K,) i32 — slot offset within this tick's batch
+    task_ids: jax.Array = None,  # (K,) i32 — global task ids to store;
+    #                               defaults to arange(K) (uncompacted call)
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Enqueue a batch of tasks into their fog rings at ``head+len+rank``.
 
@@ -103,9 +105,10 @@ def batched_enqueue(
     slot = q_head[jnp.clip(fog, 0, F - 1)] + q_len[jnp.clip(fog, 0, F - 1)] + eff_rank
     fits = mask & (q_len[jnp.clip(fog, 0, F - 1)] + eff_rank < Q) & (eff_rank >= 0)
     flat_idx = jnp.where(fits, jnp.clip(fog, 0, F - 1) * Q + slot % Q, F * Q)
-    ids = jnp.arange(mask.shape[0], dtype=jnp.int32)
+    if task_ids is None:
+        task_ids = jnp.arange(mask.shape[0], dtype=jnp.int32)
     flat = queue.reshape(F * Q)
-    flat = flat.at[flat_idx].set(ids, mode="drop")
+    flat = flat.at[flat_idx].set(task_ids, mode="drop")
     queue = flat.reshape(F, Q)
 
     added = jnp.zeros((F + 1,), jnp.int32).at[

@@ -48,6 +48,7 @@ def schedule_batch(
     rr_cursor: jax.Array,  # () i32
     key: jax.Array,  # PRNG key for RANDOM
     mips0_divisor: bool,  # static bug-compat switch (SURVEY App. B item 1)
+    v1_max_scan: bool = True,  # static bug-compat switch (MAX_MIPS scan)
 ) -> Tuple[jax.Array, jax.Array]:
     """Pick a fog node for every masked task. Returns ((T,) i32 fog, rr').
 
@@ -66,14 +67,34 @@ def schedule_batch(
     divisor = view_mips[0] if mips0_divisor else view_mips  # (|) or (F,)
     est = _safe_div(mips_req[:, None], jnp.broadcast_to(divisor, (F,))[None, :])
 
-    if policy == int(Policy.MIN_BUSY) or policy == int(Policy.LOCAL_FIRST):
-        # LOCAL_FIRST's offload branch is v1's, which is the same argmin
-        # (BrokerBaseApp.cc:173-189).
+    if policy in (int(Policy.MAX_MIPS), int(Policy.LOCAL_FIRST)):
+        # v1/v2 offload pick (BrokerBaseApp.cc:228-240): one winner for the
+        # whole batch — the scan does not depend on the task.  With the
+        # faithful bug (v1_max_scan) ``temp`` stays brokers[0]'s MIPS, so the
+        # winner is the LAST fog whose MIPS beats fog 0's (or fog 0 itself).
+        # LOCAL_FIRST's offload branch is exactly this scan (same function,
+        # sendPubAck(status=false)); its local branch is decided by the
+        # engine against the broker's own pool.  The engine also applies the
+        # per-task guard ``MIPSRequired < winner MIPS`` (BrokerBaseApp.cc:
+        # 244) — a failing task is never sent anywhere.
+        idx = jnp.arange(F, dtype=jnp.int32)
+        if v1_max_scan:
+            cand = avail & (idx > 0) & (view_mips > view_mips[0])
+            last = jnp.max(jnp.where(cand, idx, -1))
+            winner = jnp.where(last >= 0, last, 0).astype(jnp.int32)
+        else:
+            winner = jnp.argmax(jnp.where(avail, view_mips, -jnp.inf)).astype(
+                jnp.int32
+            )
+        return jnp.where(mask, winner, -1).astype(jnp.int32), rr_cursor
+    if policy == int(Policy.MIN_BUSY):
         scores = view_busy[None, :] + est
     elif policy == int(Policy.MIN_LATENCY):
         scores = rtt_broker_fog[None, :] + view_busy[None, :] + est
     elif policy == int(Policy.ENERGY_AWARE):
-        # prefer energy-rich fogs; dead fogs are unusable
+        # prefer energy-rich fogs; dead fogs are unusable (when every fog is
+        # dead the all-masked argmin would silently pick fog 0 — guard below
+        # returns -1 so the caller routes these to Stage.NO_RESOURCE)
         scores = view_busy[None, :] + est + 10.0 * (1.0 - fog_energy_frac)[None, :]
         avail = avail & fog_alive
     elif policy == int(Policy.ROUND_ROBIN):
@@ -92,7 +113,9 @@ def schedule_batch(
     elif policy == int(Policy.RANDOM):
         ok = avail & fog_alive
         logits = jnp.where(ok, 0.0, -jnp.inf)
+        # all -inf logits make categorical undefined: guard with -1
         choice = jax.random.categorical(key, logits, shape=(T,))
+        choice = jnp.where(jnp.any(ok), choice, -1)
         return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
     else:
         raise ValueError(f"unknown policy {policy}")
@@ -102,4 +125,8 @@ def schedule_batch(
     # MIPS=0 registration) must still pick fog 0, like the C++ `<` scan
     scores = jnp.nan_to_num(scores, posinf=_BIG)
     choice = jnp.argmin(scores, axis=1).astype(jnp.int32)
+    # no available fog at all -> -1 (caller routes to Stage.NO_RESOURCE);
+    # matters for ENERGY_AWARE, where avail can be empty while registered
+    # fogs exist (all dead)
+    choice = jnp.where(jnp.any(avail), choice, -1)
     return jnp.where(mask, choice, -1), rr_cursor

@@ -1,0 +1,2 @@
+"""Runtime services: signal extraction, result recording, checkpointing."""
+from .signals import extract_signals, summarize  # noqa: F401

@@ -1,0 +1,83 @@
+"""Signal extraction: the reference's statistic vectors, with safe sentinels.
+
+The task table stores absolute event times with ``+inf`` meaning "never
+happened" (and ``nan`` for unset queue times).  This module is the one place
+that turns those columns into the reference's per-task signal vectors —
+masked, finite, in milliseconds — so no downstream consumer ever does
+``inf - inf`` arithmetic:
+
+  * ``latency``   — publish → status-5 "assigned" ack (``mqttApp2.cc:256-267``)
+  * ``latency_h1``— publish → status-4 ack, both the broker's own "forwarded"
+    and the relayed fog "queued" (``mqttApp2.cc:269-277``)
+  * ``task_time`` — publish → status-6 "performed" ack (``mqttApp2.cc:279-291``)
+  * ``queue_time``— fog FIFO wait (``ComputeBrokerApp3.cc:238``)
+  * ``delay``     — broker-side publish transit (``BrokerBaseApp3.cc:143``)
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ..spec import Stage
+from ..state import WorldState
+
+
+def _finite_ms(t_end: np.ndarray, t_start: np.ndarray) -> np.ndarray:
+    """(t_end - t_start) * 1e3 over rows where both ends are finite."""
+    m = np.isfinite(t_end) & np.isfinite(t_start)
+    return ((t_end[m] - t_start[m]) * 1e3).astype(np.float64)
+
+
+def extract_signals(final: WorldState) -> Dict[str, np.ndarray]:
+    """Per-task signal vectors (milliseconds) from a finished run.
+
+    Keys mirror the reference's ``@statistic`` names; each value is the
+    1-D vector of samples that the reference would have recorded into its
+    ``.vec`` file for that signal.
+    """
+    t = final.tasks
+    t_create = np.asarray(t.t_create)
+    return {
+        "latency": _finite_ms(np.asarray(t.t_ack5), t_create),
+        "latency_h1": np.concatenate(
+            [
+                _finite_ms(np.asarray(t.t_ack4_fwd), t_create),
+                _finite_ms(np.asarray(t.t_ack4_queued), t_create),
+            ]
+        ),
+        "task_time": _finite_ms(np.asarray(t.t_ack6), t_create),
+        "ack3": _finite_ms(np.asarray(t.t_ack3), t_create),
+        "queue_time": np.asarray(t.queue_time_ms)[
+            np.isfinite(np.asarray(t.queue_time_ms))
+            & ~np.isnan(np.asarray(t.queue_time_ms))
+        ].astype(np.float64),
+        "delay": _finite_ms(np.asarray(t.t_at_broker), t_create),
+    }
+
+
+def summarize(final: WorldState) -> Dict[str, float]:
+    """Scalar roll-up: counts plus mean/max of each signal (ms)."""
+    sig = extract_signals(final)
+    stage = np.asarray(final.tasks.stage)
+    out: Dict[str, float] = {
+        f"n_{s.name.lower()}": int((stage == int(s)).sum()) for s in Stage
+    }
+    m = final.metrics
+    out.update(
+        n_published=int(m.n_published),
+        n_scheduled=int(m.n_scheduled),
+        n_completed=int(m.n_completed),
+        n_dropped=int(m.n_dropped),
+        n_no_resource=int(m.n_no_resource),
+        n_connected=int(m.n_connected),
+        n_subscribed=int(m.n_subscribed),
+        n_fanout=int(m.n_fanout),
+        n_rejected=int(m.n_rejected),
+        n_local=int(m.n_local),
+    )
+    for name, v in sig.items():
+        out[f"{name}_n"] = int(v.size)
+        out[f"{name}_mean_ms"] = float(v.mean()) if v.size else float("nan")
+        out[f"{name}_max_ms"] = float(v.max()) if v.size else float("nan")
+    return out

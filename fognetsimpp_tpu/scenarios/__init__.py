@@ -1,0 +1,6 @@
+"""Scenario builders: the reference's simulation ladder re-expressed.
+
+Each module builds (spec, state, net, bounds) for one of the reference's
+scenarios (SURVEY.md §4 table); `smoke` is the wired integration shape.
+"""
+from . import smoke  # noqa: F401

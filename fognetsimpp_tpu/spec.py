@@ -61,8 +61,13 @@ class Stage(enum.IntEnum):
     DONE = 5  # completed; status-6 ack recorded
     NO_RESOURCE = 6  # broker had no fog nodes (BrokerBaseApp3.cc:306-319)
     DROPPED = 7  # queue overflow (no reference analog: vectors are unbounded)
-    LOCAL_RUN = 8  # executed locally on the base broker (v1 path,
-    #                BrokerBaseApp.cc:169-189)
+    LOCAL_RUN = 8  # executing locally on the base broker (v1 path,
+    #                BrokerBaseApp.cc:196-224 sendPubAck(status=true))
+    REJECTED = 9  # pool fog rejected (TaskAck status=false,
+    #               ComputeBrokerApp2.cc:300-310 — the broker ignores the
+    #               TaskAck, BrokerBaseApp2.cc:139-141, so the task dies) or
+    #               the v1 offload scan found no fog with MIPS > required
+    #               (BrokerBaseApp.cc:244 guard: nothing is sent at all)
 
 
 class Policy(enum.IntEnum):
@@ -80,7 +85,11 @@ class Policy(enum.IntEnum):
     MIN_LATENCY = 2
     ENERGY_AWARE = 3
     RANDOM = 4
-    LOCAL_FIRST = 5  # v1 hybrid: local if MIPSRequired < broker MIPS
+    LOCAL_FIRST = 5  # v1 hybrid: local if MIPSRequired < broker pool
+    #                  (BrokerBaseApp.cc:171-180), else offload via MAX_MIPS
+    MAX_MIPS = 6  # v1/v2 offload pick: the buggy "max MIPS" scan that
+    #               compares every candidate to brokers[0]
+    #               (BrokerBaseApp.cc:228-240; see BugCompat.v1_max_scan)
 
 
 class FogModel(enum.IntEnum):
@@ -121,10 +130,20 @@ class BugCompat:
         table (``BrokerBaseApp3.cc:104``) so estimates are +inf until the
         first advertisement lands.  When False, the true MIPS is known at
         registration.
+      v1_max_scan: the v1/v2 offload scan compares every candidate's MIPS to
+        ``brokers[0]``'s instead of the running max (``BrokerBaseApp.cc:
+        232-236``: ``temp`` is never updated), so the winner is the *last*
+        fog whose MIPS exceeds fog 0's.  When False, a true argmax is used.
+      local_pool_leak: the v1 local path never records its Request
+        (``BrokerBaseApp.cc:208`` is commented out) so ``releaseResource``
+        finds nothing and the broker pool is never refunded.  When False,
+        the pool is released at task expiry (the evident intent).
     """
 
     mips0_divisor: bool = True
     zero_initial_view_mips: bool = True
+    v1_max_scan: bool = True
+    local_pool_leak: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,6 +168,12 @@ class WorldSpec:
     # --- capacities ---------------------------------------------------
     max_sends_per_user: int = 64
     queue_capacity: int = 64
+    # Max task arrivals decided per tick at the broker / at the fogs.  The
+    # hot phases gather the masked rows into a buffer of this size (sort and
+    # score-matrix cost O(K) instead of O(T)); overflowing arrivals simply
+    # stay in flight and are picked up next tick.  None = task_capacity
+    # (never overflows; right for small worlds and parity tests).
+    arrival_window: Optional[int] = None
 
     # --- time ---------------------------------------------------------
     dt: float = 1e-3  # tick length (s); keep <= min link delay for fidelity
@@ -174,6 +199,19 @@ class WorldSpec:
     adv_on_completion: bool = True  # v3 (ComputeBrokerApp3.cc:254)
     adv_periodic: bool = False  # v1/v2 (ComputeBrokerApp2.cc:219)
     broker_mips: float = 0.0  # broker's own pool for LOCAL_FIRST (v1)
+    # POOL fog model: how many arrival ranks are pool-checked per tick (the
+    # sequential accept/reject chain is exact up to this depth; deeper
+    # arrivals wait a tick).  See _phase_pool_arrivals.
+    pool_phases: int = 4
+
+    # --- MQTT control plane (BrokerBaseApp3.cc:86-121, 201-218) --------
+    # When True, users/fogs start unconnected: a Connect must round-trip to
+    # the broker before the first publish / advertisement (mqttApp2.cc:
+    # 165-233, ComputeBrokerApp3.cc:261-267).  False = born connected (the
+    # round-1 shortcut, kept for micro-tests).
+    connect_gating: bool = True
+    n_topics: int = 1  # topic id space for subscriptions / fan-out
+    fanout_enabled: bool = True  # publishAll as a live feature (SURVEY §3.4)
 
     # --- energy (testing/wireless5.ini:150-166) ------------------------
     energy_enabled: bool = False
@@ -229,8 +267,22 @@ class WorldSpec:
     def fog_index(self, f: int) -> int:
         return self.n_users + f
 
+    @property
+    def window(self) -> int:
+        """Effective arrival-compaction buffer size K."""
+        if self.arrival_window is None:
+            return self.task_capacity
+        return min(self.arrival_window, self.task_capacity)
+
     def validate(self) -> "WorldSpec":
         assert self.n_users >= 0 and self.n_fogs >= 0
         assert self.max_sends_per_user > 0 and self.queue_capacity > 0
         assert self.dt > 0 and self.horizon > 0
+        assert self.n_topics >= 1 and self.pool_phases >= 1
+        if self.arrival_window is not None:
+            assert self.arrival_window > 0
+        if self.policy == int(Policy.LOCAL_FIRST):
+            assert self.broker_mips > 0, (
+                "LOCAL_FIRST needs a broker-side MIPS pool (broker_mips)"
+            )
         return self

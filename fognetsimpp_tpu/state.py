@@ -57,6 +57,18 @@ class UserState:
     send_count: jax.Array  # (U,) i32 messageCount (mqttApp2.cc:355)
     send_interval: jax.Array  # (U,) f32 per-user interval (volatile par)
     connected: jax.Array  # (U,) bool got Connack (mqttApp2.cc:244-251)
+    # --- MQTT control plane (spec.connect_gating) ----------------------
+    start_t: jax.Array  # (U,) f32 app start time (processStart sends Connect)
+    connack_at: jax.Array  # (U,) f32 Connack arrival at the user (+inf until
+    #                         the connect phase stamps it)
+    publisher: jax.Array  # (U,) bool role mask: publishes tasks (the pub/sub
+    #                        split of testing/omnetpp.ini:18-21)
+    pub_topic: jax.Array  # (U,) i32 topic id this user publishes on
+    sub_mask: jax.Array  # (U, n_topics) bool subscription table (the
+    #                       broker's subscriptions[] vector, BrokerBaseApp3
+    #                       .cc:201-218, transposed to per-user rows)
+    n_delivered: jax.Array  # (U,) i32 publishes fanned out to this user
+    #                          (publishAll, BrokerBaseApp3.cc:365-385)
 
 
 @struct.dataclass
@@ -73,6 +85,9 @@ class FogState:
     busy_time: jax.Array  # (F,) f32 fog's own busyTime accumulator
     current_task: jax.Array  # (F,) i32 task id or NO_TASK
     busy_until: jax.Array  # (F,) f32 absolute finish time of current task
+    free_since: jax.Array  # (F,) f32 when an idle fog last became idle (an
+    #                         arrival earlier than this still starts service
+    #                         here — the event-driven server was busy then)
     queue: jax.Array  # (F, Q) i32 task ids (ring buffer)
     q_head: jax.Array  # (F,) i32
     q_len: jax.Array  # (F,) i32
@@ -94,7 +109,11 @@ class BrokerView:
 
     view_mips: jax.Array  # (F,) f32 broker's last-seen MIPS per fog
     view_busy: jax.Array  # (F,) f32 broker's last-seen busyTime per fog
-    registered: jax.Array  # (F,) bool fog sent its Connect yet
+    registered: jax.Array  # (F,) bool fog's Connect has arrived
+    register_t: jax.Array  # (F,) f32 when the fog's Connect arrives at the
+    #                         broker (brokers.push_back, BrokerBaseApp3.cc:
+    #                         102-107); +inf = never (connect_gating off
+    #                         initialises it to 0: born registered)
     adv_val_mips: jax.Array  # (F,) f32 in-flight advertisement payload
     adv_val_busy: jax.Array  # (F,) f32
     adv_arrive_t: jax.Array  # (F,) f32 arrival time (+inf = none in flight)
@@ -119,6 +138,7 @@ class TaskState:
     stage: jax.Array  # (T,) int8 Stage
     user: jax.Array  # (T,) i32 originating user index
     fog: jax.Array  # (T,) i32 assigned fog index (NO_TASK before)
+    topic: jax.Array  # (T,) i32 publish topic id (MqttMsgPublish.msg:22)
     mips_req: jax.Array  # (T,) f32 MIPSRequired
     t_create: jax.Array  # (T,) f32 publish creation time
     t_at_broker: jax.Array  # (T,) f32 publish arrival at base broker
@@ -127,6 +147,8 @@ class TaskState:
     t_complete: jax.Array  # (T,) f32
     t_q_enter: jax.Array  # (T,) f32 queueStartTime (ComputeBrokerApp3.cc:306)
     # client-side ack arrival times (absolute seconds; +inf = not received)
+    t_ack3: jax.Array  # (T,) v1 local-accept "processing" status-3
+    #                     (BrokerBaseApp.cc:212)
     t_ack4_fwd: jax.Array  # (T,) broker's own "forwarded" status-4
     t_ack4_queued: jax.Array  # (T,) relayed fog "queued" status-4
     t_ack5: jax.Array  # (T,) relayed "assigned" status-5
@@ -143,6 +165,11 @@ class Metrics:
     n_completed: jax.Array  # () i32 tasks completed
     n_dropped: jax.Array  # () i32 queue overflows
     n_no_resource: jax.Array  # () i32 publishes with no fog registered
+    n_connected: jax.Array  # () i32 users whose Connack arrived (numClients)
+    n_subscribed: jax.Array  # () i32 subscriptions acked (numSubscribed)
+    n_fanout: jax.Array  # () i32 publishAll deliveries to subscribers
+    n_rejected: jax.Array  # () i32 pool rejections / v1 unsendable offloads
+    n_local: jax.Array  # () i32 tasks run locally on the broker (v1)
 
 
 @struct.dataclass
@@ -208,11 +235,18 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         minval=spec.start_time_min,
         maxval=max(spec.start_time_max, spec.start_time_min + 1e-9),
     )
+    gating = spec.connect_gating
     users = UserState(
-        next_send=start,
+        next_send=jnp.full((U,), jnp.inf, f32) if gating else start,
         send_count=jnp.zeros((U,), jnp.int32),
         send_interval=jnp.full((U,), spec.send_interval, f32),
-        connected=jnp.ones((U,), bool),
+        connected=jnp.full((U,), not gating, bool),
+        start_t=start,
+        connack_at=jnp.full((U,), jnp.inf, f32),
+        publisher=jnp.ones((U,), bool),
+        pub_topic=jnp.zeros((U,), jnp.int32),
+        sub_mask=jnp.zeros((U, spec.n_topics), bool),
+        n_delivered=jnp.zeros((U,), jnp.int32),
     )
 
     fogs = FogState(
@@ -220,6 +254,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         busy_time=jnp.zeros((F,), f32),
         current_task=jnp.full((F,), NO_TASK, jnp.int32),
         busy_until=jnp.full((F,), jnp.inf, f32),
+        free_since=jnp.full((F,), -jnp.inf, f32),
         queue=jnp.full((F, Q), NO_TASK, jnp.int32),
         q_head=jnp.zeros((F,), jnp.int32),
         q_len=jnp.zeros((F,), jnp.int32),
@@ -231,7 +266,8 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
     broker = BrokerView(
         view_mips=jnp.full((F,), view_mips0, f32),
         view_busy=jnp.zeros((F,), f32),
-        registered=jnp.ones((F,), bool),
+        registered=jnp.full((F,), not gating, bool),
+        register_t=jnp.full((F,), jnp.inf if gating else 0.0, f32),
         adv_val_mips=jnp.zeros((F,), f32),
         adv_val_busy=jnp.zeros((F,), f32),
         adv_arrive_t=jnp.full((F,), jnp.inf, f32),
@@ -243,6 +279,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         stage=jnp.zeros((T,), jnp.int8),
         user=jnp.repeat(jnp.arange(U, dtype=jnp.int32), spec.max_sends_per_user),
         fog=jnp.full((T,), NO_TASK, jnp.int32),
+        topic=jnp.zeros((T,), jnp.int32),
         mips_req=jnp.zeros((T,), f32),
         t_create=jnp.full((T,), jnp.inf, f32),
         t_at_broker=jnp.full((T,), jnp.inf, f32),
@@ -250,6 +287,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         t_service_start=jnp.full((T,), jnp.inf, f32),
         t_complete=jnp.full((T,), jnp.inf, f32),
         t_q_enter=jnp.full((T,), jnp.inf, f32),
+        t_ack3=jnp.full((T,), jnp.inf, f32),
         t_ack4_fwd=jnp.full((T,), jnp.inf, f32),
         t_ack4_queued=jnp.full((T,), jnp.inf, f32),
         t_ack5=jnp.full((T,), jnp.inf, f32),
@@ -263,6 +301,11 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         n_completed=jnp.zeros((), jnp.int32),
         n_dropped=jnp.zeros((), jnp.int32),
         n_no_resource=jnp.zeros((), jnp.int32),
+        n_connected=jnp.zeros((), jnp.int32),
+        n_subscribed=jnp.zeros((), jnp.int32),
+        n_fanout=jnp.zeros((), jnp.int32),
+        n_rejected=jnp.zeros((), jnp.int32),
+        n_local=jnp.zeros((), jnp.int32),
     )
 
     return WorldState(

@@ -1,0 +1,147 @@
+"""v1/v2 application-generation semantics: POOL fogs, LOCAL_FIRST, MAX_MIPS.
+
+Round-1 exported these enums without implementing them (VERDICT items 5/8/9/
+11); these tests pin the now-live semantics to the reference:
+``ComputeBrokerApp2.cc:258-310`` (pool accept/reject/release),
+``BrokerBaseApp.cc:160-260`` (local-first + the buggy max-MIPS offload scan).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from fognetsimpp_tpu import BugCompat, FogModel, Policy, Stage, run
+from fognetsimpp_tpu.scenarios import smoke
+
+
+def _pool_world(**kw):
+    kw.setdefault("n_users", 2)
+    kw.setdefault("n_fogs", 3)
+    kw.setdefault("fog_mips", (1000.0, 3000.0, 2000.0))
+    kw.setdefault("horizon", 0.4)
+    kw.setdefault("send_interval", 0.05)
+    kw.setdefault("fog_model", int(FogModel.POOL))
+    kw.setdefault("policy", int(Policy.MAX_MIPS))
+    kw.setdefault("adv_periodic", True)
+    kw.setdefault("adv_on_completion", False)
+    kw.setdefault("app_gen", 2)
+    return smoke.build(**kw)
+
+
+def test_pool_accept_and_release():
+    """Pool tasks run concurrently for requiredTime then refund the pool."""
+    spec, state, net, bounds = _pool_world()
+    final, _ = run(spec, state, net, bounds)
+    stage = np.asarray(final.tasks.stage)
+    done = stage == int(Stage.DONE)
+    assert done.sum() > 0
+    # service duration is exactly requiredTime (ComputeBrokerApp2.cc:275:
+    # expiry = now + requiredTime, independent of MIPS rating)
+    svc = (
+        np.asarray(final.tasks.t_complete)[done]
+        - np.asarray(final.tasks.t_service_start)[done]
+    )
+    np.testing.assert_allclose(svc, spec.required_time, rtol=1e-4)
+    # at quiescence every accepted task has been released: pool == rated MIPS
+    in_flight = np.isin(
+        stage, [int(Stage.RUNNING), int(Stage.QUEUED), int(Stage.TASK_INFLIGHT)]
+    ).sum()
+    if in_flight == 0:
+        np.testing.assert_allclose(
+            np.asarray(final.fogs.pool_avail), np.asarray(final.fogs.mips)
+        )
+    # v2 completions reach the client through the broker relay
+    assert np.isfinite(np.asarray(final.tasks.t_ack6)[done]).all()
+
+
+def test_pool_rejects_oversized_tasks():
+    """A task bigger than the whole pool is rejected (strict <,
+    ComputeBrokerApp2.cc:269), and the broker ignores the TaskAck."""
+    spec, state, net, bounds = _pool_world(
+        fog_mips=(500.0, 500.0, 500.0),
+        fixed_mips_required=800,  # > every pool -> every arrival rejected
+        bug_compat=BugCompat(v1_max_scan=False),
+    )
+    final, _ = run(spec, state, net, bounds)
+    stage = np.asarray(final.tasks.stage)
+    # the broker-side guard (MIPSRequired < winner's advertised MIPS,
+    # BrokerBaseApp.cc:244) already refuses to send once adverts arrive;
+    # anything sent before the first advert lands is rejected at the fog
+    assert (stage[stage != int(Stage.UNUSED)] != int(Stage.DONE)).all()
+    assert int(final.metrics.n_rejected) > 0
+    assert int(final.metrics.n_completed) == 0
+
+
+def test_v1_max_scan_bug_compat():
+    """The faithful v1 scan picks the LAST fog whose MIPS beats fog 0's
+    (BrokerBaseApp.cc:232-236: `temp` is never updated), not the true max."""
+    spec, state, net, bounds = _pool_world(
+        fog_mips=(1000.0, 3000.0, 2000.0),
+        fixed_mips_required=100,
+        horizon=0.3,
+    )
+    final, _ = run(spec, state, net, bounds)
+    fog = np.asarray(final.tasks.fog)
+    sent = fog >= 0
+    assert sent.any()
+    # skip decisions made before the first advertisement arrived (view
+    # MIPS all zero -> winner falls back to fog 0)
+    t_ab = np.asarray(final.tasks.t_at_broker)
+    informed = sent & (t_ab > 0.05)
+    # buggy scan: last fog with MIPS > 1000 is fog 2 (2000), not fog 1 (3000)
+    assert (fog[informed] == 2).all()
+
+    spec2, state2, net2, bounds2 = _pool_world(
+        fog_mips=(1000.0, 3000.0, 2000.0),
+        fixed_mips_required=100,
+        horizon=0.3,
+        bug_compat=BugCompat(v1_max_scan=False),
+    )
+    final2, _ = run(spec2, state2, net2, bounds2)
+    fog2 = np.asarray(final2.tasks.fog)
+    informed2 = (fog2 >= 0) & (np.asarray(final2.tasks.t_at_broker) > 0.05)
+    assert (fog2[informed2] == 1).all()  # true argmax
+
+
+def test_local_first_runs_small_tasks_on_broker():
+    """LOCAL_FIRST (v1): tasks with MIPSRequired < pool run locally with a
+    status-3 ack and a direct status-6 on expiry (BrokerBaseApp.cc:196-224,
+    369-394); the pool is debited and refunded."""
+    spec, state, net, bounds = _pool_world(
+        policy=int(Policy.LOCAL_FIRST),
+        broker_mips=10000.0,
+        fixed_mips_required=400,
+        horizon=0.3,
+    )
+    final, _ = run(spec, state, net, bounds)
+    stage = np.asarray(final.tasks.stage)
+    created = np.isfinite(np.asarray(final.tasks.t_create))
+    # pool 10000 >> 400: everything runs locally
+    assert int(final.metrics.n_local) == created.sum() > 0
+    done = stage == int(Stage.DONE)
+    assert done.sum() > 0
+    assert np.isfinite(np.asarray(final.tasks.t_ack3)[done]).all()
+    assert np.isfinite(np.asarray(final.tasks.t_ack6)[done]).all()
+    # local run takes exactly requiredTime on the broker
+    svc = (
+        np.asarray(final.tasks.t_complete)[done]
+        - np.asarray(final.tasks.t_service_start)[done]
+    )
+    np.testing.assert_allclose(svc, spec.required_time, rtol=1e-4)
+    # pool refunded at quiescence (local_pool_leak defaults False)
+    if (stage == int(Stage.LOCAL_RUN)).sum() == 0:
+        np.testing.assert_allclose(float(final.broker.local_pool), 10000.0)
+
+
+def test_local_pool_leak_bug_compat():
+    """With the faithful leak (BrokerBaseApp.cc:208 commented out) the
+    broker pool only ever shrinks, eventually pushing tasks to offload."""
+    spec, state, net, bounds = _pool_world(
+        policy=int(Policy.LOCAL_FIRST),
+        broker_mips=1000.0,
+        fixed_mips_required=400,
+        horizon=0.3,
+        bug_compat=BugCompat(local_pool_leak=True),
+    )
+    final, _ = run(spec, state, net, bounds)
+    # 1000 -> two local runs (400+400), then pool=200 < 400 forever
+    assert int(final.metrics.n_local) == 2
+    assert float(final.broker.local_pool) <= 200.0 + 1e-6
