@@ -37,6 +37,9 @@ class NetParams:
 
     core_delay: jax.Array  # (I, I) f32 — base path delay between attach pts
     node_attach: jax.Array  # (N,) i32 — wired attach point per node (or -1)
+    node_acc: jax.Array  # (N,) f32 — wired access-link delay to the attach
+    #   point (lets many hosts share one infra entry: a 10k-host star is one
+    #   switch + per-node access cost, O(N) instead of an O(N^2) matrix)
     is_wireless: jax.Array  # (N,) bool
     ap_nodes: jax.Array  # (A,) i32 node indices of APs (A >= 1 if any wireless)
     ap_attach: jax.Array  # (A,) i32 infra index of each AP
@@ -55,10 +58,38 @@ class LinkCache:
     attach_now: jax.Array  # (N,) i32 — current infra attach point per node
     acc_delay: jax.Array  # (N,) f32 — current wireless access delay per node
     reachable: jax.Array  # (N,) bool — node currently has connectivity
+    d2b: jax.Array  # (N,) f32 — delay(node, broker) this tick (+inf when
+    #   unreachable).  Every message in the protocol has the base broker at
+    #   one end (SURVEY.md §3.2-3.3), so this one vector serves all phases.
+
+
+def _delay_between(
+    net: NetParams, attach_a, acc_a, attach_b, acc_b
+) -> jax.Array:
+    """The delay model: ``acc_a + core[attach_a, attach_b] + acc_b``.
+
+    Single implementation shared by :func:`pair_delay` and the per-tick
+    broker-delay cache; unattached endpoints (attach < 0) yield +inf.
+    """
+    I = net.core_delay.shape[0]
+    core = net.core_delay[
+        jnp.clip(attach_a, 0, I - 1), jnp.clip(attach_b, 0, I - 1)
+    ]
+    d = acc_a + core + acc_b
+    return jnp.where((attach_a >= 0) & (attach_b >= 0), d, jnp.inf)
+
+
+def _delay_to(
+    net: NetParams, attach_now: jax.Array, acc_delay: jax.Array, dst: int
+) -> jax.Array:
+    """Per-node delay to one fixed destination node (the base broker)."""
+    return _delay_between(
+        net, attach_now, acc_delay, attach_now[dst], acc_delay[dst]
+    )
 
 
 def associate(
-    net: NetParams, pos: jax.Array, alive: jax.Array
+    net: NetParams, pos: jax.Array, alive: jax.Array, broker: int = 0
 ) -> LinkCache:
     """Recompute AP association + access delays for the current positions.
 
@@ -75,8 +106,9 @@ def associate(
             assoc=jnp.full((N,), -1, jnp.int32),
             n_assoc=jnp.zeros((0,), jnp.int32),
             attach_now=attach_now,
-            acc_delay=jnp.zeros((N,), jnp.float32),
+            acc_delay=net.node_acc,
             reachable=attach_now >= 0,
+            d2b=_delay_to(net, attach_now, net.node_acc, broker),
         )
     ap_pos = pos[net.ap_nodes]  # (A, 2)
     ap_ok = alive[net.ap_nodes]  # (A,)
@@ -101,14 +133,16 @@ def associate(
         net.w_base
         + net.w_prop * ndist
         + net.w_contention * n_assoc[jnp.clip(assoc, 0, A - 1)].astype(jnp.float32),
-        0.0,
+        net.node_acc,
     )
+    acc = acc.astype(jnp.float32)
     return LinkCache(
         assoc=assoc,
         n_assoc=n_assoc,
         attach_now=attach_now,
-        acc_delay=acc.astype(jnp.float32),
+        acc_delay=acc,
         reachable=attach_now >= 0,
+        d2b=_delay_to(net, attach_now, acc, broker),
     )
 
 
@@ -120,13 +154,13 @@ def pair_delay(
     Unreachable endpoints (wireless node out of AP range, dead AP) yield
     +inf — the message is lost, like a packet that never associates in INET.
     """
-    I = net.core_delay.shape[0]
-    a = cache.attach_now[src]
-    b = cache.attach_now[dst]
-    core = net.core_delay[jnp.clip(a, 0, I - 1), jnp.clip(b, 0, I - 1)]
-    d = cache.acc_delay[src] + core + cache.acc_delay[dst]
-    ok = (a >= 0) & (b >= 0)
-    return jnp.where(ok, d, jnp.inf)
+    return _delay_between(
+        net,
+        cache.attach_now[src],
+        cache.acc_delay[src],
+        cache.attach_now[dst],
+        cache.acc_delay[dst],
+    )
 
 
 # ----------------------------------------------------------------------
@@ -168,6 +202,7 @@ def make_net_params(
     w_base: float = 2e-3,
     w_prop: float = 3.336e-9,
     w_contention: float = 1.5e-3,
+    node_acc: np.ndarray | None = None,
 ) -> NetParams:
     """Assemble a :class:`NetParams` pytree from host-side arrays."""
     A = len(ap_nodes)
@@ -176,9 +211,12 @@ def make_net_params(
         if np.isscalar(ap_range)
         else np.asarray(ap_range, np.float32)
     )
+    if node_acc is None:
+        node_acc = np.zeros((n_nodes,), np.float32)
     return NetParams(
         core_delay=jnp.asarray(core_delay, jnp.float32),
         node_attach=jnp.asarray(node_attach, jnp.int32),
+        node_acc=jnp.asarray(node_acc, jnp.float32),
         is_wireless=jnp.asarray(is_wireless, bool),
         ap_nodes=jnp.asarray(np.asarray(ap_nodes, np.int32)),
         ap_attach=jnp.asarray(np.asarray(ap_attach, np.int32)),
@@ -195,15 +233,18 @@ def wired_star(n_nodes: int, link_delay: float = 1e-4, rate: float = 100e6,
 
     Approximates ``simulations/testing/network.ned:27-69`` where users, fog
     nodes and the broker hang off one router with identical channels.
+
+    Built as ONE infra point (the switch) with per-node access-link delays,
+    so construction and memory are O(N) — a 10k-host star needs no 10k²
+    delay matrix.  ``delay(a, b) = acc_a + acc_b`` for distinct nodes,
+    identical to the two-hop path through the switch.
     """
-    links: List[Tuple[int, int, float, float]] = []
-    switch = n_nodes  # extra infra node for the switch
-    for i in range(n_nodes):
-        links.append((i, switch, rate, link_delay))
-    core = build_core_delay(n_nodes + 1, links, packet_bytes)
+    cost = link_delay + (packet_bytes * 8.0) / rate
+    core = np.zeros((1, 1), np.float32)
     return make_net_params(
         n_nodes=n_nodes,
         core_delay=core,
-        node_attach=np.arange(n_nodes, dtype=np.int32),
+        node_attach=np.zeros((n_nodes,), np.int32),
         is_wireless=np.zeros((n_nodes,), bool),
+        node_acc=np.full((n_nodes,), cost, np.float32),
     )

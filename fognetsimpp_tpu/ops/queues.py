@@ -42,46 +42,70 @@ class ArrivalPlan(NamedTuple):
     counts: jax.Array
 
 
+# Above this batch width the O(K^2) pairwise rank falls back to sorting.
+_PAIRWISE_MAX = 4096
+
+
 def plan_arrivals(
-    mask: jax.Array,  # (T,) bool — tasks arriving at a fog this tick
-    fog: jax.Array,  # (T,) i32 — destination fog per task
-    t_arrive: jax.Array,  # (T,) f32 — exact arrival time
+    mask: jax.Array,  # (K,) bool — tasks arriving at a fog this tick
+    fog: jax.Array,  # (K,) i32 — destination fog per task
+    t_arrive: jax.Array,  # (K,) f32 — exact arrival time
     n_fogs: int,
     fog_idle: jax.Array,  # (F,) bool — fog can take a task immediately
 ) -> ArrivalPlan:
     """Compute per-fog arrival order for a batch of same-tick arrivals.
 
-    Sorts (fog, t_arrive, id) lexicographically, then derives each task's
-    rank within its fog segment with a cumulative-max trick — O(T log T),
-    no host round-trips, fully fused by XLA.
+    For bench-sized windows (K <= 4096) the within-fog rank is one fused
+    O(K^2) pairwise comparison + row-sum — dramatically cheaper on TPU than
+    a bitonic ``lexsort`` chain (tens of sequential sort stages per tick for
+    a few thousand elements).  Larger windows fall back to the sort path.
+    The first arrival per fog comes from two scatter-mins (time, then id
+    among time-ties), preserving the (t_arrive, id) tie-break of the
+    sequential event order.
     """
-    T = mask.shape[0]
-    ids = jnp.arange(T, dtype=jnp.int32)
+    K = mask.shape[0]
+    ids = jnp.arange(K, dtype=jnp.int32)
     f_key = jnp.where(mask, fog, n_fogs).astype(jnp.int32)
-    # lexsort: last key is primary
-    order = jnp.lexsort((ids, t_arrive, f_key))
-    f_sorted = f_key[order]
-    valid_sorted = mask[order]
+    t_key = jnp.where(mask, t_arrive, jnp.inf)
 
-    idx = jnp.arange(T, dtype=jnp.int32)
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), f_sorted[1:] != f_sorted[:-1]]
-    )
-    seg_start = jax.lax.associative_scan(jnp.maximum, jnp.where(is_start, idx, 0))
-    rank_sorted = jnp.where(valid_sorted, idx - seg_start, -1)
-
-    rank = jnp.zeros((T,), jnp.int32).at[order].set(rank_sorted)
+    if K <= _PAIRWISE_MAX:
+        same = f_key[None, :] == f_key[:, None]  # (K, K) j vs i
+        earlier = (t_key[None, :] < t_key[:, None]) | (
+            (t_key[None, :] == t_key[:, None]) & (ids[None, :] < ids[:, None])
+        )
+        before = same & earlier & mask[None, :]
+        rank = jnp.where(mask, jnp.sum(before, axis=1, dtype=jnp.int32), -1)
+    else:
+        order = jnp.lexsort((ids, t_arrive, f_key))
+        f_sorted = f_key[order]
+        valid_sorted = mask[order]
+        idx = jnp.arange(K, dtype=jnp.int32)
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), f_sorted[1:] != f_sorted[:-1]]
+        )
+        seg_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_start, idx, 0)
+        )
+        rank_sorted = jnp.where(valid_sorted, idx - seg_start, -1)
+        rank = jnp.zeros((K,), jnp.int32).at[order].set(rank_sorted)
 
     counts = (
         jnp.zeros((n_fogs + 1,), jnp.int32).at[f_key].add(mask.astype(jnp.int32))
     )[:n_fogs]
 
-    # first arrival per fog -> candidate for immediate assignment
-    first = jnp.full((n_fogs + 1,), NO_TASK, jnp.int32)
-    scatter_f = jnp.where(valid_sorted & (rank_sorted == 0), f_sorted, n_fogs)
-    first = first.at[scatter_f].set(order.astype(jnp.int32), mode="drop")
-    # `set` with duplicate index n_fogs is fine — we slice it off
-    assign_task = jnp.where(fog_idle, first[:n_fogs], NO_TASK)
+    # first arrival per fog: scatter-min on time, then min id among ties
+    scatter_f = jnp.where(mask, f_key, n_fogs)
+    t_min = jnp.full((n_fogs + 1,), jnp.inf, jnp.float32).at[scatter_f].min(
+        t_key, mode="drop"
+    )[:n_fogs]
+    is_tmin = mask & (t_key == t_min[jnp.clip(f_key, 0, n_fogs - 1)])
+    first = jnp.full((n_fogs + 1,), jnp.iinfo(jnp.int32).max, jnp.int32).at[
+        jnp.where(is_tmin, f_key, n_fogs)
+    ].min(ids, mode="drop")[:n_fogs]
+    has_arrival = counts > 0
+    assign_task = jnp.where(
+        fog_idle & has_arrival, first, NO_TASK
+    ).astype(jnp.int32)
     return ArrivalPlan(assign_task=assign_task, rank=rank, counts=counts)
 
 

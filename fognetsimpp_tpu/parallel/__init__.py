@@ -1,0 +1,23 @@
+"""Parallel execution: replicas, mesh sharding, policy sweeps.
+
+The reference is a single-threaded sequential DES — OMNeT++ 4.6 executes
+one event at a time and the repo never enables parsim (SURVEY.md §2.3).
+The TPU-native scale-out axes this package provides instead:
+
+  * **DP** — :func:`replicas.run_replicated`: ``vmap`` over Monte-Carlo
+    world replicas, optionally sharded over a device mesh
+    (:mod:`mesh`) so each chip advances its own slice of replicas.
+  * **TP** — :mod:`tp`: node-axis sharding of the scheduler's score
+    matrix via ``shard_map`` with cross-shard argmin combines, for worlds
+    whose fog population exceeds one chip's comfortable tile.
+  * **EP** — :func:`sweep.sweep_policies`: the policy axis of the grid
+    (the reference's dead ``algo`` parameter made sweepable).
+
+Collectives ride the mesh (ICI within a slice, DCN across) through XLA —
+``all_gather``/``pmin`` inserted by ``shard_map`` — never hand-written
+transports.
+"""
+from .replicas import replicate_state, run_replicated, replica_counters  # noqa: F401
+from .mesh import make_mesh, replica_sharding, shard_replicas, run_sharded  # noqa: F401
+from .sweep import sweep_policies  # noqa: F401
+from .tp import sharded_min_busy  # noqa: F401

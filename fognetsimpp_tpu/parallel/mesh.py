@@ -1,0 +1,94 @@
+"""Device-mesh sharding of the replica axis (DP over ICI/DCN).
+
+A batched world (leading replica axis from
+:func:`fognetsimpp_tpu.parallel.replicas.replicate_state`) is laid out with
+``NamedSharding(mesh, P('replica', ...))`` on every leaf; the jitted
+``vmap(scan(step))`` then partitions cleanly — replicas never communicate,
+so XLA inserts zero collectives in the steady state and each chip advances
+its local slice at full speed.  Cross-replica reductions (sweep summaries)
+become single ``psum``-style combines at the end, riding ICI within a slice
+and DCN across slices (SURVEY.md §2.3 "distributed comm backend" row).
+
+This is the TPU-native replacement for launching N OMNeT++ processes: one
+program, one compile, N_devices × replicas-per-device worlds.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.engine import run
+from ..net.mobility import MobilityBounds
+from ..net.topology import NetParams
+from ..spec import WorldSpec
+from ..state import WorldState
+
+REPLICA_AXIS = "replica"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, axis_name: str = REPLICA_AXIS
+) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def replica_sharding(mesh: Mesh, axis_name: str = REPLICA_AXIS):
+    """Pytree-of-shardings: leading axis split over the mesh, rest replicated."""
+
+    def leaf(x):
+        x = jax.numpy.asarray(x) if not hasattr(x, "ndim") else x
+        return NamedSharding(mesh, P(axis_name, *([None] * (x.ndim - 1))))
+
+    return leaf
+
+
+def shard_replicas(
+    batch: WorldState, mesh: Mesh, axis_name: str = REPLICA_AXIS
+) -> WorldState:
+    """Place a replicated world on the mesh, replica axis sharded.
+
+    The replica count must divide the mesh size evenly (fixed shapes).
+    """
+    leaf = replica_sharding(mesh, axis_name)
+    return jax.tree.map(lambda x: jax.device_put(x, leaf(x)), batch)
+
+
+def run_sharded(
+    spec: WorldSpec,
+    batch: WorldState,
+    net: NetParams,
+    bounds: MobilityBounds,
+    mesh: Mesh,
+    n_ticks: Optional[int] = None,
+    axis_name: str = REPLICA_AXIS,
+) -> WorldState:
+    """Shard the replica axis over ``mesh`` and advance all replicas.
+
+    Identical semantics to :func:`replicas.run_replicated` — a test asserts
+    bit-equality — but each device owns ``R / n_devices`` replicas.  ``net``
+    and ``bounds`` are replicated to every device.
+    """
+    batch = shard_replicas(batch, mesh, axis_name)
+    repl = NamedSharding(mesh, P())
+    net = jax.tree.map(lambda x: jax.device_put(x, repl), net)
+    bounds = jax.tree.map(lambda x: jax.device_put(x, repl), bounds)
+
+    def run_one(s: WorldState) -> WorldState:
+        final, _ = run(spec, s, net, bounds, n_ticks=n_ticks)
+        return final
+
+    leaf = replica_sharding(mesh, axis_name)
+    out_shardings = jax.tree.map(leaf, batch)
+    fn = jax.jit(jax.vmap(run_one), out_shardings=out_shardings)
+    return fn(batch)

@@ -1,0 +1,107 @@
+"""Monte-Carlo replica fan-out: ``vmap`` over whole worlds.
+
+The reference runs one world per OS process and sweeps sequentially
+(``simulations/run:3`` launches a single binary; no ``repeat`` keys in any
+ini — SURVEY.md §2.3 DP row).  Here a replica is one more leading axis on
+the world pytree: ``vmap(step)`` advances every replica's every node in the
+same fused kernels, and the replica axis is what the mesh shards
+(:mod:`fognetsimpp_tpu.parallel.mesh`).
+
+Replicas share the (static) topology/``NetParams`` and differ in PRNG key —
+hence task sizes (``mqttApp2.cc:370``), app start times, and optionally the
+per-user publish interval (the ``volatile sendInterval`` NED parameter,
+``mqttApp2.ned:22-40``, re-sampled per replica here).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.engine import run
+from ..net.mobility import MobilityBounds
+from ..net.topology import NetParams
+from ..spec import WorldSpec
+from ..state import WorldState
+
+
+def replicate_state(
+    spec: WorldSpec,
+    state: WorldState,
+    n_replicas: int,
+    seed: int = 0,
+    resample_starts: bool = True,
+) -> WorldState:
+    """Broadcast one world to ``n_replicas`` with per-replica PRNG keys.
+
+    Every leaf gains a leading replica axis.  When ``resample_starts`` and
+    the spec declares a start-time window, each replica redraws its user app
+    start times (the per-run RNG seeding the reference gets from OMNeT++'s
+    seedset — SURVEY.md §4 item 4).
+    """
+    R = n_replicas
+    keys = jax.random.split(jax.random.PRNGKey(seed), R)
+    batch = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (R,) + jnp.shape(x)), state
+    )
+    batch = batch.replace(key=keys)
+    if resample_starts and spec.start_time_max > spec.start_time_min:
+        sub = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+        starts = jax.vmap(
+            lambda k: jax.random.uniform(
+                k,
+                (spec.n_users,),
+                jnp.float32,
+                minval=spec.start_time_min,
+                maxval=spec.start_time_max,
+            )
+        )(sub)
+        users = batch.users.replace(start_t=starts)
+        if not spec.connect_gating:
+            # without the connect handshake the first publish fires at the
+            # app start time directly (round-1 shortcut worlds)
+            users = users.replace(next_send=starts)
+        batch = batch.replace(users=users)
+    return batch
+
+
+def run_replicated(
+    spec: WorldSpec,
+    batch: WorldState,
+    net: NetParams,
+    bounds: MobilityBounds,
+    n_ticks: Optional[int] = None,
+) -> WorldState:
+    """Advance every replica over the horizon: ``jit(vmap(scan(step)))``.
+
+    ``net``/``bounds`` are shared (broadcast) across replicas.  Returns the
+    batched final state; pull per-replica scalars with
+    :func:`replica_counters`.
+    """
+
+    def run_one(s: WorldState) -> WorldState:
+        final, _ = run(spec, s, net, bounds, n_ticks=n_ticks)
+        return final
+
+    fn = jax.jit(jax.vmap(run_one))
+    return fn(batch)
+
+
+def replica_counters(final_batch: WorldState) -> Dict[str, np.ndarray]:
+    """Per-replica metric counters as host numpy arrays, keyed by name."""
+    m = final_batch.metrics
+    return {
+        name: np.asarray(getattr(m, name))
+        for name in (
+            "n_published",
+            "n_scheduled",
+            "n_completed",
+            "n_dropped",
+            "n_no_resource",
+            "n_connected",
+            "n_rejected",
+            "n_local",
+        )
+    }

@@ -1,0 +1,65 @@
+"""Policy × load sweep driver (the EP axis of SURVEY.md §2.3).
+
+Reproduces the shape of the BASELINE.json sweep configs ("10k nodes × 4
+schedulers × 256 load levels"): the *policy* axis is static (each policy is
+a different compiled branch — one compile per policy, reused across all
+loads), while the *load* axis is dynamic — the per-user publish interval is
+a state array (``users.send_interval``, the reference's volatile
+``sendInterval`` NED parameter), so every load level × Monte-Carlo replica
+runs inside one ``vmap`` and shards over the mesh with zero extra compiles.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import run_sharded
+from .replicas import replica_counters, replicate_state, run_replicated
+
+
+def sweep_policies(
+    build: Callable[..., tuple],
+    policies: Sequence[int],
+    load_intervals: Sequence[float],
+    n_replicas_per_load: int = 1,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    n_ticks: Optional[int] = None,
+    **build_kwargs,
+) -> Dict[int, Dict[str, np.ndarray]]:
+    """Run every (policy, load, replica) combination; return counter grids.
+
+    ``build`` is a scenario builder (e.g. ``scenarios.smoke.build``)
+    accepting ``policy=`` and returning ``(spec, state, net, bounds)``.
+    ``load_intervals`` are publish intervals in seconds (smaller = heavier).
+
+    Returns ``{policy: {counter: (n_loads, n_replicas) array}}``.
+    """
+    n_loads = len(load_intervals)
+    R = n_loads * n_replicas_per_load
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    for pol in policies:
+        spec, state, net, bounds = build(policy=int(pol), **build_kwargs)
+        batch = replicate_state(spec, state, R, seed=seed)
+        si = jnp.repeat(
+            jnp.asarray(load_intervals, jnp.float32), n_replicas_per_load
+        )  # (R,)
+        batch = batch.replace(
+            users=batch.users.replace(
+                send_interval=jnp.broadcast_to(
+                    si[:, None], (R, spec.n_users)
+                )
+            )
+        )
+        if mesh is not None:
+            final = run_sharded(spec, batch, net, bounds, mesh, n_ticks=n_ticks)
+        else:
+            final = run_replicated(spec, batch, net, bounds, n_ticks=n_ticks)
+        out[int(pol)] = {
+            k: v.reshape(n_loads, n_replicas_per_load)
+            for k, v in replica_counters(final).items()
+        }
+    return out

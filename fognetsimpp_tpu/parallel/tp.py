@@ -1,0 +1,101 @@
+"""Node-axis (tensor-parallel) sharding of the scheduler kernel.
+
+When the fog population is large enough that the broker's ``(K, F)`` score
+matrix should be split across chips, the argmin decision becomes a
+two-stage combine: each shard scores its local fog columns and reduces to a
+per-task (local-min, global-index) pair, then one ``all_gather`` across the
+``fog`` mesh axis picks the global winner.  First-wins tie-breaking (the
+``<`` scan of ``src/mqttapp/BrokerBaseApp3.cc:272-279``) is preserved
+because both the local argmin and the cross-shard pick prefer the lowest
+index.
+
+This is the SURVEY.md §2.3 TP row: state sharded over mesh axes via
+``shard_map``, with XLA collectives over ICI doing the combine — the
+communication pattern NCCL/MPI would carry in a torch framework.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 exposes shard_map at top level (check_vma kwarg)
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        return _shard_map(f, **kw)
+except ImportError:  # pragma: no cover - older jax: check_rep, not check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_old(f, **kw)
+
+FOG_AXIS = "fog"
+
+
+def sharded_min_busy(
+    mesh: Mesh,
+    mask: jax.Array,  # (K,) bool — tasks being decided (replicated)
+    mips_req: jax.Array,  # (K,) f32 (replicated)
+    view_busy: jax.Array,  # (F,) f32 — sharded over the fog axis
+    view_mips: jax.Array,  # (F,) f32 — sharded over the fog axis
+    registered: jax.Array,  # (F,) bool — sharded over the fog axis
+    divisor: Optional[jax.Array] = None,  # () f32 — brokers[0] MIPS (the
+    #   mips0_divisor bug, BrokerBaseApp3.cc:268); None = per-fog MIPS
+    axis_name: str = FOG_AXIS,
+) -> jax.Array:
+    """MIN_BUSY over a fog axis sharded across the mesh. Returns (K,) i32.
+
+    Matches :func:`fognetsimpp_tpu.ops.sched.schedule_batch` with
+    ``policy=MIN_BUSY`` exactly (a test asserts equality), including the
+    all-unavailable -> -1 guard.
+    """
+    n_shards = mesh.shape[axis_name]
+    F = view_busy.shape[0]
+    assert F % n_shards == 0, "fog count must divide the mesh axis"
+    f_local = F // n_shards
+    big = jnp.float32(3.4e38)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,  # output is replicated via all_gather; the static
+        #                   replication checker can't see through the
+        #                   argmin/take combine
+    )
+    def kernel(mask_, req_, busy_, mips_, reg_):
+        shard = jax.lax.axis_index(axis_name)
+        if divisor is None:
+            est = jnp.where(
+                mips_ > 0, req_[:, None] / jnp.maximum(mips_, 1e-30)[None, :],
+                jnp.inf,
+            )
+        else:
+            est = jnp.where(
+                divisor > 0,
+                req_[:, None] / jnp.maximum(divisor, 1e-30),
+                jnp.inf,
+            ) * jnp.ones((1, f_local), jnp.float32)
+        scores = jnp.where(reg_[None, :], busy_[None, :] + est, big)
+        scores = jnp.nan_to_num(scores, posinf=big)
+        loc_arg = jnp.argmin(scores, axis=1).astype(jnp.int32)  # (K,)
+        loc_min = jnp.min(scores, axis=1)  # (K,)
+        glob_idx = shard * f_local + loc_arg
+        any_avail = jnp.any(reg_)
+
+        mins = jax.lax.all_gather(loc_min, axis_name)  # (S, K)
+        idxs = jax.lax.all_gather(glob_idx, axis_name)  # (S, K)
+        avails = jax.lax.all_gather(any_avail, axis_name)  # (S,)
+        # lowest score wins; ties -> lowest shard (hence lowest global index)
+        win_shard = jnp.argmin(mins, axis=0)  # (K,)
+        choice = jnp.take_along_axis(idxs, win_shard[None, :], axis=0)[0]
+        choice = jnp.where(jnp.any(avails), choice, -1)
+        return jnp.where(mask_, choice, -1).astype(jnp.int32)
+
+    return kernel(mask, mips_req, view_busy, view_mips, registered)

@@ -1,14 +1,24 @@
-"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+"""Test harness config: force an 8-device virtual CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; sharding tests run on
 ``xla_force_host_platform_device_count=8`` virtual CPU devices, the pattern
 the driver's ``dryrun_multichip`` also uses.
+
+The environment may pre-import jax with the platform pinned to the tunneled
+TPU (axon sitecustomize), which makes ``JAX_PLATFORMS`` env assignments
+moot — so we set the XLA flag (read at first backend init, which has not
+happened yet at conftest time) and override the platform via
+``jax.config.update``.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

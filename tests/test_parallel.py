@@ -1,0 +1,128 @@
+"""Parallel-axis tests: vmap replicas, mesh sharding, TP kernel, sweeps.
+
+Run on the 8-device virtual CPU mesh forced by conftest.py — the same
+pattern the driver's ``dryrun_multichip`` uses for multi-chip validation
+without TPU hardware.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy
+from fognetsimpp_tpu.ops.sched import schedule_batch
+from fognetsimpp_tpu.parallel import (
+    make_mesh,
+    replicate_state,
+    replica_counters,
+    run_replicated,
+    run_sharded,
+    sharded_min_busy,
+    sweep_policies,
+)
+from fognetsimpp_tpu.scenarios import smoke
+
+HORIZON = 0.3
+
+
+@pytest.fixture(scope="module")
+def world():
+    return smoke.build(horizon=HORIZON, start_time_max=0.05)
+
+
+def test_replicas_run_and_diverge(world):
+    spec, state, net, bounds = world
+    R = 8
+    batch = replicate_state(spec, state, R, seed=7)
+    final = run_replicated(spec, batch, net, bounds)
+    counters = replica_counters(final)
+    assert counters["n_published"].shape == (R,)
+    assert (counters["n_published"] > 0).all()
+    # per-replica PRNG keys -> different task sizes between replicas
+    mips = np.asarray(final.tasks.mips_req)
+    assert not np.array_equal(mips[0], mips[1])
+    # start-time resampling -> different connect times
+    st = np.asarray(final.users.start_t)
+    assert not np.array_equal(st[0], st[1])
+
+
+def test_sharded_equals_unsharded(world):
+    spec, state, net, bounds = world
+    n_dev = len(jax.devices())
+    assert n_dev == 8, "conftest must provision 8 virtual devices"
+    batch = replicate_state(spec, state, n_dev, seed=7)
+    ref = run_replicated(spec, batch, net, bounds)
+    mesh = make_mesh(n_dev)
+    got = run_sharded(spec, batch, net, bounds, mesh)
+    # replica-axis sharding must not change any result bit
+    for name in ("t_create", "t_ack5", "t_ack6", "mips_req"):
+        a = np.asarray(getattr(ref.tasks, name))
+        b = np.asarray(getattr(got.tasks, name))
+        np.testing.assert_array_equal(a, b, err_msg=name)
+    np.testing.assert_array_equal(
+        np.asarray(ref.metrics.n_completed), np.asarray(got.metrics.n_completed)
+    )
+    # and the output really is distributed over the mesh
+    assert len(got.tasks.t_ack6.sharding.device_set) == n_dev
+
+
+def test_sharded_min_busy_matches_kernel():
+    mesh = make_mesh(8, axis_name="fog")
+    F, K = 16, 8
+    key = jax.random.PRNGKey(3)
+    k1, k2, k3 = jax.random.split(key, 3)
+    view_busy = jax.random.uniform(k1, (F,), maxval=2.0)
+    view_mips = jax.random.uniform(k2, (F,), minval=100.0, maxval=4000.0)
+    registered = jnp.ones((F,), bool).at[3].set(False)
+    mask = jnp.ones((K,), bool).at[K - 1].set(False)
+    mips_req = jax.random.uniform(k3, (K,), minval=200.0, maxval=900.0)
+
+    want, _ = schedule_batch(
+        int(Policy.MIN_BUSY), mask, mips_req, view_busy, view_mips,
+        registered, jnp.ones((F,), bool), jnp.ones((F,)),
+        jnp.zeros((F,)), jnp.zeros((), jnp.int32), key,
+        mips0_divisor=False,
+    )
+    got = sharded_min_busy(
+        mesh, mask, mips_req, view_busy, view_mips, registered, divisor=None,
+        axis_name="fog",
+    )
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    # the mips0_divisor bug path (BrokerBaseApp3.cc:268)
+    want_b, _ = schedule_batch(
+        int(Policy.MIN_BUSY), mask, mips_req, view_busy, view_mips,
+        registered, jnp.ones((F,), bool), jnp.ones((F,)),
+        jnp.zeros((F,)), jnp.zeros((), jnp.int32), key,
+        mips0_divisor=True,
+    )
+    got_b = sharded_min_busy(
+        mesh, mask, mips_req, view_busy, view_mips, registered,
+        divisor=view_mips[0], axis_name="fog",
+    )
+    np.testing.assert_array_equal(np.asarray(want_b), np.asarray(got_b))
+
+    # all-unregistered -> -1 everywhere
+    got_none = sharded_min_busy(
+        mesh, mask, mips_req, view_busy, view_mips,
+        jnp.zeros((F,), bool), divisor=None, axis_name="fog",
+    )
+    assert (np.asarray(got_none)[np.asarray(mask)] == -1).all()
+
+
+def test_sweep_policies(world):
+    spec, state, net, bounds = world
+    del spec, state  # sweep builds its own worlds
+    grids = sweep_policies(
+        smoke.build,
+        policies=[int(Policy.MIN_BUSY), int(Policy.ROUND_ROBIN)],
+        load_intervals=[0.05, 0.02],
+        n_replicas_per_load=2,
+        horizon=HORIZON,
+        start_time_max=0.05,
+    )
+    for pol, grid in grids.items():
+        assert grid["n_published"].shape == (2, 2)
+        # heavier load (shorter interval) publishes strictly more
+        assert (grid["n_published"][1] > grid["n_published"][0]).all(), pol
+        assert (grid["n_scheduled"] > 0).all()
