@@ -1,0 +1,9 @@
+"""Native C++ event-driven parity core.
+
+``desim.cpp`` is a sequential DES (binary event heap, virtual clock, the
+three v3 application state machines of the reference) standing in for
+OMNeT++'s execution model; :mod:`bridge` compiles it with g++ and exposes it
+over ctypes.  The batched JAX engine is validated against it by
+``tests/test_parity.py`` (the <=1% criterion of BASELINE.json).
+"""
+from . import bridge  # noqa: F401

@@ -1,0 +1,173 @@
+"""ctypes bridge to the native event-driven parity core (desim.cpp).
+
+Compiles ``desim.cpp`` with g++ on first use (cached in ``_build/`` keyed on
+source hash) and exposes :func:`run_v3` plus :func:`replay_engine_world`,
+which replays the exact publish workload a batched-engine run decided
+client-side (task creation times + MIPSRequired) through the sequential
+DES — the two simulators then disagree only where their *execution models*
+differ, which is what the parity gate (tests/test_parity.py) measures.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Dict, Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "desim.cpp")
+_BUILD = os.path.join(_DIR, "_build")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(force: bool = False) -> str:
+    """Compile desim.cpp -> _build/libdesim-<hash>.so; returns the path."""
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_BUILD, f"libdesim-{tag}.so")
+    if force or not os.path.exists(so):
+        os.makedirs(_BUILD, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", so, _SRC],
+            check=True,
+            capture_output=True,
+        )
+    return so
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build())
+        dp = ctypes.POINTER(ctypes.c_double)
+        ip = ctypes.POINTER(ctypes.c_int)
+        lib.desim_run_v3.restype = ctypes.c_long
+        lib.desim_run_v3.argtypes = (
+            [ctypes.c_int] * 3
+            + [ip, dp, dp]  # task_user, t_create, mips_req
+            + [dp] * 5  # d_ub, d_bf, fog_mips, register_t, adv0_t
+            + [ctypes.c_double]
+            + [ctypes.c_int] * 4
+            + [dp, ip] + [dp] * 8 + [ip]
+        )
+        _lib = lib
+    return _lib
+
+
+def run_v3(
+    task_user: np.ndarray,
+    task_t_create: np.ndarray,
+    task_mips_req: np.ndarray,
+    d_ub: np.ndarray,
+    d_bf: np.ndarray,
+    fog_mips: np.ndarray,
+    register_t: np.ndarray,
+    adv0_t: np.ndarray,
+    horizon: float,
+    mips0_divisor: bool = True,
+    zero_initial_view: bool = True,
+    adv_on_completion: bool = True,
+    queue_capacity: int = 64,
+) -> Dict[str, np.ndarray]:
+    """Run the native v3 DES over an explicit publish schedule."""
+    lib = _load()
+    n_tasks = len(task_user)
+    n_users = len(d_ub)
+    n_fogs = len(d_bf)
+
+    def d(a):
+        return np.ascontiguousarray(np.asarray(a, np.float64))
+
+    def i(a):
+        return np.ascontiguousarray(np.asarray(a, np.int32))
+
+    task_user = i(task_user)
+    ins = [d(task_t_create), d(task_mips_req), d(d_ub), d(d_bf), d(fog_mips),
+           d(register_t), d(adv0_t)]
+    outs_d = {
+        k: np.empty((n_tasks,), np.float64)
+        for k in (
+            "t_at_broker", "t_at_fog", "t_service_start", "t_complete",
+            "t_ack4_fwd", "t_ack5", "t_ack4_queued", "t_ack6", "queue_time",
+        )
+    }
+    fog = np.empty((n_tasks,), np.int32)
+    stage = np.empty((n_tasks,), np.int32)
+
+    dp = ctypes.POINTER(ctypes.c_double)
+    ip = ctypes.POINTER(ctypes.c_int)
+
+    def pd(a):
+        return a.ctypes.data_as(dp)
+
+    def pi(a):
+        return a.ctypes.data_as(ip)
+
+    n_events = lib.desim_run_v3(
+        n_users, n_fogs, n_tasks,
+        pi(task_user), pd(ins[0]), pd(ins[1]),
+        pd(ins[2]), pd(ins[3]), pd(ins[4]), pd(ins[5]), pd(ins[6]),
+        ctypes.c_double(horizon),
+        int(mips0_divisor), int(zero_initial_view), int(adv_on_completion),
+        int(queue_capacity),
+        pd(outs_d["t_at_broker"]), pi(fog), pd(outs_d["t_at_fog"]),
+        pd(outs_d["t_service_start"]), pd(outs_d["t_complete"]),
+        pd(outs_d["t_ack4_fwd"]), pd(outs_d["t_ack5"]),
+        pd(outs_d["t_ack4_queued"]), pd(outs_d["t_ack6"]),
+        pd(outs_d["queue_time"]), pi(stage),
+    )
+    out = dict(outs_d)
+    out["fog"] = fog
+    out["stage"] = stage
+    out["n_events"] = np.asarray(n_events)
+    return out
+
+
+def replay_engine_world(spec, final_state, net, horizon: Optional[float] = None):
+    """Replay a finished engine run's publish workload through the DES.
+
+    Extracts the client-side inputs the engine decided (per-task user,
+    creation time, MIPSRequired — all independent of scheduling), the static
+    delay vectors, and the fog boot schedule from the primed initial state,
+    then runs the native core over the same horizon.
+    """
+    import jax.numpy as jnp  # deferred; host-side use only
+
+    from ..net.topology import associate
+    from ..state import init_state
+    from ..core.engine import prime_initial_advertisements
+
+    tasks = final_state.tasks
+    t_create = np.asarray(tasks.t_create, np.float64)
+    used = np.isfinite(t_create)
+    cache = associate(
+        net, final_state.nodes.pos, jnp.ones_like(final_state.nodes.alive),
+        broker=spec.broker_index,
+    )
+    d2b = np.asarray(cache.d2b, np.float64)
+    fog_nodes = np.arange(spec.n_fogs) + spec.n_users
+
+    # fog boot schedule exactly as prime_initial_advertisements stamped it
+    state0 = prime_initial_advertisements(spec, init_state(spec), net)
+    register_t = np.asarray(state0.broker.register_t, np.float64)
+    adv0_t = np.asarray(state0.broker.adv_arrive_t, np.float64)
+
+    return run_v3(
+        task_user=np.asarray(tasks.user)[used],
+        task_t_create=t_create[used],
+        task_mips_req=np.asarray(tasks.mips_req, np.float64)[used],
+        d_ub=d2b[: spec.n_users],
+        d_bf=d2b[fog_nodes],
+        fog_mips=np.asarray(final_state.fogs.mips, np.float64),
+        register_t=register_t,
+        adv0_t=adv0_t,
+        horizon=spec.horizon if horizon is None else horizon,
+        mips0_divisor=spec.bug_compat.mips0_divisor,
+        zero_initial_view=spec.bug_compat.zero_initial_view_mips,
+        adv_on_completion=spec.adv_on_completion,
+        queue_capacity=spec.queue_capacity,
+    ), used

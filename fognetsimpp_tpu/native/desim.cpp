@@ -1,0 +1,268 @@
+// Native event-driven parity core: the sequential-DES baseline the batched
+// JAX engine is validated against (the "within 1% of C++ DES" gate of
+// BASELINE.json, replacing OMNeT++'s role natively — SURVEY.md §7 step 2).
+//
+// Implements the v3 hot path exactly as the reference's three application
+// state machines execute it, one event at a time on a binary heap:
+//
+//   publish arrival -> broker argmin schedule   (BrokerBaseApp3.cc:231-319)
+//   task arrival    -> fog assign / FIFO queue  (ComputeBrokerApp3.cc:269-320)
+//   release         -> complete + promote head  (ComputeBrokerApp3.cc:224-256)
+//   advert arrival  -> broker view refresh      (BrokerBaseApp3.cc:123-136)
+//
+// Faithful-parity switches mirror fognetsimpp_tpu.spec.BugCompat:
+//   * mips0_divisor: every candidate's service estimate divides by
+//     brokers[0]'s MIPS (BrokerBaseApp3.cc:268,273,275);
+//   * zero_initial_view: fogs register with MIPS=0 until their first
+//     advertisement lands (BrokerBaseApp3.cc:104), making early estimates
+//     +inf exactly like the C++ double division.
+//
+// The publish schedule (user, creation time, MIPSRequired) is an *input*:
+// the client-side behaviour (connect gating, send timers, task-size RNG) is
+// driven by the caller so both simulators decide over identical workloads.
+//
+// Build: g++ -O2 -shared -fPIC desim.cpp -o libdesim.so   (see bridge.py)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <queue>
+#include <vector>
+
+namespace {
+
+constexpr double kInf = std::numeric_limits<double>::infinity();
+
+// Stage codes matching fognetsimpp_tpu.spec.Stage.
+enum Stage : int {
+  kUnused = 0,
+  kPubInflight = 1,
+  kTaskInflight = 2,
+  kQueued = 3,
+  kRunning = 4,
+  kDone = 5,
+  kNoResource = 6,
+  kDropped = 7,
+};
+
+enum EventKind : int {
+  kEvPubArrive = 0,   // publish reaches the base broker
+  kEvTaskArrive = 1,  // FognetMsgTask reaches its fog node
+  kEvRelease = 2,     // fog's in-service task completes
+  kEvAdvArrive = 3,   // FognetMsgAdvertiseMIPS reaches the broker
+  kEvRegister = 4,    // fog's Connect reaches the broker (registration)
+};
+
+struct Event {
+  double t;
+  int64_t seq;  // FIFO tie-break: heap pops equal-time events in push order,
+                // matching OMNeT++'s insertion-ordered event list
+  int kind;
+  int a;      // task id / fog id
+  double x;   // advert payload: MIPS
+  double y;   // advert payload: busyTime
+};
+
+struct EventLater {
+  bool operator()(const Event& l, const Event& r) const {
+    if (l.t != r.t) return l.t > r.t;
+    return l.seq > r.seq;
+  }
+};
+
+struct Fog {
+  double mips = 0.0;
+  double busy_time = 0.0;  // sum of service times of queued+running tasks
+  int current = -1;        // in-service task id
+  double busy_until = kInf;
+  std::vector<int> fifo;   // requests[] vector (head = front)
+  size_t head = 0;
+};
+
+struct Task {
+  int user = 0;
+  double t_create = 0.0;
+  double mips_req = 0.0;
+  int stage = kUnused;
+  int fog = -1;
+  double t_at_broker = kInf;
+  double t_at_fog = kInf;
+  double t_service_start = kInf;
+  double t_complete = kInf;
+  double t_q_enter = kInf;
+  double t_ack4_fwd = kInf;
+  double t_ack4_queued = kInf;
+  double t_ack5 = kInf;
+  double t_ack6 = kInf;
+  double queue_time = kInf;
+  double svc = 0.0;  // service time at its fog (tskTime)
+};
+
+}  // namespace
+
+extern "C" {
+
+// Runs the v3 world to `horizon` (events past it are not processed, like a
+// sim-time-limit) and writes per-task records. Returns processed event count.
+long desim_run_v3(
+    int n_users, int n_fogs, int n_tasks,
+    const int* task_user, const double* task_t_create,
+    const double* task_mips_req,
+    const double* d_ub,       // (n_users) user<->broker one-way delay
+    const double* d_bf,       // (n_fogs) broker<->fog one-way delay
+    const double* fog_mips,   // (n_fogs)
+    const double* register_t, // (n_fogs) Connect arrival at the broker
+    const double* adv0_t,     // (n_fogs) first advertisement arrival time
+    double horizon, int mips0_divisor, int zero_initial_view,
+    int adv_on_completion, int queue_capacity,
+    // outputs (n_tasks):
+    double* o_t_at_broker, int* o_fog, double* o_t_at_fog,
+    double* o_t_service_start, double* o_t_complete, double* o_t_ack4_fwd,
+    double* o_t_ack5, double* o_t_ack4_queued, double* o_t_ack6,
+    double* o_queue_time, int* o_stage) {
+  std::vector<Fog> fogs(n_fogs);
+  std::vector<Task> tasks(n_tasks);
+  // broker's stale view (brokers[] vector, BrokerBaseApp3.h:26-63)
+  std::vector<double> view_mips(n_fogs, 0.0), view_busy(n_fogs, 0.0);
+  std::vector<char> registered(n_fogs, 0);
+
+  std::priority_queue<Event, std::vector<Event>, EventLater> heap;
+  int64_t seq = 0;
+  auto push = [&](double t, int kind, int a, double x = 0.0, double y = 0.0) {
+    heap.push(Event{t, seq++, kind, a, x, y});
+  };
+
+  for (int f = 0; f < n_fogs; ++f) {
+    fogs[f].mips = fog_mips[f];
+    if (!zero_initial_view) view_mips[f] = fog_mips[f];
+    if (std::isfinite(register_t[f])) push(register_t[f], kEvRegister, f);
+    if (std::isfinite(adv0_t[f]))
+      push(adv0_t[f], kEvAdvArrive, f, fog_mips[f], 0.0);
+  }
+  for (int i = 0; i < n_tasks; ++i) {
+    tasks[i].user = task_user[i];
+    tasks[i].t_create = task_t_create[i];
+    tasks[i].mips_req = task_mips_req[i];
+    if (std::isfinite(task_t_create[i])) {
+      tasks[i].stage = kPubInflight;
+      tasks[i].t_at_broker = task_t_create[i] + d_ub[task_user[i]];
+      push(tasks[i].t_at_broker, kEvPubArrive, i);
+    }
+  }
+
+  long n_events = 0;
+  while (!heap.empty()) {
+    Event ev = heap.top();
+    heap.pop();
+    if (ev.t > horizon) break;
+    ++n_events;
+    switch (ev.kind) {
+      case kEvRegister:
+        registered[ev.a] = 1;  // brokers.push_back (BrokerBaseApp3.cc:102-107)
+        break;
+      case kEvAdvArrive:  // latest-wins view refresh (:123-136)
+        view_mips[ev.a] = ev.x;
+        view_busy[ev.a] = ev.y;
+        break;
+      case kEvPubArrive: {
+        Task& tk = tasks[ev.a];
+        // status-4 "forwarded" ack straight back to the client (:146-150)
+        tk.t_ack4_fwd = ev.t + d_ub[tk.user];
+        // the `<` scan over brokers[] (BrokerBaseApp3.cc:267-281):
+        // first-wins tie-break, +inf estimates while view MIPS is 0
+        int best = -1;
+        double best_score = kInf;
+        bool any = false;
+        for (int f = 0; f < n_fogs; ++f) {
+          if (!registered[f]) continue;
+          double div = mips0_divisor ? view_mips[0] : view_mips[f];
+          double est = div > 0.0 ? tk.mips_req / div : kInf;
+          double score = view_busy[f] + est;
+          if (!any || score < best_score) {
+            best = f;
+            best_score = score;
+            any = true;
+          }
+        }
+        if (!any) {  // "no compute resource available" (:306-319)
+          tk.stage = kNoResource;
+          break;
+        }
+        tk.stage = kTaskInflight;
+        tk.fog = best;
+        tk.t_at_fog = ev.t + d_bf[best];
+        push(tk.t_at_fog, kEvTaskArrive, ev.a);
+        break;
+      }
+      case kEvTaskArrive: {  // ComputeBrokerApp3.cc:269-320
+        Task& tk = tasks[ev.a];
+        Fog& fg = fogs[tk.fog];
+        tk.svc = tk.mips_req / fg.mips;       // tskTime (:276)
+        fg.busy_time += tk.svc;               // busyTime += tskTime (:279)
+        if (fg.current < 0) {                 // idle: assign (:282-303)
+          fg.current = ev.a;
+          tk.stage = kRunning;
+          tk.t_service_start = ev.t;
+          fg.busy_until = ev.t + tk.svc;
+          tk.t_ack5 = ev.t + d_bf[tk.fog] + d_ub[tk.user];  // "assigned"
+          push(fg.busy_until, kEvRelease, tk.fog);
+        } else {                              // busy: FIFO (:304-314)
+          int backlog = static_cast<int>(fg.fifo.size() - fg.head);
+          if (backlog >= queue_capacity) {    // engine-side cap analog; the
+            tk.stage = kDropped;              // reference vector is unbounded
+            break;
+          }
+          fg.fifo.push_back(ev.a);
+          tk.stage = kQueued;
+          tk.t_q_enter = ev.t;
+          tk.t_ack4_queued = ev.t + d_bf[tk.fog] + d_ub[tk.user];  // "queued"
+        }
+        break;
+      }
+      case kEvRelease: {  // releaseResource (ComputeBrokerApp3.cc:224-256)
+        Fog& fg = fogs[ev.a];
+        if (fg.current < 0) break;
+        Task& done = tasks[fg.current];
+        double t_done = fg.busy_until;
+        done.stage = kDone;
+        done.t_complete = t_done;
+        done.t_ack6 = t_done + d_bf[ev.a] + d_ub[done.user];  // "performed"
+        fg.busy_time -= done.svc;  // busyTime -= requiredTime (:232)
+        fg.current = -1;
+        fg.busy_until = kInf;
+        if (fg.head < fg.fifo.size()) {  // promote FIFO head (:236-252)
+          int nxt = fg.fifo[fg.head++];
+          Task& tn = tasks[nxt];
+          fg.current = nxt;
+          tn.stage = kRunning;
+          tn.t_service_start = t_done;
+          tn.queue_time = t_done - tn.t_q_enter;  // queueTime signal (:238)
+          fg.busy_until = t_done + tn.svc;
+          push(fg.busy_until, kEvRelease, ev.a);
+        }
+        if (adv_on_completion)  // advertiseMIPS() at :254
+          push(t_done + d_bf[ev.a], kEvAdvArrive, ev.a, fg.mips, fg.busy_time);
+        break;
+      }
+    }
+  }
+
+  for (int i = 0; i < n_tasks; ++i) {
+    const Task& tk = tasks[i];
+    o_t_at_broker[i] = tk.t_at_broker;
+    o_fog[i] = tk.fog;
+    o_t_at_fog[i] = tk.t_at_fog;
+    o_t_service_start[i] = tk.t_service_start;
+    o_t_complete[i] = tk.t_complete;
+    o_t_ack4_fwd[i] = tk.t_ack4_fwd;
+    o_t_ack5[i] = tk.t_ack5;
+    o_t_ack4_queued[i] = tk.t_ack4_queued;
+    o_t_ack6[i] = tk.t_ack6;
+    o_queue_time[i] = tk.queue_time;
+    o_stage[i] = tk.stage;
+  }
+  return n_events;
+}
+
+}  // extern "C"

@@ -89,7 +89,7 @@ def _delay_to(
 
 
 def associate(
-    net: NetParams, pos: jax.Array, alive: jax.Array, broker: int = 0
+    net: NetParams, pos: jax.Array, alive: jax.Array, broker: int | None = None
 ) -> LinkCache:
     """Recompute AP association + access delays for the current positions.
 
@@ -97,7 +97,17 @@ def associate(
     association, made explicit).  Handover between APs as a node moves is
     emergent, as in the reference's wireless4/wireless5 scenarios
     (``simulations/testing/wireless4.ini``).
+
+    ``broker`` must be the base-broker node index (``spec.broker_index``) —
+    required because a wrong-but-plausible default (node 0 is always a
+    *user* under the [users | fogs | broker] layout) would silently compute
+    every protocol delay to the wrong node.
     """
+    if broker is None:
+        raise ValueError(
+            "associate() needs broker=spec.broker_index to build the "
+            "delay-to-broker cache"
+        )
     N = pos.shape[0]
     A = net.ap_nodes.shape[0]
     if A == 0:

@@ -64,6 +64,29 @@ def shard_replicas(
     return jax.tree.map(lambda x: jax.device_put(x, leaf(x)), batch)
 
 
+def shard_world(
+    batch: WorldState,
+    net: NetParams,
+    bounds: MobilityBounds,
+    mesh: Mesh,
+    axis_name: str = REPLICA_AXIS,
+):
+    """Lay a replicated world out on the mesh: the production DP sharding.
+
+    The replica axis of every world-state leaf is split over the mesh;
+    ``net``/``bounds`` (shared topology) are replicated to every device.
+    Returns ``(batch, net, bounds, out_shardings)`` — the single source of
+    truth used by both :func:`run_sharded` and the driver's
+    ``dryrun_multichip``.
+    """
+    batch = shard_replicas(batch, mesh, axis_name)
+    repl = NamedSharding(mesh, P())
+    net = jax.tree.map(lambda x: jax.device_put(x, repl), net)
+    bounds = jax.tree.map(lambda x: jax.device_put(x, repl), bounds)
+    leaf = replica_sharding(mesh, axis_name)
+    return batch, net, bounds, jax.tree.map(leaf, batch)
+
+
 def run_sharded(
     spec: WorldSpec,
     batch: WorldState,
@@ -79,16 +102,13 @@ def run_sharded(
     bit-equality — but each device owns ``R / n_devices`` replicas.  ``net``
     and ``bounds`` are replicated to every device.
     """
-    batch = shard_replicas(batch, mesh, axis_name)
-    repl = NamedSharding(mesh, P())
-    net = jax.tree.map(lambda x: jax.device_put(x, repl), net)
-    bounds = jax.tree.map(lambda x: jax.device_put(x, repl), bounds)
+    batch, net, bounds, out_shardings = shard_world(
+        batch, net, bounds, mesh, axis_name
+    )
 
     def run_one(s: WorldState) -> WorldState:
         final, _ = run(spec, s, net, bounds, n_ticks=n_ticks)
         return final
 
-    leaf = replica_sharding(mesh, axis_name)
-    out_shardings = jax.tree.map(leaf, batch)
     fn = jax.jit(jax.vmap(run_one), out_shardings=out_shardings)
     return fn(batch)

@@ -41,6 +41,11 @@ def sweep_policies(
     n_loads = len(load_intervals)
     R = n_loads * n_replicas_per_load
     out: Dict[int, Dict[str, np.ndarray]] = {}
+    # Build the world for the HEAVIEST load level so capacity-derived shapes
+    # (max_sends_per_user, arrival_window) fit every level; lighter levels
+    # just publish less.  Overriding send_interval only post-build would
+    # silently cap heavy loads at the light-load send budget.
+    build_kwargs.setdefault("send_interval", min(load_intervals))
     for pol in policies:
         spec, state, net, bounds = build(policy=int(pol), **build_kwargs)
         batch = replicate_state(spec, state, R, seed=seed)
