@@ -230,12 +230,20 @@ def _phase_spawn(
     def scat(col, val):
         return col.at[slot].set(jnp.where(due, val, 0), mode="drop")
 
+    t_arrive = t_create + d_ub
+    if spec.link_up_s > 0:
+        # ARP/association warm-up: a publish that would arrive before the
+        # link is up instead arrives at its drain slot (spec.link_up_s)
+        drained = spec.link_up_s + users.send_count.astype(
+            jnp.float32
+        ) * jnp.float32(spec.link_drain_s)
+        t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
     tasks = tasks.replace(
         stage=tasks.stage.at[slot].set(jnp.int8(int(Stage.PUB_INFLIGHT)), mode="drop"),
         topic=tasks.topic.at[slot].set(users.pub_topic, mode="drop"),
         mips_req=scat(tasks.mips_req, mips_req),
         t_create=scat(tasks.t_create, t_create),
-        t_at_broker=scat(tasks.t_at_broker, t_create + d_ub),
+        t_at_broker=scat(tasks.t_at_broker, t_arrive),
     )
     interval = users.send_interval
     if spec.send_interval_jitter > 0:

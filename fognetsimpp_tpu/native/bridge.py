@@ -31,11 +31,16 @@ def build(force: bool = False) -> str:
     so = os.path.join(_BUILD, f"libdesim-{tag}.so")
     if force or not os.path.exists(so):
         os.makedirs(_BUILD, exist_ok=True)
-        subprocess.run(
+        proc = subprocess.run(
             ["g++", "-O2", "-shared", "-fPIC", "-o", so, _SRC],
-            check=True,
             capture_output=True,
+            text=True,
         )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"desim.cpp compile failed (g++ exit {proc.returncode}):\n"
+                f"{proc.stderr}"
+            )
     return so
 
 
@@ -134,12 +139,25 @@ def replay_engine_world(spec, final_state, net, horizon: Optional[float] = None)
     creation time, MIPSRequired — all independent of scheduling), the static
     delay vectors, and the fog boot schedule from the primed initial state,
     then runs the native core over the same horizon.
+
+    Only defined for static wired worlds (the smoke shape): with wireless
+    nodes or mobility the per-task delays are time-varying and a single
+    delay vector would silently corrupt the parity baseline.
     """
     import jax.numpy as jnp  # deferred; host-side use only
 
     from ..net.topology import associate
     from ..state import init_state
     from ..core.engine import prime_initial_advertisements
+
+    if bool(np.asarray(net.is_wireless).any()):
+        raise NotImplementedError(
+            "replay_engine_world is defined for static wired worlds only"
+        )
+    if bool((np.asarray(final_state.nodes.mobility) != 0).any()):
+        raise NotImplementedError(
+            "replay_engine_world requires stationary nodes"
+        )
 
     tasks = final_state.tasks
     t_create = np.asarray(tasks.t_create, np.float64)

@@ -1,0 +1,467 @@
+"""The wireless scenario ladder: the reference's integration suite rebuilt.
+
+Each builder reproduces one rung of ``simulations/testing/*.ini`` →
+``*.ned`` (SURVEY.md §4 table) as a batched world: the NED topology
+becomes an infrastructure delay graph (routers/APs + 100 Mbps / 0.1 µs
+``channel C`` links, ``testing/wireless5.ned:37-42``), 802.11 access
+becomes the calibrated per-AP contention model
+(:mod:`fognetsimpp_tpu.net.topology`), and the ini's mobility / MIPS /
+energy blocks become per-node state arrays.
+
+Ladder (reference config → builder):
+  * ``wireless.ini`` → :func:`wireless` — 1 linear user, 2 APs, 2 fogs.
+  * ``wireless2.ini`` → :func:`wireless2` — 10+1 users, 4 APs, 3 fogs,
+    CircleMobility on selected users.
+  * ``wireless3.ini`` → :func:`wireless3` — parametric AP chain
+    (``wireless3.ned:81-85``'s NED for-loop).
+  * ``wireless4.ini`` → :func:`wireless4` — 10-AP row, linear users
+    traverse it (handover).
+  * ``wireless5.ini`` → :func:`wireless5` — the full-feature world:
+    heterogeneous fog MIPS 1000-4000, broker MIPS 0, energy
+    storage/harvesting + node shutdown/start churn.
+  * ``paper.ned`` → :func:`paper` — the publication topology (4 fogs,
+    7 APs, 13 users incl. a wired static sensor); no committed ini, so
+    v3 defaults.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.engine import prime_initial_advertisements
+from ..net.mobility import MobilityBounds
+from ..net.topology import NetParams, build_core_delay, make_net_params
+from ..spec import Mobility, WorldSpec
+from ..state import init_state
+
+# `channel C extends DatarateChannel { datarate = 100Mbps; delay = 0.1us; }`
+C_RATE = 100e6
+C_DELAY = 1e-7
+
+
+class InfraGraph:
+    """Named infrastructure points + C-channel links -> core delay matrix."""
+
+    def __init__(self) -> None:
+        self.names: Dict[str, int] = {}
+        self.links: List[Tuple[int, int, float, float]] = []
+
+    def node(self, name: str) -> int:
+        return self.names.setdefault(name, len(self.names))
+
+    def link(self, a: str, b: str, rate: float = C_RATE,
+             delay: float = C_DELAY) -> None:
+        self.links.append((self.node(a), self.node(b), rate, delay))
+
+    def core(self, packet_bytes: int) -> np.ndarray:
+        return build_core_delay(len(self.names), self.links, packet_bytes)
+
+
+def access_cost(packet_bytes: int) -> float:
+    """One C-channel hop: propagation + serialization."""
+    return C_DELAY + packet_bytes * 8.0 / C_RATE
+
+
+def _deg(a: float) -> float:
+    return a * math.pi / 180.0
+
+
+def assemble(
+    spec: WorldSpec,
+    graph: InfraGraph,
+    *,
+    seed: int = 0,
+    fog_mips: Sequence[float],
+    fog_attach: Sequence[str],
+    broker_attach: str,
+    fog_pos: Optional[Sequence[Tuple[float, float]]] = None,
+    broker_pos: Tuple[float, float] = (0.0, 0.0),
+    ap_names: Sequence[str] = (),
+    ap_pos: Sequence[Tuple[float, float]] = (),
+    ap_range: float = 250.0,
+    user_pos: Sequence[Tuple[float, float]] = (),
+    linear: Optional[Dict[int, Tuple[float, float]]] = None,  # u -> (speed, angle_rad)
+    circle: Optional[Dict[int, Tuple[float, float, float, float, float]]] = None,
+    # u -> (cx, cy, r, speed, start_angle_rad)
+    wired_users: Optional[Dict[int, str]] = None,  # u -> infra attach name
+    area: Tuple[float, float] = (600.0, 400.0),
+    w_base: float = 2e-3,
+    w_prop: float = 3.336e-9,
+    w_contention: float = 1.5e-3,
+    energy_users: bool = False,
+    initial_energy_frac: Optional[Tuple[float, float]] = None,
+):
+    """Shared scenario assembler: builds ``(spec, state, net, bounds)``.
+
+    Node layout [users | fogs | broker | aps]; routers exist only as infra
+    points.  Wired hosts (fogs, broker, APs themselves, wired users) attach
+    to their router with one C-hop access cost; wireless users associate
+    per tick.
+    """
+    U, F, A = spec.n_users, spec.n_fogs, spec.n_aps
+    assert A == len(ap_names) == len(ap_pos)
+    assert F == len(fog_mips) == len(fog_attach)
+    N = spec.n_nodes
+    cost = access_cost(spec.task_bytes)
+
+    node_attach = np.full((N,), -1, np.int32)
+    node_acc = np.zeros((N,), np.float32)
+    is_wireless = np.zeros((N,), bool)
+    is_wireless[:U] = True
+    for u, name in (wired_users or {}).items():
+        is_wireless[u] = False
+        node_attach[u] = graph.node(name)
+        node_acc[u] = cost
+    for f in range(F):
+        node_attach[U + f] = graph.node(fog_attach[f])
+        node_acc[U + f] = cost
+    node_attach[spec.broker_index] = graph.node(broker_attach)
+    node_acc[spec.broker_index] = cost
+    ap_infra = [graph.node(nm) for nm in ap_names]
+    for i in range(A):
+        node_attach[spec.ap_slice[0] + i] = ap_infra[i]
+
+    net = make_net_params(
+        n_nodes=N,
+        core_delay=graph.core(spec.task_bytes),
+        node_attach=node_attach,
+        is_wireless=is_wireless,
+        ap_nodes=list(range(spec.ap_slice[0], spec.ap_slice[0] + A)),
+        ap_attach=ap_infra,
+        ap_range=ap_range,
+        w_base=w_base,
+        w_prop=w_prop,
+        w_contention=w_contention,
+        node_acc=node_acc,
+    )
+
+    state = init_state(spec, jax.random.PRNGKey(seed))
+    mips = jnp.asarray(fog_mips, jnp.float32)
+    state = state.replace(fogs=state.fogs.replace(mips=mips, pool_avail=mips))
+
+    pos = np.zeros((N, 2), np.float32)
+    pos[:U] = np.asarray(user_pos, np.float32) if len(user_pos) else 0.0
+    if fog_pos is not None:
+        pos[U : U + F] = np.asarray(fog_pos, np.float32)
+    pos[spec.broker_index] = broker_pos
+    if A:
+        pos[spec.ap_slice[0] : spec.ap_slice[0] + A] = np.asarray(
+            ap_pos, np.float32
+        )
+
+    mob = np.zeros((N,), np.int8)
+    vel = np.zeros((N, 2), np.float32)
+    ccen = np.zeros((N, 2), np.float32)
+    crad = np.zeros((N,), np.float32)
+    comg = np.zeros((N,), np.float32)
+    cpha = np.zeros((N,), np.float32)
+    for u, (speed, ang) in (linear or {}).items():
+        mob[u] = int(Mobility.LINEAR)
+        vel[u] = (speed * math.cos(ang), speed * math.sin(ang))
+    for u, (cx, cy, r, speed, start) in (circle or {}).items():
+        mob[u] = int(Mobility.CIRCLE)
+        ccen[u] = (cx, cy)
+        crad[u] = r
+        comg[u] = speed / r
+        cpha[u] = start
+        pos[u] = (cx + r * math.cos(start), cy + r * math.sin(start))
+
+    nodes = state.nodes.replace(
+        pos=jnp.asarray(pos),
+        mobility=jnp.asarray(mob),
+        vel=jnp.asarray(vel),
+        circle_center=jnp.asarray(ccen),
+        circle_radius=jnp.asarray(crad),
+        circle_omega=jnp.asarray(comg),
+        circle_phase=jnp.asarray(cpha),
+    )
+    if energy_users:
+        has = np.zeros((N,), bool)
+        has[:U] = True
+        nodes = nodes.replace(has_energy=jnp.asarray(has))
+        if initial_energy_frac is not None:
+            lo, hi = initial_energy_frac
+            key = jax.random.PRNGKey(seed + 1)
+            frac = jax.random.uniform(key, (N,), minval=lo, maxval=hi)
+            nodes = nodes.replace(
+                energy=jnp.where(
+                    jnp.asarray(has), frac * nodes.energy_capacity,
+                    nodes.energy,
+                )
+            )
+    state = state.replace(nodes=nodes)
+    state = prime_initial_advertisements(spec, state, net)
+    bounds = MobilityBounds(
+        lo=jnp.zeros((2,), jnp.float32),
+        hi=jnp.asarray(area, jnp.float32),
+    )
+    return spec, state, net, bounds
+
+
+# ----------------------------------------------------------------------
+# the ladder
+# ----------------------------------------------------------------------
+
+def wireless(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
+             **overrides):
+    """``testing/wireless.ini`` → WirelessNetwork: 1 linear user, 2 APs.
+
+    2 fogs MIPS 1000 behind router1; APs via router to the broker
+    (``Wireless.ned:73-80``); user LinearMobility 20 mps in a 600x400 area,
+    publish every 50 ms.
+    """
+    spec = WorldSpec(
+        n_users=1, n_fogs=2, n_aps=2,
+        send_interval=0.05, horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / 0.05) + 4,
+        **overrides,
+    ).validate()
+    g = InfraGraph()
+    for a, b in [("ap2", "ap1"), ("router", "ap1"), ("router", "ap2"),
+                 ("router", "bb"), ("router1", "bb"), ("router1", "cb1"),
+                 ("router1", "cb2")]:
+        g.link(a, b)
+    return assemble(
+        spec, g, seed=seed,
+        fog_mips=(1000.0, 1000.0), fog_attach=("router1", "router1"),
+        broker_attach="router",
+        ap_names=("ap1", "ap2"), ap_pos=((123.0, 175.0), (467.0, 175.0)),
+        ap_range=250.0,
+        user_pos=((397.0, 78.0),),
+        linear={0: (20.0, 0.0)},
+        area=(600.0, 400.0),
+    )
+
+
+def wireless2(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
+              **overrides):
+    """``testing/wireless2.ini`` → WirelessNetwork2: 10+1 users, 4 APs.
+
+    user1-analog (index 10) and user2 (index 2) ride CircleMobility around
+    (300, 300) r=250 at 40 mps (``wireless2.ini:15-27``); the rest are
+    LinearMobility 20 mps.  3 fogs MIPS 1000, publish every 1 s.
+    """
+    U = 11
+    spec = WorldSpec(
+        n_users=U, n_fogs=3, n_aps=4,
+        send_interval=1.0, horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / 1.0) + 4,
+        **overrides,
+    ).validate()
+    g = InfraGraph()
+    for a, b in [("ap1", "ap2"), ("router3", "ap1"), ("router2", "ap2"),
+                 ("router2", "ap3"), ("router3", "ap4"), ("ap3", "ap4"),
+                 ("router3", "router"), ("router2", "router"),
+                 ("router", "bb"), ("router1", "bb")] + [
+            ("router1", f"cb{i}") for i in range(3)]:
+        g.link(a, b)
+    rng = np.random.default_rng(seed)
+    user_pos = rng.uniform((50, 50), (550, 350), (U, 2))
+    linear = {u: (20.0, 0.0) for u in range(U)}
+    circle = {}
+    for u, start in ((10, _deg(360)), (2, _deg(180))):
+        del linear[u]
+        circle[u] = (300.0, 300.0, 250.0, 40.0, start)
+    return assemble(
+        spec, g, seed=seed,
+        fog_mips=(1000.0,) * 3, fog_attach=("router1",) * 3,
+        broker_attach="router",
+        ap_names=("ap1", "ap2", "ap3", "ap4"),
+        ap_pos=((77.0, 151.0), (475.0, 151.0), (475.0, 408.0), (77.0, 398.0)),
+        ap_range=300.0,
+        user_pos=user_pos, linear=linear, circle=circle,
+        area=(600.0, 400.0),
+    )
+
+
+def wireless3(numb: int = 4, numb_users: int = 2, horizon: float = 10.0,
+              dt: float = 1e-3, seed: int = 0, **overrides):
+    """``testing/wireless3.ini`` → WirelessNetwork3: the parametric AP chain.
+
+    ``numb`` APs chained ap[i] <-> ap[i+1], each backhauled through
+    routerL3[i] to the broker — the NED for-loop topology
+    (``wireless3.ned:81-85``).  ``numb_users`` wireless users (user index 1
+    circles like the ini's user1 when present), 3 fogs MIPS 1000.
+    """
+    assert numb >= 2, "the AP chain needs >= 2 APs (the NED loop is 0..numb-2)"
+    spec = WorldSpec(
+        n_users=numb_users, n_fogs=3, n_aps=numb,
+        send_interval=1.0, horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / 1.0) + 4,
+        **overrides,
+    ).validate()
+    g = InfraGraph()
+    for a, b in [("router1", "bb")] + [("router1", f"cb{i}") for i in range(3)]:
+        g.link(a, b)
+    for i in range(numb - 1):  # the NED for i=0..(numb-2) loop
+        g.link(f"ap{i}", f"ap{i + 1}")
+        g.link(f"routerL3{i}", f"ap{i}")
+        g.link(f"routerL3{i}", "bb")
+    ap_pos = [(100.0 + 250.0 * i, 123.0) for i in range(numb)]
+    rng = np.random.default_rng(seed)
+    user_pos = rng.uniform((50, 50), (100 + 250 * (numb - 1), 350),
+                           (numb_users, 2))
+    linear = {u: (20.0, 0.0) for u in range(numb_users)}
+    circle = {}
+    if numb_users > 1:
+        del linear[1]
+        circle[1] = (300.0, 300.0, 250.0, 40.0, _deg(360))
+    return assemble(
+        spec, g, seed=seed,
+        fog_mips=(1000.0,) * 3, fog_attach=("router1",) * 3,
+        broker_attach="router1",
+        ap_names=[f"ap{i}" for i in range(numb)], ap_pos=ap_pos,
+        ap_range=300.0,
+        user_pos=user_pos, linear=linear, circle=circle,
+        area=(100.0 + 250.0 * numb, 400.0),
+    )
+
+
+def wireless4(numb_users: int = 2, horizon: float = 30.0, dt: float = 1e-3,
+              seed: int = 0, **overrides):
+    """``testing/wireless4.ini`` → WirelessNetwork4: the 10-AP handover row.
+
+    10 APs at y=259 spanning x=60..1074, each backhauled through its own
+    router to the broker (``wireless4.ned``); users are LinearMobility
+    20 mps along +x, so they traverse AP cells and hand over.  Publish
+    every 2 s; 3 fogs MIPS 1000.
+    """
+    ap_x = [60.0, 177.0, 298.0, 422.0, 529.0, 634.0, 742.0, 834.0, 954.0,
+            1074.0]
+    spec = WorldSpec(
+        n_users=numb_users, n_fogs=3, n_aps=10,
+        send_interval=2.0, horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / 2.0) + 4,
+        **overrides,
+    ).validate()
+    g = InfraGraph()
+    g.link("router1", "bb")
+    for i in range(3):
+        g.link("router1", f"cb{i}")
+    for i in range(10):
+        g.link(f"r{i}", f"ap{i}")
+        g.link(f"r{i}", "bb")
+    rng = np.random.default_rng(seed)
+    ys = rng.uniform(150.0, 260.0, numb_users)
+    user_pos = np.stack([np.full(numb_users, 70.0), ys], axis=-1)
+    return assemble(
+        spec, g, seed=seed,
+        fog_mips=(1000.0,) * 3, fog_attach=("router1",) * 3,
+        broker_attach="router1",
+        ap_names=[f"ap{i}" for i in range(10)],
+        ap_pos=[(x, 259.0) for x in ap_x],
+        ap_range=100.0,  # 2.5 mW cells: only the nearest row AP is in range
+        user_pos=user_pos,
+        linear={u: (20.0, 0.0) for u in range(numb_users)},
+        area=(1150.0, 400.0),
+    )
+
+
+def wireless5(numb_users: int = 10, horizon: float = 60.0, dt: float = 0.01,
+              seed: int = 0, **overrides):
+    """``testing/wireless5.ini`` → WirelessNetwork5: the full-feature world.
+
+    Heterogeneous fogs MIPS 1000/2000/3000/4000 (``wireless5.ini:116-119``),
+    broker MIPS 0 (pure scheduler, ``:110``), 5 APs with ap4 as the hub
+    (``wireless5.ned:103-126``), users 0..5 on CircleMobility (start angles
+    30..180°, ``:23-33``), the rest LinearMobility; publish every 1.5 s;
+    and the energy framework (``:150-166``): 0.05 J storage, initial charge
+    uniform(10%, 100%), 4 mW alternating harvester, shutdown at 10% /
+    restart at 50% — the reference's fault-injection mechanism.
+    """
+    overrides.setdefault("energy_enabled", True)
+    overrides.setdefault("energy_capacity_j", 0.05)
+    overrides.setdefault("harvest_power_w", 4e-3)
+    # AlternatingEpEnergyGenerator: generation/sleep ~ exponential(25 s)
+    # (wireless5.ini:165-166) -> square wave, 50 s period, 50% duty
+    overrides.setdefault("harvest_period_s", 50.0)
+    overrides.setdefault("harvest_duty", 0.5)
+    overrides.setdefault("shutdown_frac", 0.10)
+    overrides.setdefault("start_frac", 0.50)
+    spec = WorldSpec(
+        n_users=numb_users, n_fogs=4, n_aps=5,
+        send_interval=1.5, horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / 1.5) + 4,
+        **overrides,
+    ).validate()
+    g = InfraGraph()
+    for a, b in ([("router1", "bb")] +
+                 [("router1", f"cb{i}") for i in range(4)] +
+                 [("router2", "bb"), ("router11", "bb"),
+                  ("router2", "ap"), ("router11", "ap2"),
+                  ("router11", "ap1"), ("router2", "ap3"),
+                  ("ap4", "bb"), ("ap4", "ap"), ("ap4", "ap1"),
+                  ("ap4", "ap2"), ("ap4", "ap3")]):
+        g.link(a, b)
+    rng = np.random.default_rng(seed)
+    user_pos = rng.uniform((50, 50), (950, 950), (numb_users, 2))
+    linear = {u: (20.0, 0.0) for u in range(numb_users)}
+    circle = {}
+    for u in range(min(6, numb_users)):
+        del linear[u]
+        circle[u] = (300.0, 300.0, 250.0, 40.0, _deg(30.0 * (u + 1)))
+    return assemble(
+        spec, g, seed=seed,
+        fog_mips=(1000.0, 2000.0, 3000.0, 4000.0),
+        fog_attach=("router1",) * 4, broker_attach="router1",
+        ap_names=("ap", "ap1", "ap2", "ap3", "ap4"),
+        ap_pos=((133.0, 172.0), (997.0, 566.0), (997.0, 172.0),
+                (139.0, 566.0), (582.0, 330.0)),
+        ap_range=400.0,  # 3.5 mW transmit power (wireless5.ini:52)
+        user_pos=user_pos, linear=linear, circle=circle,
+        area=(1000.0, 1000.0),
+        energy_users=True, initial_energy_frac=(0.10, 1.0),
+    )
+
+
+def paper(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
+          **overrides):
+    """``testing/paper.ned`` → WirelessNetwork6: the publication topology.
+
+    4 fog nodes on separate routers, 7 APs, 17 wireless users + 1 wired
+    static sensor (``paper.ned:31-188``).  No committed ini selects it
+    (SURVEY.md §6), so v3 app defaults apply (publish every 1 s).
+    """
+    user_pos = [
+        (710.0, 268.0), (320.0, 59.0), (725.0, 74.0), (109.0, 128.0),
+        (471.0, 180.0), (109.0, 251.0), (497.0, 95.0), (816.0, 497.0),
+        (725.0, 419.0), (421.0, 419.0), (131.0, 437.0), (922.0, 290.0),
+        (870.0, 74.0), (274.0, 144.0), (344.0, 503.0), (679.0, 164.0),
+        (589.0, 31.0), (301.0, 451.0),  # last = staticSensor (wired)
+    ]
+    U = len(user_pos)
+    spec = WorldSpec(
+        n_users=U, n_fogs=4, n_aps=7,
+        send_interval=1.0, horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / 1.0) + 4,
+        **overrides,
+    ).validate()
+    g = InfraGraph()
+    for a, b in [("router1", "bb"), ("router2", "fn1a"), ("router1", "fn2a"),
+                 ("router3", "fn3a"), ("router11", "fn4a"),
+                 ("router2", "bb"), ("router11", "bb"), ("router3", "bb"),
+                 ("router2", "ap"), ("router3", "ap4"), ("router11", "ap2"),
+                 ("router11", "ap1"), ("router2", "ap3"), ("router2", "ap5"),
+                 ("router11", "ap6")]:
+        g.link(a, b)
+    # the four "mobile*" hosts move; everyone else is stationary
+    linear = {7: (20.0, 0.0), 13: (20.0, 0.0), 14: (20.0, 0.0),
+              15: (20.0, 0.0)}
+    return assemble(
+        spec, g, seed=seed,
+        fog_mips=(1000.0,) * 4,
+        fog_attach=("router2", "router1", "router3", "router11"),
+        broker_attach="router1",
+        ap_names=("ap", "ap1", "ap2", "ap3", "ap4", "ap5", "ap6"),
+        ap_pos=((363.0, 163.0), (783.0, 172.0), (909.0, 172.0),
+                (197.0, 163.0), (566.0, 163.0), (197.0, 528.0),
+                (909.0, 528.0)),
+        ap_range=300.0,
+        user_pos=user_pos, linear=linear,
+        wired_users={U - 1: "router2"},  # staticSensor: StandardHost
+        area=(1000.0, 600.0),
+    )

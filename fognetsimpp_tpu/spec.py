@@ -204,6 +204,16 @@ class WorldSpec:
     # arrivals wait a tick).  See _phase_pool_arrivals.
     pool_phases: int = 4
 
+    # --- link warm-up (INET ARP/802.11-association transient) ----------
+    # In every committed reference wireless run the first ~1 s of uplink
+    # packets buffer below the app while ARP + association resolve, then
+    # drain as a burst (example/results/General-0.vec vector 1093: first
+    # sample's delay is exactly link_up - app_start).  When link_up_s > 0,
+    # a publish whose normal arrival would precede it instead arrives at
+    # ``link_up_s + send_index * link_drain_s``.
+    link_up_s: float = 0.0  # 0 = disabled
+    link_drain_s: float = 0.02  # backlog drain spacing once the link is up
+
     # --- MQTT control plane (BrokerBaseApp3.cc:86-121, 201-218) --------
     # When True, users/fogs start unconnected: a Connect must round-trip to
     # the broker before the first publish / advertisement (mqttApp2.cc:
