@@ -1,0 +1,95 @@
+"""Launcher CLI: the ``simulations/run`` analog.
+
+The reference launches ``../src/fognetsim -n .:../src <ini>``
+(``simulations/run:1-4``); here::
+
+    python -m fognetsimpp_tpu --config run.ini
+    python -m fognetsimpp_tpu --scenario wireless5 --set spec.horizon=30 \
+        --out results/
+
+builds the world from the config tier (:mod:`fognetsimpp_tpu.config.ini`),
+runs the jitted scan, persists ``.sca.json``/``.vec.npz`` results
+(:mod:`fognetsimpp_tpu.runtime.recorder`), and prints a one-line JSON
+summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fognetsimpp_tpu",
+        description="TPU-native fog-computing simulator (FogNetSim++ capability set)",
+    )
+    ap.add_argument("--config", "-c", help="ini-style config file")
+    ap.add_argument("--scenario", "-s", help="scenario builder name")
+    ap.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="config override (e.g. spec.horizon=2.0, fog.0.mips=4000); "
+        "repeatable; takes precedence over --config",
+    )
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--out", "-o", default=None, help="results directory")
+    ap.add_argument("--run-id", default=None,
+                    help="defaults to config output.run_id, else General-0")
+    ap.add_argument("--ticks", action="store_true",
+                    help="record per-tick series vectors")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (cpu/tpu)")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from .config.ini import Config, build_from_config
+    from .core.engine import run
+    from .runtime.recorder import record_run
+    from .runtime.signals import summarize
+
+    text = ""
+    if args.config:
+        with open(args.config) as f:
+            text = f.read()
+    pre = []
+    if args.scenario:
+        pre.append(f"scenario = {args.scenario}")
+    pre.extend(o.replace("=", " = ", 1) for o in args.set)
+    if args.ticks:
+        pre.append("spec.record_tick_series = true")
+    cfg = Config.from_str("\n".join(pre) + "\n" + text)
+
+    spec, state, net, bounds = build_from_config(cfg, seed=args.seed)
+    t0 = time.perf_counter()
+    final, series = run(spec, state, net, bounds)
+    import jax
+
+    jax.block_until_ready(final)
+    wall = time.perf_counter() - t0
+
+    out = {"scenario": cfg.lookup("scenario", "smoke"), "wall_s": round(wall, 3)}
+    outdir = args.out or cfg.lookup("output.dir")
+    if outdir:
+        run_id = args.run_id or cfg.lookup("output.run_id", "General-0")
+        paths = record_run(
+            outdir, spec, final, series=series, run_id=run_id,
+            attrs={"argv": sys.argv[1:]},
+        )
+        out.update(paths)
+    s = summarize(final)
+    out.update(
+        n_published=s["n_published"], n_completed=s["n_completed"],
+        task_time_mean_ms=round(s["task_time_mean_ms"], 3)
+        if s["task_time_mean_ms"] == s["task_time_mean_ms"] else None,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

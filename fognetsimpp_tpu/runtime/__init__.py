@@ -1,2 +1,4 @@
 """Runtime services: signal extraction, result recording, checkpointing."""
 from .signals import extract_signals, summarize  # noqa: F401
+from .recorder import load_scalars, load_vectors, record_run  # noqa: F401
+from . import checkpoint  # noqa: F401

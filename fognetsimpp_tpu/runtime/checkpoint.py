@@ -1,0 +1,46 @@
+"""Checkpoint/resume: snapshot the whole world, restore it, keep running.
+
+Absent from the reference (no snapshot keys in any ini — SURVEY.md §5);
+nearly free here because the entire world is one pytree of fixed-shape
+arrays whose *structure* is a pure function of the spec: save = spec JSON
++ flattened leaves; load = rebuild the skeleton from the spec and pour the
+leaves back in.  A resumed run continues bit-identically (the PRNG key is
+part of the state).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from ..spec import BugCompat, WorldSpec
+from ..state import WorldState, init_state
+from .recorder import spec_to_dict
+
+
+def save(path: str, spec: WorldSpec, state: WorldState) -> None:
+    """Write ``<path>`` (npz): spec JSON + the state pytree's leaves."""
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["spec_json"] = np.frombuffer(
+        json.dumps(spec_to_dict(spec)).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(path, **arrays)
+
+
+def load(path: str) -> Tuple[WorldSpec, WorldState]:
+    """Rebuild (spec, state) from a :func:`save` file."""
+    with np.load(path) as z:
+        spec_d = json.loads(bytes(z["spec_json"]).decode())
+        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+    spec_d["bug_compat"] = BugCompat(**spec_d["bug_compat"])
+    spec = WorldSpec(**spec_d).validate()
+    skeleton = init_state(spec)
+    treedef = jax.tree.structure(skeleton)
+    state = jax.tree.unflatten(
+        treedef, [jax.numpy.asarray(x) for x in leaves]
+    )
+    return spec, state

@@ -1,0 +1,76 @@
+"""Results recorder: the ``.sca`` / ``.vec`` output layer.
+
+The reference's L5 (SURVEY.md §1): OMNeT++ records ``@statistic`` signals
+into ``results/General-0.sca`` (scalars at finish) and ``.vec`` (sample
+vectors), which ``.anf`` descriptors then analyse.  Here a finished run is
+persisted as
+
+  * ``<run>.sca.json`` — run attributes (scenario, seed, spec) + every
+    scalar :func:`~fognetsimpp_tpu.runtime.signals.summarize` produces
+    (counts, per-signal mean/max) — human- and tool-readable;
+  * ``<run>.vec.npz`` — the per-task signal vectors
+    (:func:`extract_signals`: latency, latencyH1, taskTime, queueTime,
+    delay) plus any per-tick series from ``spec.record_tick_series``.
+
+Unlike the reference's signal-handle scalars (``recordScalar(name,
+signal)`` records an int handle — SURVEY.md App. B item 6), the scalars
+here are real statistics.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..spec import WorldSpec
+from ..state import WorldState
+from .signals import extract_signals, summarize
+
+
+def spec_to_dict(spec: WorldSpec) -> Dict:
+    return dataclasses.asdict(spec)  # recurses into BugCompat
+
+
+def record_run(
+    outdir: str,
+    spec: WorldSpec,
+    final: WorldState,
+    series: Optional[Dict] = None,
+    run_id: str = "General-0",
+    attrs: Optional[Dict] = None,
+) -> Dict[str, str]:
+    """Persist one finished run. Returns {'sca': path, 'vec': path}."""
+    os.makedirs(outdir, exist_ok=True)
+    sca_path = os.path.join(outdir, f"{run_id}.sca.json")
+    vec_path = os.path.join(outdir, f"{run_id}.vec.npz")
+
+    sca = {
+        "run": run_id,
+        "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "attrs": attrs or {},
+        "spec": spec_to_dict(spec),
+        "scalars": summarize(final),
+    }
+    with open(sca_path, "w") as f:
+        json.dump(sca, f, indent=1, default=str)
+
+    vectors = dict(extract_signals(final))
+    if series is not None:
+        for k, v in series.items():
+            vectors[f"tick.{k}"] = np.asarray(v)
+    np.savez_compressed(vec_path, **vectors)
+    return {"sca": sca_path, "vec": vec_path}
+
+
+def load_scalars(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_vectors(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}

@@ -17,7 +17,8 @@ app and then drain as a burst (first sample's delay is exactly
 ``link_up - app_start`` = 0.9814), settling to a *constant* 0.4015 s
 steady-state transit.  The parameters below reproduce both: ``link_up_s``/
 ``link_drain_s`` model the warm-up (``WorldSpec`` link warm-up block) and
-``w_base`` carries the steady transit.  tests/test_example.py pins the
+``w_base`` carries the steady transit.
+``tests/test_scenarios.py::test_example_matches_committed_trace`` pins the
 resulting mean/min/max/n to the committed trace.
 """
 from __future__ import annotations

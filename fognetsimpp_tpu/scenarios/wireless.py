@@ -20,8 +20,8 @@ Ladder (reference config → builder):
     heterogeneous fog MIPS 1000-4000, broker MIPS 0, energy
     storage/harvesting + node shutdown/start churn.
   * ``paper.ned`` → :func:`paper` — the publication topology (4 fogs,
-    7 APs, 13 users incl. a wired static sensor); no committed ini, so
-    v3 defaults.
+    7 APs, 18 users — 17 wireless hosts incl. the mobiles/laptop plus one
+    wired static sensor); no committed ini, so v3 defaults.
 """
 from __future__ import annotations
 
@@ -214,10 +214,11 @@ def wireless(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
     (``Wireless.ned:73-80``); user LinearMobility 20 mps in a 600x400 area,
     publish every 50 ms.
     """
+    overrides.setdefault("send_interval", 0.05)
     spec = WorldSpec(
         n_users=1, n_fogs=2, n_aps=2,
-        send_interval=0.05, horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / 0.05) + 4,
+        horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
         **overrides,
     ).validate()
     g = InfraGraph()
@@ -246,10 +247,11 @@ def wireless2(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
     LinearMobility 20 mps.  3 fogs MIPS 1000, publish every 1 s.
     """
     U = 11
+    overrides.setdefault("send_interval", 1.0)
     spec = WorldSpec(
         n_users=U, n_fogs=3, n_aps=4,
-        send_interval=1.0, horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / 1.0) + 4,
+        horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
         **overrides,
     ).validate()
     g = InfraGraph()
@@ -288,10 +290,11 @@ def wireless3(numb: int = 4, numb_users: int = 2, horizon: float = 10.0,
     circles like the ini's user1 when present), 3 fogs MIPS 1000.
     """
     assert numb >= 2, "the AP chain needs >= 2 APs (the NED loop is 0..numb-2)"
+    overrides.setdefault("send_interval", 1.0)
     spec = WorldSpec(
         n_users=numb_users, n_fogs=3, n_aps=numb,
-        send_interval=1.0, horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / 1.0) + 4,
+        horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
         **overrides,
     ).validate()
     g = InfraGraph()
@@ -332,10 +335,11 @@ def wireless4(numb_users: int = 2, horizon: float = 30.0, dt: float = 1e-3,
     """
     ap_x = [60.0, 177.0, 298.0, 422.0, 529.0, 634.0, 742.0, 834.0, 954.0,
             1074.0]
+    overrides.setdefault("send_interval", 2.0)
     spec = WorldSpec(
         n_users=numb_users, n_fogs=3, n_aps=10,
-        send_interval=2.0, horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / 2.0) + 4,
+        horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
         **overrides,
     ).validate()
     g = InfraGraph()
@@ -382,10 +386,11 @@ def wireless5(numb_users: int = 10, horizon: float = 60.0, dt: float = 0.01,
     overrides.setdefault("harvest_duty", 0.5)
     overrides.setdefault("shutdown_frac", 0.10)
     overrides.setdefault("start_frac", 0.50)
+    overrides.setdefault("send_interval", 1.5)
     spec = WorldSpec(
         n_users=numb_users, n_fogs=4, n_aps=5,
-        send_interval=1.5, horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / 1.5) + 4,
+        horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
         **overrides,
     ).validate()
     g = InfraGraph()
@@ -434,10 +439,11 @@ def paper(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
         (589.0, 31.0), (301.0, 451.0),  # last = staticSensor (wired)
     ]
     U = len(user_pos)
+    overrides.setdefault("send_interval", 1.0)
     spec = WorldSpec(
         n_users=U, n_fogs=4, n_aps=7,
-        send_interval=1.0, horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / 1.0) + 4,
+        horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
         **overrides,
     ).validate()
     g = InfraGraph()

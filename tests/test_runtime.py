@@ -1,0 +1,123 @@
+"""Config tier, recorder, checkpoint/resume, CLI."""
+import json
+
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import run
+from fognetsimpp_tpu.__main__ import main as cli_main
+from fognetsimpp_tpu.config import Config, build_from_config, parse_value
+from fognetsimpp_tpu.runtime import checkpoint, load_scalars, load_vectors, record_run
+from fognetsimpp_tpu.scenarios import smoke
+
+
+def test_parse_values():
+    assert parse_value("50ms") == pytest.approx(0.05)
+    assert parse_value("2s") == 2.0
+    assert parse_value("100Mbps") == 100e6
+    assert parse_value("true") is True
+    assert parse_value("3") == 3 and isinstance(parse_value("3"), int)
+    assert parse_value("1.5") == 1.5
+    assert parse_value('"mqttApp2"') == "mqttApp2"
+
+
+def test_wildcard_first_match_wins():
+    cfg = Config.from_str(
+        """
+        [General]
+        fog.2.mips = 4000      # specific first, like omnetpp.ini
+        fog.*.mips = 1000
+        **.send_interval = 2s
+        """
+    )
+    assert cfg.lookup("fog.2.mips") == 4000
+    assert cfg.lookup("fog.0.mips") == 1000
+    assert cfg.lookup("user.7.send_interval") == 2.0
+    assert cfg.lookup("nothing.here") is None
+
+
+def test_build_from_config():
+    cfg = Config.from_str(
+        """
+        scenario = smoke
+        scenario.horizon = 0.4
+        scenario.n_fogs = 3
+        spec.queue_capacity = 16
+        fog.1.mips = 4000
+        user.*.send_interval = 0.02
+        """
+    )
+    spec, state, net, bounds = build_from_config(cfg)
+    assert spec.horizon == pytest.approx(0.4)
+    assert spec.n_fogs == 3
+    assert spec.queue_capacity == 16
+    mips = np.asarray(state.fogs.mips)
+    assert mips[1] == 4000.0
+    # re-primed advertisement carries the overridden MIPS
+    assert np.asarray(state.broker.adv_val_mips)[1] == 4000.0
+    assert (np.asarray(state.users.send_interval) == np.float32(0.02)).all()
+
+    with pytest.raises(ValueError):
+        build_from_config(Config.from_str("scenario = nope"))
+    with pytest.raises(ValueError):
+        build_from_config(
+            Config.from_str("scenario = smoke\nspec.not_a_field = 1")
+        )
+
+
+@pytest.fixture(scope="module")
+def tiny_run():
+    spec, state, net, bounds = smoke.build(horizon=0.3)
+    final, _ = run(spec, state, net, bounds)
+    return spec, state, net, bounds, final
+
+
+def test_recorder_roundtrip(tiny_run, tmp_path):
+    spec, _, _, _, final = tiny_run
+    paths = record_run(str(tmp_path), spec, final, run_id="r0")
+    sca = load_scalars(paths["sca"])
+    assert sca["scalars"]["n_published"] > 0
+    assert sca["spec"]["n_users"] == spec.n_users
+    vec = load_vectors(paths["vec"])
+    assert "latency_h1" in vec and vec["latency_h1"].size > 0
+    assert "delay" in vec
+
+
+def test_checkpoint_resume_bit_identical(tiny_run, tmp_path):
+    spec, state, net, bounds, _ = tiny_run
+    half = spec.n_ticks // 2
+    # straight run
+    full, _ = run(spec, state, net, bounds)
+    # run half, checkpoint, reload, run the rest
+    mid, _ = run(spec, state, net, bounds, n_ticks=half)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, spec, mid)
+    spec2, mid2 = checkpoint.load(path)
+    assert spec2 == spec
+    resumed, _ = run(spec2, mid2, net, bounds, n_ticks=spec.n_ticks - half)
+    for name in ("t_create", "t_ack6", "mips_req", "stage"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full.tasks, name)),
+            np.asarray(getattr(resumed.tasks, name)),
+            err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(full.metrics.n_completed),
+        np.asarray(resumed.metrics.n_completed),
+    )
+
+
+def test_cli(tmp_path, capsys):
+    rc = cli_main(
+        [
+            "--scenario", "smoke",
+            "--set", "spec.horizon=0.3",
+            "--out", str(tmp_path),
+            "--run-id", "cli-0",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["n_published"] > 0
+    assert (tmp_path / "cli-0.sca.json").exists()
+    assert (tmp_path / "cli-0.vec.npz").exists()
