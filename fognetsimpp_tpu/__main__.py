@@ -59,7 +59,10 @@ def main(argv=None) -> int:
     pre = []
     if args.scenario:
         pre.append(f"scenario = {args.scenario}")
-    pre.extend(o.replace("=", " = ", 1) for o in args.set)
+    for o in args.set:
+        if "=" not in o:
+            ap.error(f"--set needs KEY=VALUE, got {o!r}")
+        pre.append(o.replace("=", " = ", 1))
     if args.ticks:
         pre.append("spec.record_tick_series = true")
     cfg = Config.from_str("\n".join(pre) + "\n" + text)

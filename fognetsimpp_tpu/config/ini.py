@@ -122,6 +122,7 @@ def build_from_config(cfg: Config, seed: Optional[int] = None):
     name = cfg.lookup("scenario", "smoke")
     builders = {
         "smoke": scenarios.smoke.build,
+        "wired_v1": scenarios.wired_v1.build,
         "example": scenarios.example.build,
         "wireless": scenarios.wireless.wireless,
         "wireless2": scenarios.wireless.wireless2,
@@ -152,10 +153,19 @@ def build_from_config(cfg: Config, seed: Optional[int] = None):
         msg = str(e)
         if "multiple values" in msg:
             # a spec.* override collided with a field the builder owns
-            # (structural fields like n_users, or horizon/dt)
+            import inspect
+
+            m = re.search(r"argument '(\w+)'", msg)
+            field = m.group(1) if m else "?"
+            sig = set(inspect.signature(builders[name]).parameters)
+            hint = (
+                f"set it via a scenario.{field} key instead"
+                if field in sig
+                else "this field is derived by the builder and is not "
+                "overridable for this scenario"
+            )
             raise ValueError(
-                f"{msg}: scenario {name!r} owns this field — set it via a "
-                f"scenario.<kwarg> key instead of spec.<field>"
+                f"scenario {name!r} owns WorldSpec field {field!r}: {hint}"
             ) from e
         raise
 
@@ -185,6 +195,17 @@ def build_from_config(cfg: Config, seed: Optional[int] = None):
             si[i] = float(v)
             changed = True
     if changed:
+        # the send budget (max_sends_per_user) was sized from the builder's
+        # interval; a faster per-user rate would silently truncate there
+        if si.min() > 0 and spec.horizon / si.min() + 1 > spec.max_sends_per_user:
+            raise ValueError(
+                f"user send_interval override {si.min():g}s exceeds the "
+                f"world's send budget (max_sends_per_user="
+                f"{spec.max_sends_per_user}); also set "
+                f"spec.send_interval = {si.min():g} (or a smaller "
+                "scenario horizon) so capacity is sized for the fastest "
+                "publisher"
+            )
         state = state.replace(
             users=state.users.replace(send_interval=jnp.asarray(si))
         )

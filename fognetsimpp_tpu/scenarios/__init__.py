@@ -3,4 +3,4 @@
 Each module builds (spec, state, net, bounds) for one of the reference's
 scenarios (SURVEY.md §4 table); `smoke` is the wired integration shape.
 """
-from . import example, smoke, wireless  # noqa: F401
+from . import example, smoke, wired_v1, wireless  # noqa: F401

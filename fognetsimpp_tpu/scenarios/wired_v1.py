@@ -1,0 +1,89 @@
+"""``testing/omnetpp.ini`` → Network: the wired v1 pub/sub smoke world.
+
+The reference's first ladder rung (SURVEY.md §4): two standard users, two
+fog nodes and the base broker all hanging off one router over identical
+100 Mbps links (``network.ned:27-69``), running the generation-1 apps:
+
+  * ``standardUser`` publishes fixed-size tasks (``MIPSRequired = 100``,
+    ``mqttApp.cc:330``) on "test topic 1"; ``standardUser1`` publishes
+    nothing and subscribes to topics 1 and 2 (``omnetpp.ini:18-21``).
+  * ``BrokerBaseApp`` (v1) runs a task locally when its MIPS pool covers
+    it (strict <, ``BrokerBaseApp.cc:171-180``) and otherwise offloads via
+    the buggy compare-to-first MAX_MIPS scan (``:228-240``) —
+    ``Policy.LOCAL_FIRST`` with ``broker_mips = 1000``.
+  * ``ComputeBrokerApp`` (v1) fogs are MIPS pools (subtract on accept,
+    reject when exhausted, ``ComputeBrokerApp.cc:285-320``).
+
+v1 quirk ledger honoured here: ``app_gen=1`` records no status-6 ack for
+offloaded tasks (the v1 broker logs and drops the fog's TaskAck,
+``BrokerBaseApp.cc:142-147``), while broker-local completions do ack the
+client directly (``:369-394``).  The reference even reads its *publish*
+topics from the ``subscribeToTopics`` parameter (``mqttApp.cc:54`` — a
+faithful-parity quirk we do not replicate; topics here are explicit).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.engine import prime_initial_advertisements
+from ..net.mobility import default_bounds
+from ..net.topology import wired_star
+from ..spec import BugCompat, FogModel, Policy, WorldSpec
+from ..state import init_state
+
+
+def build(
+    horizon: float = 5.0,
+    dt: float = 1e-3,
+    send_interval: float = 0.05,
+    broker_mips: float = 1000.0,
+    fog_mips: float = 1000.0,
+    seed: int = 0,
+    max_sends_per_user: Optional[int] = None,
+    **overrides,
+):
+    """Returns (spec, state, net, bounds) for the wired v1 world."""
+    overrides.setdefault("app_gen", 1)
+    overrides.setdefault("policy", int(Policy.LOCAL_FIRST))
+    overrides.setdefault("fog_model", int(FogModel.POOL))
+    overrides.setdefault("fixed_mips_required", 100)  # mqttApp.cc:330
+    overrides.setdefault("adv_periodic", True)  # v1 re-advertises on a timer
+    overrides.setdefault("adv_on_completion", False)
+    overrides.setdefault("n_topics", 2)
+    # Faithful v1: the broker's local pool is never refunded (the request
+    # record push is commented out, BrokerBaseApp.cc:208), so the pool
+    # drains over the first ~broker_mips/100 tasks and everything after
+    # goes down the offload path — both branches get exercised.
+    overrides.setdefault("bug_compat", BugCompat(local_pool_leak=True))
+    if max_sends_per_user is None:
+        max_sends_per_user = int(horizon / send_interval) + 4
+    spec = WorldSpec(
+        n_users=2,
+        n_fogs=2,
+        send_interval=send_interval,
+        horizon=horizon,
+        dt=dt,
+        broker_mips=broker_mips,
+        max_sends_per_user=max_sends_per_user,
+        **overrides,
+    ).validate()
+
+    state = init_state(spec, jax.random.PRNGKey(seed))
+    mips = jnp.full((2,), fog_mips, jnp.float32)
+    state = state.replace(fogs=state.fogs.replace(mips=mips, pool_avail=mips))
+    # pub/sub split (omnetpp.ini:18-21): user 0 publishes topic 0; user 1
+    # is subscribe-only on topics 0 and 1
+    users = state.users.replace(
+        publisher=jnp.asarray([True, False]),
+        pub_topic=jnp.asarray([0, 0], jnp.int32),
+        sub_mask=jnp.asarray([[False, False], [True, True]]),
+    )
+    state = state.replace(users=users)
+
+    net = wired_star(spec.n_nodes, link_delay=1e-7, rate=100e6,
+                     packet_bytes=spec.task_bytes)
+    state = prime_initial_advertisements(spec, state, net)
+    return spec, state, net, default_bounds(1000.0)

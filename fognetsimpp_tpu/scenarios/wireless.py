@@ -206,6 +206,22 @@ def assemble(
 # the ladder
 # ----------------------------------------------------------------------
 
+def _sized(overrides: dict, horizon: float, default_interval: float) -> dict:
+    """Apply the ladder's send-capacity sizing idiom in one place.
+
+    Routes ``send_interval`` through ``overrides`` (so config-tier
+    overrides don't collide with a builder-owned kwarg) and sizes
+    ``max_sends_per_user`` from the *effective* interval, so faster
+    overridden rates never truncate at the default-rate send budget.
+    """
+    overrides.setdefault("send_interval", default_interval)
+    overrides.setdefault(
+        "max_sends_per_user",
+        int(horizon / overrides["send_interval"]) + 4,
+    )
+    return overrides
+
+
 def wireless(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
              **overrides):
     """``testing/wireless.ini`` → WirelessNetwork: 1 linear user, 2 APs.
@@ -214,12 +230,9 @@ def wireless(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
     (``Wireless.ned:73-80``); user LinearMobility 20 mps in a 600x400 area,
     publish every 50 ms.
     """
-    overrides.setdefault("send_interval", 0.05)
     spec = WorldSpec(
-        n_users=1, n_fogs=2, n_aps=2,
-        horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
-        **overrides,
+        n_users=1, n_fogs=2, n_aps=2, horizon=horizon, dt=dt,
+        **_sized(overrides, horizon, 0.05),
     ).validate()
     g = InfraGraph()
     for a, b in [("ap2", "ap1"), ("router", "ap1"), ("router", "ap2"),
@@ -247,12 +260,9 @@ def wireless2(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
     LinearMobility 20 mps.  3 fogs MIPS 1000, publish every 1 s.
     """
     U = 11
-    overrides.setdefault("send_interval", 1.0)
     spec = WorldSpec(
-        n_users=U, n_fogs=3, n_aps=4,
-        horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
-        **overrides,
+        n_users=U, n_fogs=3, n_aps=4, horizon=horizon, dt=dt,
+        **_sized(overrides, horizon, 1.0),
     ).validate()
     g = InfraGraph()
     for a, b in [("ap1", "ap2"), ("router3", "ap1"), ("router2", "ap2"),
@@ -290,12 +300,9 @@ def wireless3(numb: int = 4, numb_users: int = 2, horizon: float = 10.0,
     circles like the ini's user1 when present), 3 fogs MIPS 1000.
     """
     assert numb >= 2, "the AP chain needs >= 2 APs (the NED loop is 0..numb-2)"
-    overrides.setdefault("send_interval", 1.0)
     spec = WorldSpec(
-        n_users=numb_users, n_fogs=3, n_aps=numb,
-        horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
-        **overrides,
+        n_users=numb_users, n_fogs=3, n_aps=numb, horizon=horizon, dt=dt,
+        **_sized(overrides, horizon, 1.0),
     ).validate()
     g = InfraGraph()
     for a, b in [("router1", "bb")] + [("router1", f"cb{i}") for i in range(3)]:
@@ -335,12 +342,9 @@ def wireless4(numb_users: int = 2, horizon: float = 30.0, dt: float = 1e-3,
     """
     ap_x = [60.0, 177.0, 298.0, 422.0, 529.0, 634.0, 742.0, 834.0, 954.0,
             1074.0]
-    overrides.setdefault("send_interval", 2.0)
     spec = WorldSpec(
-        n_users=numb_users, n_fogs=3, n_aps=10,
-        horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
-        **overrides,
+        n_users=numb_users, n_fogs=3, n_aps=10, horizon=horizon, dt=dt,
+        **_sized(overrides, horizon, 2.0),
     ).validate()
     g = InfraGraph()
     g.link("router1", "bb")
@@ -386,12 +390,9 @@ def wireless5(numb_users: int = 10, horizon: float = 60.0, dt: float = 0.01,
     overrides.setdefault("harvest_duty", 0.5)
     overrides.setdefault("shutdown_frac", 0.10)
     overrides.setdefault("start_frac", 0.50)
-    overrides.setdefault("send_interval", 1.5)
     spec = WorldSpec(
-        n_users=numb_users, n_fogs=4, n_aps=5,
-        horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
-        **overrides,
+        n_users=numb_users, n_fogs=4, n_aps=5, horizon=horizon, dt=dt,
+        **_sized(overrides, horizon, 1.5),
     ).validate()
     g = InfraGraph()
     for a, b in ([("router1", "bb")] +
@@ -439,12 +440,9 @@ def paper(horizon: float = 10.0, dt: float = 1e-3, seed: int = 0,
         (589.0, 31.0), (301.0, 451.0),  # last = staticSensor (wired)
     ]
     U = len(user_pos)
-    overrides.setdefault("send_interval", 1.0)
     spec = WorldSpec(
-        n_users=U, n_fogs=4, n_aps=7,
-        horizon=horizon, dt=dt,
-        max_sends_per_user=int(horizon / overrides["send_interval"]) + 4,
-        **overrides,
+        n_users=U, n_fogs=4, n_aps=7, horizon=horizon, dt=dt,
+        **_sized(overrides, horizon, 1.0),
     ).validate()
     g = InfraGraph()
     for a, b in [("router1", "bb"), ("router2", "fn1a"), ("router1", "fn2a"),

@@ -43,6 +43,7 @@ def test_build_from_config():
         scenario.horizon = 0.4
         scenario.n_fogs = 3
         spec.queue_capacity = 16
+        spec.send_interval = 0.02   # size capacity for the fastest user
         fog.1.mips = 4000
         user.*.send_interval = 0.02
         """
@@ -62,6 +63,19 @@ def test_build_from_config():
     with pytest.raises(ValueError):
         build_from_config(
             Config.from_str("scenario = smoke\nspec.not_a_field = 1")
+        )
+    # a faster per-user rate than the send budget must error, not truncate
+    with pytest.raises(ValueError, match="send budget"):
+        build_from_config(
+            Config.from_str(
+                "scenario = smoke\nscenario.horizon = 0.4\n"
+                "user.*.send_interval = 0.005"
+            )
+        )
+    # builder-owned structural fields give a clear error
+    with pytest.raises(ValueError, match="owns WorldSpec field"):
+        build_from_config(
+            Config.from_str("scenario = wireless\nspec.n_users = 5")
         )
 
 
