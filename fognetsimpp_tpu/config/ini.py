@@ -195,9 +195,13 @@ def build_from_config(cfg: Config, seed: Optional[int] = None):
             si[i] = float(v)
             changed = True
     if changed:
+        if si.min() <= 0:
+            raise ValueError(
+                f"user send_interval override must be > 0, got {si.min():g}"
+            )
         # the send budget (max_sends_per_user) was sized from the builder's
         # interval; a faster per-user rate would silently truncate there
-        if si.min() > 0 and spec.horizon / si.min() + 1 > spec.max_sends_per_user:
+        if spec.horizon / si.min() + 1 > spec.max_sends_per_user:
             raise ValueError(
                 f"user send_interval override {si.min():g}s exceeds the "
                 f"world's send budget (max_sends_per_user="

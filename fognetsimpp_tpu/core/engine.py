@@ -187,7 +187,11 @@ def _phase_adverts(state: WorldState, t1: jax.Array) -> WorldState:
         view_busy=jnp.where(arrived, b.adv_val_busy, b.view_busy),
         adv_arrive_t=jnp.where(arrived, jnp.inf, b.adv_arrive_t),
     )
-    return state.replace(broker=broker)
+    metrics = state.metrics.replace(
+        n_adverts=state.metrics.n_adverts
+        + jnp.sum(arrived.astype(jnp.int32))
+    )
+    return state.replace(broker=broker, metrics=metrics)
 
 
 def _phase_spawn(

@@ -161,6 +161,7 @@ def message_counts(spec: WorldSpec, final: WorldState) -> Dict[str, int]:
         "MqttMsgSuback": n_sub,
         "MqttMsgPublish": int(np.asarray(final.metrics.n_published)),
         "MqttMsgPuback": pubacks,
+        "FognetMsgAdvertiseMIPS": int(np.asarray(final.metrics.n_adverts)),
         "FognetMsgTask": int(np.asarray(final.metrics.n_scheduled)),
         "FognetMsgTaskAck": int(np.asarray(final.metrics.n_rejected)),
         "MqttMsgPingRequest": 0,

@@ -10,7 +10,9 @@ time), composed of
 
 where ``core`` is a small all-pairs base-delay matrix over *infrastructure
 attach points* (wired hosts, APs, routers — shortest path over link
-propagation + serialization + mean queueing, Floyd–Warshall at build time),
+propagation + serialization, Floyd–Warshall at build time; wired-link
+queueing is deliberately not modeled, as no reference scenario drives its
+100 Mbps eth links anywhere near saturation),
 ``attach`` maps a node to its attach point (itself if wired, its associated
 AP if wireless — association is argmin distance within range, recomputed
 every tick so handover is emergent, mirroring INET's 802.11 mgmt), and

@@ -68,7 +68,12 @@ def plan_arrivals(
     f_key = jnp.where(mask, fog, n_fogs).astype(jnp.int32)
     t_key = jnp.where(mask, t_arrive, jnp.inf)
 
-    if K <= _PAIRWISE_MAX:
+    from .pallas_kernels import pairwise_rank, pallas_rank_applicable
+
+    if pallas_rank_applicable(K):
+        # fused Pallas tile kernel: one pass, no (K, K) HBM intermediates
+        rank = pairwise_rank(mask, f_key, t_key)
+    elif K <= _PAIRWISE_MAX:
         same = f_key[None, :] == f_key[:, None]  # (K, K) j vs i
         earlier = (t_key[None, :] < t_key[:, None]) | (
             (t_key[None, :] == t_key[:, None]) & (ids[None, :] < ids[:, None])

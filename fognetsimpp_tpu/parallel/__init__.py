@@ -19,5 +19,6 @@ transports.
 """
 from .replicas import replicate_state, run_replicated, replica_counters  # noqa: F401
 from .mesh import make_mesh, replica_sharding, shard_replicas, run_sharded  # noqa: F401
+from .multihost import global_mesh, initialize  # noqa: F401
 from .sweep import sweep_policies  # noqa: F401
 from .tp import sharded_min_busy  # noqa: F401

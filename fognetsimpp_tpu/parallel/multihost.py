@@ -1,0 +1,61 @@
+"""Multi-host execution: replicas sharded across processes over DCN.
+
+The reference has no distributed backend at all (single-threaded DES,
+parsim unused — SURVEY.md §2.3); the TPU-native scale-out across hosts is
+``jax.distributed`` + a process-spanning mesh: every host runs the same
+program, the replica axis spans all devices of all processes, and XLA
+routes any cross-replica combine over ICI within a slice and DCN across
+slices.  Because replicas are embarrassingly parallel in the steady state
+(zero collectives per tick — :mod:`fognetsimpp_tpu.parallel.mesh`), the
+multi-host scaling of the sweep grids is linear by construction.
+
+Single-process calls are a no-op passthrough, so the same entry point
+works on one chip, one host, or a pod.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import REPLICA_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    auto: bool = False,
+) -> int:
+    """Join the jax.distributed cluster; returns the process count.
+
+    Three modes, explicit by design:
+      * coordinator args given — initialize with them;
+      * ``auto=True`` — delegate to ``jax.distributed.initialize()``'s
+        cluster autodetection (SLURM / multislice TPU env); raises if no
+        cluster is detectable, so a mis-launched pod job fails loudly
+        instead of running N duplicate single-process programs;
+      * neither — single-process passthrough (local dev / one host).
+    """
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif auto:
+        jax.distributed.initialize()
+    return jax.process_count()
+
+
+def global_mesh(axis_name: str = REPLICA_AXIS) -> Mesh:
+    """1-D mesh over every device of every process.
+
+    With ``shard_replicas`` on top, each host owns
+    ``R / (n_processes * devices_per_host)`` replicas; per-host
+    ``jax.local_devices()`` hold only the local shard (the standard
+    multi-host data layout).
+    """
+    return Mesh(np.asarray(jax.devices()), (axis_name,))

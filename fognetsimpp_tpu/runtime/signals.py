@@ -75,6 +75,7 @@ def summarize(final: WorldState) -> Dict[str, float]:
         n_fanout=int(m.n_fanout),
         n_rejected=int(m.n_rejected),
         n_local=int(m.n_local),
+        n_adverts=int(m.n_adverts),
     )
     for name, v in sig.items():
         out[f"{name}_n"] = int(v.size)

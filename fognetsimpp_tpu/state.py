@@ -170,6 +170,9 @@ class Metrics:
     n_fanout: jax.Array  # () i32 publishAll deliveries to subscribers
     n_rejected: jax.Array  # () i32 pool rejections / v1 unsendable offloads
     n_local: jax.Array  # () i32 tasks run locally on the broker (v1)
+    n_adverts: jax.Array  # () i32 FognetMsgAdvertiseMIPS delivered to the
+    #                        broker (latest-wins slot: superseded in-flight
+    #                        adverts are merged, as in BrokerView)
 
 
 @struct.dataclass
@@ -306,6 +309,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         n_fanout=jnp.zeros((), jnp.int32),
         n_rejected=jnp.zeros((), jnp.int32),
         n_local=jnp.zeros((), jnp.int32),
+        n_adverts=jnp.zeros((), jnp.int32),
     )
 
     return WorldState(

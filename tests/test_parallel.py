@@ -110,6 +110,15 @@ def test_sharded_min_busy_matches_kernel():
     assert (np.asarray(got_none)[np.asarray(mask)] == -1).all()
 
 
+def test_multihost_single_process_path():
+    from fognetsimpp_tpu.parallel import global_mesh, initialize
+
+    assert initialize() == 1  # no cluster env: single-process passthrough
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("replica",)
+
+
 def test_sweep_policies(world):
     spec, state, net, bounds = world
     del spec, state  # sweep builds its own worlds

@@ -87,3 +87,5 @@ def test_message_counts():
     # every decided publish got at least the forwarded status-4 ack
     assert counts["MqttMsgPuback"] >= s["n_scheduled"]
     assert counts["MqttMsgPingRequest"] == 0
+    # initial advert per fog, plus one per completion (v3 adv_on_completion)
+    assert counts["FognetMsgAdvertiseMIPS"] >= spec.n_fogs
