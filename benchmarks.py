@@ -1,0 +1,161 @@
+"""Benchmark suite: the BASELINE.json config grid on real hardware.
+
+``bench.py`` is the driver-facing headline number (one JSON line); this
+script reproduces the rest of BASELINE.json's config ladder and prints one
+JSON line per config:
+
+  2. 100-node grid, round-robin policy, single replica
+  3. 1k-node world, greedy min-latency, 64 vmap replicas
+  4. 10k-node mobile-handover world (APs + moving users + energy churn),
+     energy-aware scheduler, replica fan-out sized to HBM
+  5. policy x load parameter sweep (4 schedulers x 16 load levels)
+
+Measured results are recorded in BENCHMARKS.md.  Each config times the
+second invocation of the jitted program (compile excluded).
+
+Run: ``python benchmarks.py [2 3 4 5]``
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _timed(go, arg, rekey):
+    import jax
+
+    f = go(arg)
+    jax.block_until_ready(f)
+    t0 = time.perf_counter()
+    f = go(rekey(arg))
+    jax.block_until_ready(f)
+    return f, time.perf_counter() - t0
+
+
+def _emit(name, wall, decisions, ticks, extra=None):
+    out = {
+        "config": name,
+        "wall_s": round(wall, 3),
+        "decisions": int(decisions),
+        "decisions_per_sec": round(decisions / wall, 1),
+        "ticks_per_sec": round(ticks / wall, 1),
+    }
+    out.update(extra or {})
+    print(json.dumps(out), flush=True)
+
+
+def config2():
+    """100-node grid, ROUND_ROBIN, single replica."""
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu.core.engine import run
+    from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.spec import Policy
+
+    spec, state, net, bounds = smoke.build(
+        n_users=96, n_fogs=4, policy=int(Policy.ROUND_ROBIN),
+        send_interval=0.01, horizon=1.0, dt=1e-3,
+        max_sends_per_user=104, arrival_window=1024,
+    )
+    go = jax.jit(lambda s: run(spec, s, net, bounds)[0])
+    f, wall = _timed(go, state, lambda s: s.replace(key=jax.random.PRNGKey(1)))
+    _emit("2:100-node-grid-rr", wall, int(np.asarray(f.metrics.n_scheduled)),
+          spec.n_ticks)
+
+
+def config3():
+    """1k-node world, MIN_LATENCY, 64 vmap replicas."""
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu.core.engine import run
+    from fognetsimpp_tpu.parallel import replicate_state
+    from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.spec import Policy
+
+    R = 64
+    spec, state, net, bounds = smoke.build(
+        n_users=1000, n_fogs=24, policy=int(Policy.MIN_LATENCY),
+        send_interval=0.01, horizon=0.25, dt=1e-3,
+        max_sends_per_user=29, arrival_window=256,
+        start_time_max=0.05,
+    )
+    batch = replicate_state(spec, state, R, seed=0)
+    go = jax.jit(lambda b: jax.vmap(lambda s: run(spec, s, net, bounds)[0])(b))
+    f, wall = _timed(
+        go, batch,
+        lambda b: b.replace(key=jax.random.split(jax.random.PRNGKey(1), R)),
+    )
+    _emit("3:1k-node-minlat-64rep", wall,
+          int(np.sum(np.asarray(f.metrics.n_scheduled))), spec.n_ticks * R,
+          {"replicas": R})
+
+
+def config4():
+    """10k-node mobile-handover world, ENERGY_AWARE, 8 replicas."""
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu.core.engine import run
+    from fognetsimpp_tpu.parallel import replicate_state
+    from fognetsimpp_tpu.scenarios import wireless
+    from fognetsimpp_tpu.spec import Policy
+
+    R = 8
+    spec, state, net, bounds = wireless.wireless5(
+        numb_users=10_000, horizon=2.0, dt=5e-3,
+        policy=int(Policy.ENERGY_AWARE),
+        send_interval=0.05, arrival_window=2048, queue_capacity=64,
+        # 2000 stations/AP: per-station contention rescaled from the
+        # 10-user calibration or the cell saturates (see wireless5)
+        w_contention=1.5e-3 * 10 / 10_000,
+    )
+    batch = replicate_state(spec, state, R, seed=0)
+    go = jax.jit(lambda b: jax.vmap(lambda s: run(spec, s, net, bounds)[0])(b))
+    f, wall = _timed(
+        go, batch,
+        lambda b: b.replace(key=jax.random.split(jax.random.PRNGKey(1), R)),
+    )
+    _emit("4:10k-mobile-energy-8rep", wall,
+          int(np.sum(np.asarray(f.metrics.n_scheduled))), spec.n_ticks * R,
+          {"replicas": R,
+           "alive_min": int(np.asarray(f.nodes.alive).sum(-1).min())})
+
+
+def config5():
+    """4 schedulers x 16 load levels (EP x load sweep)."""
+    import numpy as np
+
+    from fognetsimpp_tpu.parallel import sweep_policies
+    from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.spec import Policy
+
+    loads = list(np.geomspace(0.005, 0.08, 16))
+    policies = [Policy.MIN_BUSY, Policy.ROUND_ROBIN, Policy.MIN_LATENCY,
+                Policy.ENERGY_AWARE]
+    n_rep = 4
+    horizon, dt = 0.25, 1e-3
+    t0 = time.perf_counter()
+    grids = sweep_policies(
+        smoke.build,
+        policies=policies,
+        load_intervals=loads,
+        n_replicas_per_load=n_rep,
+        n_users=256, n_fogs=8, horizon=horizon, dt=dt,
+        arrival_window=512, start_time_max=0.05,
+    )
+    wall = time.perf_counter() - t0  # includes the per-policy compiles
+    decisions = sum(int(g["n_scheduled"].sum()) for g in grids.values())
+    n_ticks = int(round(horizon / dt)) * len(policies) * len(loads) * n_rep
+    _emit("5:policy-x-load-sweep", wall, decisions, n_ticks,
+          {"grid": f"{len(policies)} policies x {len(loads)} loads x "
+                   f"{n_rep} replicas",
+           "note": f"wall includes {len(policies)} policy compiles"})
+
+
+if __name__ == "__main__":
+    which = [int(a) for a in sys.argv[1:]] or [2, 3, 4, 5]
+    for n in which:
+        {2: config2, 3: config3, 4: config4, 5: config5}[n]()

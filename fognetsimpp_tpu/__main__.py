@@ -40,7 +40,15 @@ def main(argv=None) -> int:
                     help="record per-tick series vectors")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (cpu/tpu)")
+    ap.add_argument("--analyze", metavar="DIR", default=None,
+                    help="analyse recorded runs in DIR and exit (.anf analog)")
     args = ap.parse_args(argv)
+
+    if args.analyze:
+        from .runtime.analysis import analyze, render_report
+
+        print(render_report(analyze(args.analyze)))
+        return 0
 
     if args.platform:
         import jax

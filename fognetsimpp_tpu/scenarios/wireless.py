@@ -370,7 +370,8 @@ def wireless4(numb_users: int = 2, horizon: float = 30.0, dt: float = 1e-3,
 
 
 def wireless5(numb_users: int = 10, horizon: float = 60.0, dt: float = 0.01,
-              seed: int = 0, **overrides):
+              seed: int = 0, ap_range: float = 400.0,
+              w_contention: float = 1.5e-3, **overrides):
     """``testing/wireless5.ini`` → WirelessNetwork5: the full-feature world.
 
     Heterogeneous fogs MIPS 1000/2000/3000/4000 (``wireless5.ini:116-119``),
@@ -417,7 +418,13 @@ def wireless5(numb_users: int = 10, horizon: float = 60.0, dt: float = 0.01,
         ap_names=("ap", "ap1", "ap2", "ap3", "ap4"),
         ap_pos=((133.0, 172.0), (997.0, 566.0), (997.0, 172.0),
                 (139.0, 566.0), (582.0, 330.0)),
-        ap_range=400.0,  # 3.5 mW transmit power (wireless5.ini:52)
+        # default 400 m ~ 3.5 mW transmit power (wireless5.ini:52); the
+        # per-station contention coefficient is calibrated for the ini's
+        # 10 users — scale it down when scaling numb_users up, or the
+        # access delay saturates (physically: one 802.11 cell cannot carry
+        # thousands of stations)
+        ap_range=ap_range,
+        w_contention=w_contention,
         user_pos=user_pos, linear=linear, circle=circle,
         area=(1000.0, 1000.0),
         energy_users=True, initial_energy_frac=(0.10, 1.0),

@@ -18,8 +18,12 @@ def _jnp_rank(mask, f_key, t_key):
     return jnp.where(mask, jnp.sum(before, axis=1, dtype=jnp.int32), -1)
 
 
-def test_pairwise_rank_matches_reference():
-    K, F = 512, 7
+import pytest
+
+
+@pytest.mark.parametrize("K", [512, 1024])  # 1024 exercises the multi-tile
+def test_pairwise_rank_matches_reference(K):  # grid (row_id = i*tk + iota)
+    F = 7
     key = jax.random.PRNGKey(0)
     k1, k2, k3 = jax.random.split(key, 3)
     mask = jax.random.bernoulli(k1, 0.7, (K,))

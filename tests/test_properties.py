@@ -1,0 +1,90 @@
+"""Property-based invariants (hypothesis) over randomized world data.
+
+Shape-stable by design: hypothesis draws only *data* (seeds, fog MIPS,
+publish intervals) so every example reuses one compiled program — the
+property layer the reference never had (SURVEY.md §4 implication note).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from fognetsimpp_tpu import Stage, run
+from fognetsimpp_tpu.core.engine import prime_initial_advertisements
+from fognetsimpp_tpu.runtime import summarize
+from fognetsimpp_tpu.scenarios import smoke
+
+TERMINAL = (Stage.DONE, Stage.NO_RESOURCE, Stage.DROPPED, Stage.REJECTED)
+IN_FLIGHT = (Stage.PUB_INFLIGHT, Stage.TASK_INFLIGHT, Stage.QUEUED,
+             Stage.RUNNING, Stage.LOCAL_RUN)
+
+_WORLD = {}
+
+
+def _world():
+    if not _WORLD:
+        _WORLD["w"] = smoke.build(
+            horizon=0.4, send_interval=0.02, n_users=4, n_fogs=3,
+            queue_capacity=8, start_time_max=0.05,
+        )
+    return _WORLD["w"]
+
+
+@settings(max_examples=12, deadline=None)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    mips=st.lists(
+        st.sampled_from([200.0, 800.0, 2000.0, 20000.0]),
+        min_size=3, max_size=3,
+    ),
+    interval=st.floats(0.02, 0.2),
+)
+def test_invariants_hold(seed, mips, interval):
+    spec, state0, net, bounds = _world()
+    m = jnp.asarray(mips, jnp.float32)
+    state = state0.replace(
+        key=jax.random.PRNGKey(seed),
+        fogs=state0.fogs.replace(mips=m, pool_avail=m),
+        users=state0.users.replace(
+            send_interval=jnp.full((spec.n_users,), interval, jnp.float32)
+        ),
+    )
+    state = prime_initial_advertisements(spec, state, net)
+    final, _ = run(spec, state, net, bounds)
+    s = summarize(final)
+
+    # 1. conservation: every published task is in exactly one stage bucket
+    accounted = sum(
+        s[f"n_{st_.name.lower()}"] for st_ in TERMINAL + IN_FLIGHT
+    )
+    assert accounted == s["n_published"]
+
+    t = final.tasks
+    stage = np.asarray(t.stage)
+    used = stage != int(Stage.UNUSED)
+
+    # 2. causal ordering along the offload chain
+    def col(name):
+        return np.asarray(getattr(t, name))
+
+    sched = np.isfinite(col("t_at_fog"))
+    assert (col("t_at_broker")[used] >= col("t_create")[used] - 1e-6).all()
+    assert (col("t_at_fog")[sched] >= col("t_at_broker")[sched] - 1e-6).all()
+    done = stage == int(Stage.DONE)
+    started = done & np.isfinite(col("t_service_start"))
+    assert (
+        col("t_complete")[started] >= col("t_service_start")[started] - 1e-6
+    ).all()
+    assert (col("t_ack6")[started] >= col("t_complete")[started] - 1e-6).all()
+
+    # 3. queue bounds and non-negative accumulators
+    q_len = np.asarray(final.fogs.q_len)
+    assert ((q_len >= 0) & (q_len <= spec.queue_capacity)).all()
+    qt = np.asarray(t.queue_time_ms)
+    assert (qt[np.isfinite(qt)] >= -1e-3).all()
+    assert (np.asarray(final.fogs.busy_time) >= -1e-3).all()
+
+    # 4. a fog's in-service task really is RUNNING
+    cur = np.asarray(final.fogs.current_task)
+    for c in cur[cur >= 0]:
+        assert stage[c] == int(Stage.RUNNING)
