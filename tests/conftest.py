@@ -22,3 +22,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+from fognetsimpp_tpu.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
