@@ -219,6 +219,12 @@ def _phase_spawn(
         & (users.send_count < S)
     )
     t_create = jnp.maximum(users.next_send, t0)  # missed-while-dead resume
+    if spec.send_stop_time != float("inf"):
+        # stopTime: the app cancels its send timer at stopTime and a
+        # restarted node reschedules sends only before it (mqttApp2.cc:
+        # 191-210); gate the actual creation time so a node resuming
+        # after stopTime cannot publish
+        due = due & (t_create < spec.send_stop_time)
 
     key, k_mips, k_jit = jax.random.split(state.key, 3)
     if spec.fixed_mips_required is not None:

@@ -1,11 +1,12 @@
 """ctypes bridge to the native event-driven parity core (desim.cpp).
 
 Compiles ``desim.cpp`` with g++ on first use (cached in ``_build/`` keyed on
-source hash) and exposes :func:`run_v3` plus :func:`replay_engine_world`,
-which replays the exact publish workload a batched-engine run decided
-client-side (task creation times + MIPSRequired) through the sequential
-DES — the two simulators then disagree only where their *execution models*
-differ, which is what the parity gate (tests/test_parity.py) measures.
+source hash) and exposes :func:`run_gen` (all three app generations) plus
+:func:`replay_engine_world`, which replays the exact publish workload a
+batched-engine run decided client-side (task creation times + MIPSRequired)
+through the sequential DES — the two simulators then disagree only where
+their *execution models* differ, which is what the parity gate
+(tests/test_parity.py) measures.
 """
 from __future__ import annotations
 
@@ -13,7 +14,7 @@ import ctypes
 import hashlib
 import os
 import subprocess
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -22,6 +23,11 @@ _SRC = os.path.join(_DIR, "desim.cpp")
 _BUILD = os.path.join(_DIR, "_build")
 
 _lib: Optional[ctypes.CDLL] = None
+
+_OUT_COLS = (
+    "t_at_broker", "t_at_fog", "t_service_start", "t_complete", "t_ack3",
+    "t_ack4_fwd", "t_ack5", "t_ack4_queued", "t_ack6", "queue_time",
+)
 
 
 def build(force: bool = False) -> str:
@@ -50,20 +56,21 @@ def _load() -> ctypes.CDLL:
         lib = ctypes.CDLL(build())
         dp = ctypes.POINTER(ctypes.c_double)
         ip = ctypes.POINTER(ctypes.c_int)
-        lib.desim_run_v3.restype = ctypes.c_long
-        lib.desim_run_v3.argtypes = (
+        lib.desim_run_gen.restype = ctypes.c_long
+        lib.desim_run_gen.argtypes = (
             [ctypes.c_int] * 3
             + [ip, dp, dp]  # task_user, t_create, mips_req
             + [dp] * 5  # d_ub, d_bf, fog_mips, register_t, adv0_t
-            + [ctypes.c_double]
-            + [ctypes.c_int] * 4
-            + [dp, ip] + [dp] * 8 + [ip]
+            + [ctypes.c_double]  # horizon
+            + [ctypes.c_int] * 10  # policy..queue_capacity
+            + [ctypes.c_double] * 3  # broker_mips, required_time, adv_interval
+            + [dp, ip] + [dp] * 9 + [ip]
         )
         _lib = lib
     return _lib
 
 
-def run_v3(
+def run_gen(
     task_user: np.ndarray,
     task_t_create: np.ndarray,
     task_mips_req: np.ndarray,
@@ -73,16 +80,23 @@ def run_v3(
     register_t: np.ndarray,
     adv0_t: np.ndarray,
     horizon: float,
+    policy: int = 0,
+    fog_model: int = 0,
+    app_gen: int = 3,
     mips0_divisor: bool = True,
     zero_initial_view: bool = True,
     adv_on_completion: bool = True,
+    adv_periodic: bool = False,
+    v1_max_scan: bool = True,
+    local_pool_leak: bool = False,
     queue_capacity: int = 64,
+    broker_mips: float = 0.0,
+    required_time: float = 0.01,
+    adv_interval: float = 0.01,
 ) -> Dict[str, np.ndarray]:
-    """Run the native v3 DES over an explicit publish schedule."""
+    """Run the native DES over an explicit publish schedule."""
     lib = _load()
     n_tasks = len(task_user)
-    n_users = len(d_ub)
-    n_fogs = len(d_bf)
 
     def d(a):
         return np.ascontiguousarray(np.asarray(a, np.float64))
@@ -93,13 +107,7 @@ def run_v3(
     task_user = i(task_user)
     ins = [d(task_t_create), d(task_mips_req), d(d_ub), d(d_bf), d(fog_mips),
            d(register_t), d(adv0_t)]
-    outs_d = {
-        k: np.empty((n_tasks,), np.float64)
-        for k in (
-            "t_at_broker", "t_at_fog", "t_service_start", "t_complete",
-            "t_ack4_fwd", "t_ack5", "t_ack4_queued", "t_ack6", "queue_time",
-        )
-    }
+    outs_d = {k: np.empty((n_tasks,), np.float64) for k in _OUT_COLS}
     fog = np.empty((n_tasks,), np.int32)
     stage = np.empty((n_tasks,), np.int32)
 
@@ -112,16 +120,20 @@ def run_v3(
     def pi(a):
         return a.ctypes.data_as(ip)
 
-    n_events = lib.desim_run_v3(
-        n_users, n_fogs, n_tasks,
+    n_events = lib.desim_run_gen(
+        len(d_ub), len(d_bf), n_tasks,
         pi(task_user), pd(ins[0]), pd(ins[1]),
         pd(ins[2]), pd(ins[3]), pd(ins[4]), pd(ins[5]), pd(ins[6]),
         ctypes.c_double(horizon),
+        int(policy), int(fog_model), int(app_gen),
         int(mips0_divisor), int(zero_initial_view), int(adv_on_completion),
+        int(adv_periodic), int(v1_max_scan), int(local_pool_leak),
         int(queue_capacity),
+        ctypes.c_double(broker_mips), ctypes.c_double(required_time),
+        ctypes.c_double(adv_interval),
         pd(outs_d["t_at_broker"]), pi(fog), pd(outs_d["t_at_fog"]),
         pd(outs_d["t_service_start"]), pd(outs_d["t_complete"]),
-        pd(outs_d["t_ack4_fwd"]), pd(outs_d["t_ack5"]),
+        pd(outs_d["t_ack3"]), pd(outs_d["t_ack4_fwd"]), pd(outs_d["t_ack5"]),
         pd(outs_d["t_ack4_queued"]), pd(outs_d["t_ack6"]),
         pd(outs_d["queue_time"]), pi(stage),
     )
@@ -132,13 +144,16 @@ def run_v3(
     return out
 
 
-def replay_engine_world(spec, final_state, net, horizon: Optional[float] = None):
+def replay_engine_world(
+    spec, final_state, net, horizon: Optional[float] = None
+) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
     """Replay a finished engine run's publish workload through the DES.
 
     Extracts the client-side inputs the engine decided (per-task user,
     creation time, MIPSRequired — all independent of scheduling), the static
-    delay vectors, and the fog boot schedule from the primed initial state,
-    then runs the native core over the same horizon.
+    delay vectors, the fog boot schedule from the primed initial state, and
+    the generation parameters from the spec, then runs the native core over
+    the same horizon.
 
     Only defined for static wired worlds (the smoke shape): with wireless
     nodes or mobility the per-task delays are time-varying and a single
@@ -158,6 +173,12 @@ def replay_engine_world(spec, final_state, net, horizon: Optional[float] = None)
         raise NotImplementedError(
             "replay_engine_world requires stationary nodes"
         )
+    if spec.policy not in (0, 5, 6):  # MIN_BUSY, LOCAL_FIRST, MAX_MIPS
+        # the DES implements only the reference's real schedulers; feeding
+        # it ROUND_ROBIN etc. would silently compare different policies
+        raise NotImplementedError(
+            f"native DES has no parity path for policy {spec.policy}"
+        )
 
     tasks = final_state.tasks
     t_create = np.asarray(tasks.t_create, np.float64)
@@ -174,7 +195,7 @@ def replay_engine_world(spec, final_state, net, horizon: Optional[float] = None)
     register_t = np.asarray(state0.broker.register_t, np.float64)
     adv0_t = np.asarray(state0.broker.adv_arrive_t, np.float64)
 
-    return run_v3(
+    return run_gen(
         task_user=np.asarray(tasks.user)[used],
         task_t_create=t_create[used],
         task_mips_req=np.asarray(tasks.mips_req, np.float64)[used],
@@ -184,8 +205,17 @@ def replay_engine_world(spec, final_state, net, horizon: Optional[float] = None)
         register_t=register_t,
         adv0_t=adv0_t,
         horizon=spec.horizon if horizon is None else horizon,
+        policy=spec.policy,
+        fog_model=spec.fog_model,
+        app_gen=spec.app_gen,
         mips0_divisor=spec.bug_compat.mips0_divisor,
         zero_initial_view=spec.bug_compat.zero_initial_view_mips,
         adv_on_completion=spec.adv_on_completion,
+        adv_periodic=spec.adv_periodic,
+        v1_max_scan=spec.bug_compat.v1_max_scan,
+        local_pool_leak=spec.bug_compat.local_pool_leak,
         queue_capacity=spec.queue_capacity,
+        broker_mips=spec.broker_mips,
+        required_time=spec.required_time,
+        adv_interval=spec.adv_interval,
     ), used

@@ -2,20 +2,31 @@
 // JAX engine is validated against (the "within 1% of C++ DES" gate of
 // BASELINE.json, replacing OMNeT++'s role natively — SURVEY.md §7 step 2).
 //
-// Implements the v3 hot path exactly as the reference's three application
-// state machines execute it, one event at a time on a binary heap:
+// Implements all three app generations exactly as the reference's state
+// machines execute them, one event at a time on a binary heap:
 //
-//   publish arrival -> broker argmin schedule   (BrokerBaseApp3.cc:231-319)
-//   task arrival    -> fog assign / FIFO queue  (ComputeBrokerApp3.cc:269-320)
-//   release         -> complete + promote head  (ComputeBrokerApp3.cc:224-256)
-//   advert arrival  -> broker view refresh      (BrokerBaseApp3.cc:123-136)
+//   v3 (FIFO fogs, min-busy broker):
+//     publish arrival -> broker argmin schedule   (BrokerBaseApp3.cc:231-319)
+//     task arrival    -> fog assign / FIFO queue  (ComputeBrokerApp3.cc:269-320)
+//     release         -> complete + promote head  (ComputeBrokerApp3.cc:224-256)
+//     advert arrival  -> broker view refresh      (BrokerBaseApp3.cc:123-136)
+//   v1/v2 (MIPS-pool fogs, LOCAL_FIRST / buggy MAX_MIPS broker):
+//     local accept    -> pool debit + status-3    (BrokerBaseApp.cc:171-212)
+//     offload scan    -> compare-to-first winner  (BrokerBaseApp.cc:228-252)
+//     pool arrival    -> strict-< accept/reject   (ComputeBrokerApp2.cc:258-310)
+//     pool release    -> refund + status-6 relay  (ComputeBrokerApp2.cc:222-245)
+//     periodic advert -> every 0.01 s, MIPS=pool  (ComputeBrokerApp2.cc:219)
 //
 // Faithful-parity switches mirror fognetsimpp_tpu.spec.BugCompat:
 //   * mips0_divisor: every candidate's service estimate divides by
 //     brokers[0]'s MIPS (BrokerBaseApp3.cc:268,273,275);
 //   * zero_initial_view: fogs register with MIPS=0 until their first
-//     advertisement lands (BrokerBaseApp3.cc:104), making early estimates
-//     +inf exactly like the C++ double division.
+//     advertisement lands (BrokerBaseApp3.cc:104);
+//   * v1_max_scan: the offload scan never updates its running max, so the
+//     winner is the LAST fog whose MIPS beats fog 0's (BrokerBaseApp.cc:
+//     232-236);
+//   * local_pool_leak: the v1 local path never records its request, so the
+//     broker pool is never refunded (BrokerBaseApp.cc:208 commented out).
 //
 // The publish schedule (user, creation time, MIPSRequired) is an *input*:
 // the client-side behaviour (connect gating, send timers, task-size RNG) is
@@ -25,7 +36,6 @@
 
 #include <cmath>
 #include <cstdint>
-#include <cstring>
 #include <limits>
 #include <queue>
 #include <vector>
@@ -44,19 +54,29 @@ enum Stage : int {
   kDone = 5,
   kNoResource = 6,
   kDropped = 7,
+  kLocalRun = 8,
+  kRejected = 9,
 };
+
+// Policy codes matching fognetsimpp_tpu.spec.Policy (subset with DES parity).
+enum Policy : int { kMinBusy = 0, kLocalFirst = 5, kMaxMips = 6 };
+
+enum FogModel : int { kFifo = 0, kPool = 1 };
 
 enum EventKind : int {
   kEvPubArrive = 0,   // publish reaches the base broker
   kEvTaskArrive = 1,  // FognetMsgTask reaches its fog node
-  kEvRelease = 2,     // fog's in-service task completes
+  kEvRelease = 2,     // FIFO fog's in-service task completes
   kEvAdvArrive = 3,   // FognetMsgAdvertiseMIPS reaches the broker
   kEvRegister = 4,    // fog's Connect reaches the broker (registration)
+  kEvPoolDone = 5,    // pool task's requiredTime expires (a = task id)
+  kEvLocalDone = 6,   // broker-local task expires (a = task id)
+  kEvAdvTimer = 7,    // v1/v2 periodic re-advertisement (a = fog id)
 };
 
 struct Event {
   double t;
-  int64_t seq;  // FIFO tie-break: heap pops equal-time events in push order,
+  int64_t seq;  // FIFO tie-break: equal-time events pop in push order,
                 // matching OMNeT++'s insertion-ordered event list
   int kind;
   int a;      // task id / fog id
@@ -73,8 +93,9 @@ struct EventLater {
 
 struct Fog {
   double mips = 0.0;
-  double busy_time = 0.0;  // sum of service times of queued+running tasks
-  int current = -1;        // in-service task id
+  double busy_time = 0.0;  // FIFO: sum of service times of queued+running
+  double pool = 0.0;       // POOL: remaining MIPS
+  int current = -1;        // FIFO in-service task id
   double busy_until = kInf;
   std::vector<int> fifo;   // requests[] vector (head = front)
   size_t head = 0;
@@ -91,21 +112,247 @@ struct Task {
   double t_service_start = kInf;
   double t_complete = kInf;
   double t_q_enter = kInf;
+  double t_ack3 = kInf;
   double t_ack4_fwd = kInf;
   double t_ack4_queued = kInf;
   double t_ack5 = kInf;
   double t_ack6 = kInf;
   double queue_time = kInf;
-  double svc = 0.0;  // service time at its fog (tskTime)
+  double svc = 0.0;  // FIFO service time at its fog (tskTime)
+};
+
+struct Params {
+  int n_users, n_fogs, n_tasks;
+  const double* d_ub;
+  const double* d_bf;
+  double horizon;
+  int policy, fog_model, app_gen;
+  int mips0_divisor, zero_initial_view, adv_on_completion, adv_periodic;
+  int v1_max_scan, local_pool_leak;
+  int queue_capacity;
+  double broker_mips, required_time, adv_interval;
+};
+
+struct World {
+  Params p;
+  std::vector<Fog> fogs;
+  std::vector<Task> tasks;
+  std::vector<double> view_mips, view_busy;  // brokers[] stale view
+  std::vector<char> registered;
+  double local_pool = 0.0;
+  std::priority_queue<Event, std::vector<Event>, EventLater> heap;
+  int64_t seq = 0;
+
+  void push(double t, int kind, int a, double x = 0.0, double y = 0.0) {
+    heap.push(Event{t, seq++, kind, a, x, y});
+  }
+
+  // v3 `<` scan over brokers[] (BrokerBaseApp3.cc:267-281): first-wins
+  // tie-break, +inf estimates while the view MIPS is 0.
+  int pick_min_busy(double req) const {
+    int best = -1;
+    double best_score = kInf;
+    bool any = false;
+    for (int f = 0; f < p.n_fogs; ++f) {
+      if (!registered[f]) continue;
+      double div = p.mips0_divisor ? view_mips[0] : view_mips[f];
+      double est = div > 0.0 ? req / div : kInf;
+      double score = view_busy[f] + est;
+      if (!any || score < best_score) {
+        best = f;
+        best_score = score;
+        any = true;
+      }
+    }
+    return any ? best : -1;
+  }
+
+  // v1/v2 offload scan (BrokerBaseApp.cc:228-240): with the faithful bug,
+  // `temp` stays brokers[0]'s MIPS, so the winner is the LAST registered
+  // fog whose advertised MIPS beats fog 0's (or fog 0 itself).
+  int pick_max_mips() const {
+    int first = -1, winner = -1;
+    for (int f = 0; f < p.n_fogs; ++f) {
+      if (!registered[f]) continue;
+      if (first < 0) {
+        first = winner = f;
+        continue;
+      }
+      if (p.v1_max_scan) {
+        if (view_mips[f] > view_mips[first]) winner = f;  // temp not updated
+      } else {
+        if (view_mips[f] > view_mips[winner]) winner = f;
+      }
+    }
+    return winner;
+  }
+
+  void broker_decide(int i, double now) {
+    Task& tk = tasks[i];
+    // v1 LOCAL_FIRST: run locally when the broker pool covers it
+    // (strict <, BrokerBaseApp.cc:171-180); status-3 "processing" ack
+    if (p.policy == kLocalFirst && tk.mips_req < local_pool) {
+      local_pool -= tk.mips_req;
+      tk.stage = kLocalRun;
+      tk.t_service_start = now;
+      tk.t_complete = now + p.required_time;
+      tk.t_ack3 = now + p.d_ub[tk.user];
+      push(tk.t_complete, kEvLocalDone, i);
+      return;
+    }
+    // every non-local publish gets the "forwarded" status-4 (:146-150)
+    tk.t_ack4_fwd = now + p.d_ub[tk.user];
+    int choice = (p.policy == kMinBusy) ? pick_min_busy(tk.mips_req)
+                                        : pick_max_mips();
+    if (choice < 0) {  // "no compute resource available" (:306-319)
+      tk.stage = kNoResource;
+      return;
+    }
+    if (p.policy != kMinBusy && !(tk.mips_req < view_mips[choice])) {
+      // v1 guard: an oversized task is never sent (BrokerBaseApp.cc:244)
+      tk.stage = kRejected;
+      return;
+    }
+    tk.stage = kTaskInflight;
+    tk.fog = choice;
+    tk.t_at_fog = now + p.d_bf[choice];
+    push(tk.t_at_fog, kEvTaskArrive, i);
+  }
+
+  void fifo_arrive(int i, double now) {  // ComputeBrokerApp3.cc:269-320
+    Task& tk = tasks[i];
+    Fog& fg = fogs[tk.fog];
+    tk.svc = tk.mips_req / fg.mips;       // tskTime (:276)
+    fg.busy_time += tk.svc;               // busyTime += tskTime (:279)
+    if (fg.current < 0) {                 // idle: assign (:282-303)
+      fg.current = i;
+      tk.stage = kRunning;
+      tk.t_service_start = now;
+      fg.busy_until = now + tk.svc;
+      tk.t_ack5 = now + p.d_bf[tk.fog] + p.d_ub[tk.user];  // "assigned"
+      push(fg.busy_until, kEvRelease, tk.fog);
+    } else {                              // busy: FIFO (:304-314)
+      int backlog = static_cast<int>(fg.fifo.size() - fg.head);
+      if (backlog >= p.queue_capacity) {  // engine-side cap analog; the
+        tk.stage = kDropped;              // reference vector is unbounded
+        return;
+      }
+      fg.fifo.push_back(i);
+      tk.stage = kQueued;
+      tk.t_q_enter = now;
+      tk.t_ack4_queued = now + p.d_bf[tk.fog] + p.d_ub[tk.user];  // "queued"
+    }
+  }
+
+  void fifo_release(int f, double) {  // releaseResource (:224-256)
+    Fog& fg = fogs[f];
+    if (fg.current < 0) return;
+    Task& done = tasks[fg.current];
+    double t_done = fg.busy_until;
+    done.stage = kDone;
+    done.t_complete = t_done;
+    done.t_ack6 = t_done + p.d_bf[f] + p.d_ub[done.user];  // "performed"
+    fg.busy_time -= done.svc;  // busyTime -= requiredTime (:232)
+    fg.current = -1;
+    fg.busy_until = kInf;
+    if (fg.head < fg.fifo.size()) {  // promote FIFO head (:236-252)
+      int nxt = fg.fifo[fg.head++];
+      Task& tn = tasks[nxt];
+      fg.current = nxt;
+      tn.stage = kRunning;
+      tn.t_service_start = t_done;
+      tn.queue_time = t_done - tn.t_q_enter;  // queueTime signal (:238)
+      fg.busy_until = t_done + tn.svc;
+      push(fg.busy_until, kEvRelease, f);
+    }
+    if (p.adv_on_completion)  // advertiseMIPS() at :254
+      push(t_done + p.d_bf[f], kEvAdvArrive, f, fg.mips, fg.busy_time);
+  }
+
+  void pool_arrive(int i, double now) {  // ComputeBrokerApp2.cc:258-310
+    Task& tk = tasks[i];
+    Fog& fg = fogs[tk.fog];
+    if (tk.mips_req < fg.pool) {  // strict <, :269
+      fg.pool -= tk.mips_req;     // :272
+      tk.stage = kRunning;
+      tk.t_service_start = now;
+      tk.t_complete = now + p.required_time;
+      push(tk.t_complete, kEvPoolDone, i);
+    } else {  // TaskAck(status=false): every broker generation ignores it
+      tk.stage = kRejected;  // (:300-310, BrokerBaseApp2.cc:139-141)
+    }
+  }
+
+  void pool_done(int i, double now) {  // releaseResource (:222-245)
+    Task& tk = tasks[i];
+    fogs[tk.fog].pool += tk.mips_req;
+    tk.stage = kDone;
+    if (p.app_gen >= 2)  // v1 acks via FognetMsgTaskAck, which the broker
+      //                    logs and drops: the client never learns
+      tk.t_ack6 = now + p.d_bf[tk.fog] + p.d_ub[tk.user];
+  }
+
+  void local_done(int i, double now) {  // BrokerBaseApp.cc:369-394
+    Task& tk = tasks[i];
+    if (!p.local_pool_leak) local_pool += tk.mips_req;
+    tk.stage = kDone;
+    tk.t_ack6 = now + p.d_ub[tk.user];  // status-6 straight to the client
+  }
+
+  long run() {
+    long n_events = 0;
+    while (!heap.empty()) {
+      Event ev = heap.top();
+      heap.pop();
+      if (ev.t > p.horizon) break;
+      ++n_events;
+      switch (ev.kind) {
+        case kEvRegister:
+          registered[ev.a] = 1;  // brokers.push_back (:102-107)
+          break;
+        case kEvAdvArrive:  // latest-wins view refresh (:123-136)
+          view_mips[ev.a] = ev.x;
+          view_busy[ev.a] = ev.y;
+          break;
+        case kEvAdvTimer: {  // v1/v2: re-advertise every 0.01 s; the POOL
+          Fog& fg = fogs[ev.a];  // model advertises the remaining pool
+          double val = p.fog_model == kPool ? fg.pool : fg.mips;
+          push(ev.t + p.d_bf[ev.a], kEvAdvArrive, ev.a, val, fg.busy_time);
+          push(ev.t + p.adv_interval, kEvAdvTimer, ev.a);
+          break;
+        }
+        case kEvPubArrive:
+          broker_decide(ev.a, ev.t);
+          break;
+        case kEvTaskArrive:
+          if (p.fog_model == kPool)
+            pool_arrive(ev.a, ev.t);
+          else
+            fifo_arrive(ev.a, ev.t);
+          break;
+        case kEvRelease:
+          fifo_release(ev.a, ev.t);
+          break;
+        case kEvPoolDone:
+          pool_done(ev.a, ev.t);
+          break;
+        case kEvLocalDone:
+          local_done(ev.a, ev.t);
+          break;
+      }
+    }
+    return n_events;
+  }
 };
 
 }  // namespace
 
 extern "C" {
 
-// Runs the v3 world to `horizon` (events past it are not processed, like a
-// sim-time-limit) and writes per-task records. Returns processed event count.
-long desim_run_v3(
+// Runs any app generation to `horizon` (events past it are not processed,
+// like a sim-time-limit) and writes per-task records. Returns processed
+// event count.
+long desim_run_gen(
     int n_users, int n_fogs, int n_tasks,
     const int* task_user, const double* task_t_create,
     const double* task_mips_req,
@@ -114,147 +361,60 @@ long desim_run_v3(
     const double* fog_mips,   // (n_fogs)
     const double* register_t, // (n_fogs) Connect arrival at the broker
     const double* adv0_t,     // (n_fogs) first advertisement arrival time
-    double horizon, int mips0_divisor, int zero_initial_view,
-    int adv_on_completion, int queue_capacity,
+    double horizon, int policy, int fog_model, int app_gen,
+    int mips0_divisor, int zero_initial_view, int adv_on_completion,
+    int adv_periodic, int v1_max_scan, int local_pool_leak,
+    int queue_capacity, double broker_mips, double required_time,
+    double adv_interval,
     // outputs (n_tasks):
     double* o_t_at_broker, int* o_fog, double* o_t_at_fog,
-    double* o_t_service_start, double* o_t_complete, double* o_t_ack4_fwd,
-    double* o_t_ack5, double* o_t_ack4_queued, double* o_t_ack6,
-    double* o_queue_time, int* o_stage) {
-  std::vector<Fog> fogs(n_fogs);
-  std::vector<Task> tasks(n_tasks);
-  // broker's stale view (brokers[] vector, BrokerBaseApp3.h:26-63)
-  std::vector<double> view_mips(n_fogs, 0.0), view_busy(n_fogs, 0.0);
-  std::vector<char> registered(n_fogs, 0);
-
-  std::priority_queue<Event, std::vector<Event>, EventLater> heap;
-  int64_t seq = 0;
-  auto push = [&](double t, int kind, int a, double x = 0.0, double y = 0.0) {
-    heap.push(Event{t, seq++, kind, a, x, y});
-  };
+    double* o_t_service_start, double* o_t_complete, double* o_t_ack3,
+    double* o_t_ack4_fwd, double* o_t_ack5, double* o_t_ack4_queued,
+    double* o_t_ack6, double* o_queue_time, int* o_stage) {
+  World w;
+  w.p = Params{n_users, n_fogs, n_tasks, d_ub, d_bf, horizon, policy,
+               fog_model, app_gen, mips0_divisor, zero_initial_view,
+               adv_on_completion, adv_periodic, v1_max_scan,
+               local_pool_leak, queue_capacity, broker_mips, required_time,
+               adv_interval};
+  w.fogs.resize(n_fogs);
+  w.tasks.resize(n_tasks);
+  w.view_mips.assign(n_fogs, 0.0);
+  w.view_busy.assign(n_fogs, 0.0);
+  w.registered.assign(n_fogs, 0);
+  w.local_pool = broker_mips;
 
   for (int f = 0; f < n_fogs; ++f) {
-    fogs[f].mips = fog_mips[f];
-    if (!zero_initial_view) view_mips[f] = fog_mips[f];
-    if (std::isfinite(register_t[f])) push(register_t[f], kEvRegister, f);
+    w.fogs[f].mips = fog_mips[f];
+    w.fogs[f].pool = fog_mips[f];
+    if (!zero_initial_view) w.view_mips[f] = fog_mips[f];
+    if (std::isfinite(register_t[f])) w.push(register_t[f], kEvRegister, f);
     if (std::isfinite(adv0_t[f]))
-      push(adv0_t[f], kEvAdvArrive, f, fog_mips[f], 0.0);
+      w.push(adv0_t[f], kEvAdvArrive, f, fog_mips[f], 0.0);
+    if (adv_periodic)  // first timer at one interval (ComputeBrokerApp2.cc:219)
+      w.push(adv_interval, kEvAdvTimer, f);
   }
   for (int i = 0; i < n_tasks; ++i) {
-    tasks[i].user = task_user[i];
-    tasks[i].t_create = task_t_create[i];
-    tasks[i].mips_req = task_mips_req[i];
+    w.tasks[i].user = task_user[i];
+    w.tasks[i].t_create = task_t_create[i];
+    w.tasks[i].mips_req = task_mips_req[i];
     if (std::isfinite(task_t_create[i])) {
-      tasks[i].stage = kPubInflight;
-      tasks[i].t_at_broker = task_t_create[i] + d_ub[task_user[i]];
-      push(tasks[i].t_at_broker, kEvPubArrive, i);
+      w.tasks[i].stage = kPubInflight;
+      w.tasks[i].t_at_broker = task_t_create[i] + d_ub[task_user[i]];
+      w.push(w.tasks[i].t_at_broker, kEvPubArrive, i);
     }
   }
 
-  long n_events = 0;
-  while (!heap.empty()) {
-    Event ev = heap.top();
-    heap.pop();
-    if (ev.t > horizon) break;
-    ++n_events;
-    switch (ev.kind) {
-      case kEvRegister:
-        registered[ev.a] = 1;  // brokers.push_back (BrokerBaseApp3.cc:102-107)
-        break;
-      case kEvAdvArrive:  // latest-wins view refresh (:123-136)
-        view_mips[ev.a] = ev.x;
-        view_busy[ev.a] = ev.y;
-        break;
-      case kEvPubArrive: {
-        Task& tk = tasks[ev.a];
-        // status-4 "forwarded" ack straight back to the client (:146-150)
-        tk.t_ack4_fwd = ev.t + d_ub[tk.user];
-        // the `<` scan over brokers[] (BrokerBaseApp3.cc:267-281):
-        // first-wins tie-break, +inf estimates while view MIPS is 0
-        int best = -1;
-        double best_score = kInf;
-        bool any = false;
-        for (int f = 0; f < n_fogs; ++f) {
-          if (!registered[f]) continue;
-          double div = mips0_divisor ? view_mips[0] : view_mips[f];
-          double est = div > 0.0 ? tk.mips_req / div : kInf;
-          double score = view_busy[f] + est;
-          if (!any || score < best_score) {
-            best = f;
-            best_score = score;
-            any = true;
-          }
-        }
-        if (!any) {  // "no compute resource available" (:306-319)
-          tk.stage = kNoResource;
-          break;
-        }
-        tk.stage = kTaskInflight;
-        tk.fog = best;
-        tk.t_at_fog = ev.t + d_bf[best];
-        push(tk.t_at_fog, kEvTaskArrive, ev.a);
-        break;
-      }
-      case kEvTaskArrive: {  // ComputeBrokerApp3.cc:269-320
-        Task& tk = tasks[ev.a];
-        Fog& fg = fogs[tk.fog];
-        tk.svc = tk.mips_req / fg.mips;       // tskTime (:276)
-        fg.busy_time += tk.svc;               // busyTime += tskTime (:279)
-        if (fg.current < 0) {                 // idle: assign (:282-303)
-          fg.current = ev.a;
-          tk.stage = kRunning;
-          tk.t_service_start = ev.t;
-          fg.busy_until = ev.t + tk.svc;
-          tk.t_ack5 = ev.t + d_bf[tk.fog] + d_ub[tk.user];  // "assigned"
-          push(fg.busy_until, kEvRelease, tk.fog);
-        } else {                              // busy: FIFO (:304-314)
-          int backlog = static_cast<int>(fg.fifo.size() - fg.head);
-          if (backlog >= queue_capacity) {    // engine-side cap analog; the
-            tk.stage = kDropped;              // reference vector is unbounded
-            break;
-          }
-          fg.fifo.push_back(ev.a);
-          tk.stage = kQueued;
-          tk.t_q_enter = ev.t;
-          tk.t_ack4_queued = ev.t + d_bf[tk.fog] + d_ub[tk.user];  // "queued"
-        }
-        break;
-      }
-      case kEvRelease: {  // releaseResource (ComputeBrokerApp3.cc:224-256)
-        Fog& fg = fogs[ev.a];
-        if (fg.current < 0) break;
-        Task& done = tasks[fg.current];
-        double t_done = fg.busy_until;
-        done.stage = kDone;
-        done.t_complete = t_done;
-        done.t_ack6 = t_done + d_bf[ev.a] + d_ub[done.user];  // "performed"
-        fg.busy_time -= done.svc;  // busyTime -= requiredTime (:232)
-        fg.current = -1;
-        fg.busy_until = kInf;
-        if (fg.head < fg.fifo.size()) {  // promote FIFO head (:236-252)
-          int nxt = fg.fifo[fg.head++];
-          Task& tn = tasks[nxt];
-          fg.current = nxt;
-          tn.stage = kRunning;
-          tn.t_service_start = t_done;
-          tn.queue_time = t_done - tn.t_q_enter;  // queueTime signal (:238)
-          fg.busy_until = t_done + tn.svc;
-          push(fg.busy_until, kEvRelease, ev.a);
-        }
-        if (adv_on_completion)  // advertiseMIPS() at :254
-          push(t_done + d_bf[ev.a], kEvAdvArrive, ev.a, fg.mips, fg.busy_time);
-        break;
-      }
-    }
-  }
+  long n_events = w.run();
 
   for (int i = 0; i < n_tasks; ++i) {
-    const Task& tk = tasks[i];
+    const Task& tk = w.tasks[i];
     o_t_at_broker[i] = tk.t_at_broker;
     o_fog[i] = tk.fog;
     o_t_at_fog[i] = tk.t_at_fog;
     o_t_service_start[i] = tk.t_service_start;
     o_t_complete[i] = tk.t_complete;
+    o_t_ack3[i] = tk.t_ack3;
     o_t_ack4_fwd[i] = tk.t_ack4_fwd;
     o_t_ack5[i] = tk.t_ack5;
     o_t_ack4_queued[i] = tk.t_ack4_queued;

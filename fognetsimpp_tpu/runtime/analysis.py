@@ -71,14 +71,15 @@ def render_report(results: Dict[str, Dict]) -> str:
                 )}
             )
         )
-        hdr = f"   {'signal':<12}{'n':>6}{'mean':>10}{'min':>10}{'p95':>10}{'max':>10}"
+        hdr = (f"   {'signal':<16}{'n':>7}{'mean':>10}{'min':>10}{'p50':>10}"
+               f"{'p95':>10}{'max':>10}")
         lines.append(hdr)
         for name, s in sorted(entry["signals"].items()):
             if s["n"] == 0:
-                lines.append(f"   {name:<12}{0:>6}")
+                lines.append(f"   {name:<16}{0:>7}")
                 continue
             lines.append(
-                f"   {name:<12}{s['n']:>6}{s['mean']:>10.2f}{s['min']:>10.2f}"
-                f"{s['p95']:>10.2f}{s['max']:>10.2f}"
+                f"   {name:<16}{s['n']:>7}{s['mean']:>10.2f}{s['min']:>10.2f}"
+                f"{s['p50']:>10.2f}{s['p95']:>10.2f}{s['max']:>10.2f}"
             )
     return "\n".join(lines)

@@ -186,6 +186,9 @@ class WorldSpec:
     send_interval_jitter: float = 0.0  # >0 resamples per send (volatile par)
     start_time_min: float = 0.0
     start_time_max: float = 0.0  # sends start uniform in [min, max]
+    send_stop_time: float = float("inf")  # stopTime NED param: publishing
+    #   ceases at this sim time (mqttApp2.cc:191-210; the inis set 300-1000 s,
+    #   beyond every committed horizon, so inf is the faithful default)
     mips_required_min: int = 200  # mqttApp2.cc:370: 200 + rand() % 701
     mips_required_max: int = 900
     required_time: float = 0.01  # mqttApp2.cc:372

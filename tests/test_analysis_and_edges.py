@@ -45,6 +45,20 @@ def test_tiny_queue_drops_counted():
     assert (np.asarray(final.fogs.q_len) <= 2).all()
 
 
+def test_send_stop_time():
+    """stopTime NED param: publishing ceases mid-horizon (mqttApp2.cc:191)."""
+    spec, state, net, bounds = smoke.build(
+        horizon=0.3, send_interval=0.01, send_stop_time=0.1
+    )
+    final, _ = run(spec, state, net, bounds)
+    s = summarize(final)
+    expect = spec.n_users * 0.1 / 0.01
+    assert s["n_published"] <= expect + spec.n_users
+    assert s["n_published"] >= expect - 2 * spec.n_users
+    t_create = np.asarray(final.tasks.t_create)
+    assert t_create[np.isfinite(t_create)].max() < 0.1 + 1e-6
+
+
 def test_coarse_dt_degrades_gracefully():
     """dt 50x the link delay: fidelity drops but conservation holds."""
     spec, state, net, bounds = smoke.build(horizon=0.5, dt=5e-2)
