@@ -940,12 +940,18 @@ def prime_initial_advertisements(
 # ----------------------------------------------------------------------
 
 def make_step(
-    spec: WorldSpec,
+    spec: WorldSpec, with_aux: bool = False
 ) -> Callable[[WorldState, NetParams, MobilityBounds], WorldState]:
-    """Build the jit-compiled single-tick transition for ``spec``."""
+    """Build the jit-compiled single-tick transition for ``spec``.
+
+    ``with_aux=True`` returns ``(state, aux)`` where ``aux`` carries the
+    tick's per-AP association counts — used by the series recorder so the
+    trace reuses the association ``step`` already computed instead of
+    recomputing it per tick.
+    """
     spec.validate()
 
-    def step(state: WorldState, net: NetParams, bounds: MobilityBounds) -> WorldState:
+    def step(state: WorldState, net: NetParams, bounds: MobilityBounds):
         t0 = state.tick.astype(jnp.float32) * spec.dt
         t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
         buf = TickBuf(
@@ -1001,7 +1007,10 @@ def make_step(
                 nodes=state.nodes.replace(energy=energy, alive=alive)
             )
 
-        return state.replace(t=t1, tick=state.tick + 1)
+        state = state.replace(t=t1, tick=state.tick + 1)
+        if with_aux:
+            return state, {"n_assoc": cache.n_assoc}
+        return state
 
     return step
 
@@ -1025,24 +1034,82 @@ def run(
 
         bounds = default_bounds()
     n = spec.n_ticks if n_ticks is None else n_ticks
-    step = make_step(spec)
+    record = spec.record_tick_series
+    step = make_step(spec, with_aux=record)
 
     def body(carry, _):
-        s = step(carry, net, bounds)
-        if spec.record_tick_series:
+        if record:
+            s, aux = step(carry, net, bounds)
             out = {
                 "t": s.t,
                 "busy_time": s.fogs.busy_time,
                 "q_len": s.fogs.q_len,
+                "pool_avail": s.fogs.pool_avail,
                 "n_alive": jnp.sum(s.nodes.alive.astype(jnp.int32)),
                 "energy_mean": jnp.mean(s.nodes.energy),
+                # per-AP station counts: the handover/association trace
+                # (INET's per-NIC association statistics analog), reusing
+                # the tick's own association instead of recomputing it
+                "n_assoc": aux["n_assoc"],
             }
         else:
+            s = step(carry, net, bounds)
             out = None
         return s, out
 
     final, series = jax.lax.scan(body, state, None, length=n)
     return final, series
+
+
+def run_chunked(
+    spec: WorldSpec,
+    state: WorldState,
+    net: NetParams,
+    bounds: Optional[MobilityBounds] = None,
+    chunk_ticks: int = 10_000,
+    callback: Optional[Callable[[WorldState, int], None]] = None,
+) -> WorldState:
+    """Advance an arbitrarily long horizon in fixed-size scan chunks.
+
+    The long axis of this workload is simulated *time* (the SP analog,
+    SURVEY.md §2.3): a compiled ``chunk_ticks``-long scan is reused across
+    chunks (one extra compile for a ragged tail when the horizon is not a
+    multiple; the persistent compilation cache covers repeat calls), so
+    ultra-long horizons run in bounded device memory;
+    ``callback(state, tick)`` runs between chunks for checkpointing or
+    streaming metrics (pairs with
+    :mod:`fognetsimpp_tpu.runtime.checkpoint`).  Bit-identical to one
+    straight scan — the carry is the same pytree either way.
+
+    Per-tick series recording is not supported here (the chunks' series
+    would be silently dropped): record via the callback instead.
+    """
+    if spec.record_tick_series:
+        raise ValueError(
+            "run_chunked does not collect per-tick series; run() per chunk "
+            "or record snapshots via the callback"
+        )
+    if bounds is None:
+        from ..net.mobility import default_bounds
+
+        bounds = default_bounds()
+
+    total = spec.n_ticks
+    chunk = min(chunk_ticks, total)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def go(n, s):
+        final, _ = run(spec, s, net, bounds, n_ticks=n)
+        return final
+
+    done = 0
+    while done < total:
+        n = min(chunk, total - done)
+        state = go(n, state)
+        done += n
+        if callback is not None:
+            callback(state, done)
+    return state
 
 
 @functools.partial(jax.jit, static_argnums=0)

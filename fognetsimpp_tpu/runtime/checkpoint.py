@@ -16,7 +16,7 @@ from typing import Tuple
 import jax
 import numpy as np
 
-from ..spec import BugCompat, WorldSpec
+from ..spec import WorldSpec
 from ..state import WorldState, init_state
 from .recorder import spec_to_dict
 
@@ -33,11 +33,12 @@ def save(path: str, spec: WorldSpec, state: WorldState) -> None:
 
 def load(path: str) -> Tuple[WorldSpec, WorldState]:
     """Rebuild (spec, state) from a :func:`save` file."""
+    from .recorder import dict_to_spec
+
     with np.load(path) as z:
         spec_d = json.loads(bytes(z["spec_json"]).decode())
         leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
-    spec_d["bug_compat"] = BugCompat(**spec_d["bug_compat"])
-    spec = WorldSpec(**spec_d).validate()
+    spec = dict_to_spec(spec_d)
     skeleton = init_state(spec)
     treedef = jax.tree.structure(skeleton)
     state = jax.tree.unflatten(

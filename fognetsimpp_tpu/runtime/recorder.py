@@ -32,7 +32,31 @@ from .signals import extract_signals, summarize
 
 
 def spec_to_dict(spec: WorldSpec) -> Dict:
-    return dataclasses.asdict(spec)  # recurses into BugCompat
+    """JSON-safe spec dict: non-finite floats become the string "inf".
+
+    ``json.dump`` would otherwise emit the non-standard ``Infinity`` token
+    (invalid per RFC 8259) for fields like ``send_stop_time``;
+    :func:`dict_to_spec` reverses the encoding.
+    """
+    d = dataclasses.asdict(spec)  # recurses into BugCompat
+    for k, v in d.items():
+        if isinstance(v, float) and not np.isfinite(v):
+            d[k] = "inf" if v > 0 else "-inf"
+    return d
+
+
+def dict_to_spec(d: Dict) -> WorldSpec:
+    """Inverse of :func:`spec_to_dict`."""
+    from ..spec import BugCompat
+
+    d = dict(d)
+    for k, v in d.items():
+        if v == "inf":
+            d[k] = float("inf")
+        elif v == "-inf":
+            d[k] = float("-inf")
+    d["bug_compat"] = BugCompat(**d["bug_compat"])
+    return WorldSpec(**d).validate()
 
 
 def record_run(

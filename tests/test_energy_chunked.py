@@ -1,0 +1,97 @@
+"""Exact energy accounting (the ≤1% energy criterion) + chunked horizons."""
+import jax.numpy as jnp
+import numpy as np
+
+from fognetsimpp_tpu import run
+from fognetsimpp_tpu.core.engine import run_chunked
+from fognetsimpp_tpu.runtime import summarize
+from fognetsimpp_tpu.scenarios import smoke
+
+
+def test_energy_matches_exact_message_accounting():
+    """Per-node drain == idle·t + tx_J·sent + rx_J·received, exactly.
+
+    The BASELINE criterion is energy within 1% of the event-driven
+    baseline; since both models drain per message, agreement reduces to
+    message-count accounting, which this pins to machine precision for a
+    single-user world (totals == that user's counts).
+    """
+    spec, state, net, bounds = smoke.build(
+        horizon=0.5,
+        send_interval=0.05,
+        n_users=1,
+        n_fogs=2,
+        energy_enabled=True,
+        energy_capacity_j=1000.0,  # far from both clamps
+        idle_power_w=2e-3,
+        tx_energy_j=2e-4,
+        rx_energy_j=1e-4,
+        harvest_power_w=0.0,
+        shutdown_frac=0.0,  # never dies
+    )
+    # only the user participates in the energy model
+    has = np.zeros((spec.n_nodes,), bool)
+    has[0] = True
+    state = state.replace(
+        nodes=state.nodes.replace(has_energy=jnp.asarray(has))
+    )
+    final, _ = run(spec, state, net, bounds)
+
+    t = final.tasks
+
+    def fin(col):
+        return int(np.isfinite(np.asarray(col)).sum())
+
+    n_pub = int(np.asarray(final.metrics.n_published))
+    n_subs = int(np.asarray(final.users.sub_mask).sum())
+    n_tx = 1 + n_subs + n_pub  # Connect + Subscribes + Publishes
+    n_rx = (
+        1 + n_subs  # Connack + Subacks
+        + fin(t.t_ack3) + fin(t.t_ack4_fwd) + fin(t.t_ack4_queued)
+        + fin(t.t_ack5) + fin(t.t_ack6)  # every ack is one receive
+        + int(np.asarray(final.users.n_delivered).sum())
+    )
+    expected = (
+        1000.0
+        - 2e-3 * spec.horizon
+        - 2e-4 * n_tx
+        - 1e-4 * n_rx
+    )
+    got = float(np.asarray(final.nodes.energy)[0])
+    assert abs(got - expected) < 1e-3, (got, expected, n_tx, n_rx)
+
+
+def test_run_chunked_bit_identical():
+    spec, state, net, bounds = smoke.build(horizon=0.4)
+    straight, _ = run(spec, state, net, bounds)
+    chunked = run_chunked(spec, state, net, bounds, chunk_ticks=150)
+    for name in ("t_create", "t_ack6", "mips_req", "stage"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(straight.tasks, name)),
+            np.asarray(getattr(chunked.tasks, name)),
+            err_msg=name,
+        )
+    assert int(straight.metrics.n_completed) == int(chunked.metrics.n_completed)
+
+
+def test_run_chunked_callback_checkpoints(tmp_path):
+    from fognetsimpp_tpu.runtime import checkpoint
+
+    spec, state, net, bounds = smoke.build(horizon=0.4)
+    saved = []
+
+    def cb(s, tick):
+        p = str(tmp_path / f"ck_{tick}.npz")
+        checkpoint.save(p, spec, s)
+        saved.append((tick, p))
+
+    final = run_chunked(spec, state, net, bounds, chunk_ticks=200, callback=cb)
+    assert [t for t, _ in saved] == [200, 400]
+    # resuming from the mid-run checkpoint reproduces the final state
+    spec2, mid = checkpoint.load(saved[0][1])
+    resumed, _ = run(spec2, mid, net, bounds, n_ticks=200)
+    np.testing.assert_array_equal(
+        np.asarray(final.tasks.t_ack6), np.asarray(resumed.tasks.t_ack6)
+    )
+    s = summarize(final)
+    assert s["n_published"] > 0
