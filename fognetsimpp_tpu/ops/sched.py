@@ -10,12 +10,16 @@ same-window arrivals all see the same snapshot there too.
 
 Policies beyond MIN_BUSY realise the dead ``algo`` parameter
 (``BrokerBaseApp3.ned:26``, SURVEY.md App. B item 4) as live kernels; they
-share the same signature so the policy axis is sweepable (vmap/pjit over
-policy ids — SURVEY.md §2.3 "expert parallelism" row).
+share the same signature so the policy axis is sweepable.  With
+``policy=Policy.DYNAMIC`` the argmin-family policy is selected by the
+*traced* ``policy_id`` value (``lax.switch``), so a whole policy × load ×
+replica grid runs under ONE compile — the EP axis as data
+(SURVEY.md §2.3 EP row; vmap turns the switch into a masked select over
+branches, trading a few extra scheduler kernels for zero recompiles).
 """
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +40,7 @@ def _safe_div(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 def schedule_batch(
-    policy: int,  # static
+    policy: int,  # static; Policy.DYNAMIC dispatches on policy_id instead
     mask: jax.Array,  # (T,) bool — publishes being decided this tick
     mips_req: jax.Array,  # (T,) f32
     view_busy: jax.Array,  # (F,) f32 broker's stale busyTime view
@@ -49,6 +53,7 @@ def schedule_batch(
     key: jax.Array,  # PRNG key for RANDOM
     mips0_divisor: bool,  # static bug-compat switch (SURVEY App. B item 1)
     v1_max_scan: bool = True,  # static bug-compat switch (MAX_MIPS scan)
+    policy_id: Optional[jax.Array] = None,  # () i32, traced (DYNAMIC only)
 ) -> Tuple[jax.Array, jax.Array]:
     """Pick a fog node for every masked task. Returns ((T,) i32 fog, rr').
 
@@ -87,17 +92,21 @@ def schedule_batch(
                 jnp.int32
             )
         return jnp.where(mask, winner, -1).astype(jnp.int32), rr_cursor
-    if policy == int(Policy.MIN_BUSY):
-        scores = view_busy[None, :] + est
-    elif policy == int(Policy.MIN_LATENCY):
-        scores = rtt_broker_fog[None, :] + view_busy[None, :] + est
-    elif policy == int(Policy.ENERGY_AWARE):
-        # prefer energy-rich fogs; dead fogs are unusable (when every fog is
-        # dead the all-masked argmin would silently pick fog 0 — guard below
-        # returns -1 so the caller routes these to Stage.NO_RESOURCE)
-        scores = view_busy[None, :] + est + 10.0 * (1.0 - fog_energy_frac)[None, :]
-        avail = avail & fog_alive
-    elif policy == int(Policy.ROUND_ROBIN):
+
+    def from_scores(scores, avail_):
+        scores = jnp.where(avail_[None, :], scores, _BIG)
+        # all-inf rows (early publishes before any advertisement, with the
+        # MIPS=0 registration) must still pick fog 0, like the C++ `<` scan
+        scores = jnp.nan_to_num(scores, posinf=_BIG)
+        choice = jnp.argmin(scores, axis=1).astype(jnp.int32)
+        # no available fog at all -> -1 (caller routes to Stage.NO_RESOURCE)
+        choice = jnp.where(jnp.any(avail_), choice, -1)
+        return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
+
+    def b_min_busy():
+        return from_scores(view_busy[None, :] + est, avail)
+
+    def b_round_robin():
         # k-th masked task of this tick gets fog (rr + k) % F among avail
         k = jnp.cumsum(mask.astype(jnp.int32)) - 1  # rank within batch
         n_avail = jnp.maximum(jnp.sum(avail.astype(jnp.int32)), 1)
@@ -108,25 +117,49 @@ def schedule_batch(
             jnp.where(avail, avail_rank, F)
         ].set(jnp.arange(F, dtype=jnp.int32), mode="drop")
         choice = fog_of_slot[slot]
+        choice = jnp.where(jnp.any(avail), choice, -1)
         rr_new = (rr_cursor + jnp.sum(mask.astype(jnp.int32))) % n_avail
         return jnp.where(mask, choice, -1).astype(jnp.int32), rr_new
-    elif policy == int(Policy.RANDOM):
+
+    def b_min_latency():
+        return from_scores(
+            rtt_broker_fog[None, :] + view_busy[None, :] + est, avail
+        )
+
+    def b_energy_aware():
+        # prefer energy-rich fogs; dead fogs are unusable (when every fog is
+        # dead the all-masked argmin would silently pick fog 0 — the guard
+        # in from_scores returns -1 so the caller routes to NO_RESOURCE)
+        scores = (
+            view_busy[None, :] + est
+            + 10.0 * (1.0 - fog_energy_frac)[None, :]
+        )
+        return from_scores(scores, avail & fog_alive)
+
+    def b_random():
         ok = avail & fog_alive
         logits = jnp.where(ok, 0.0, -jnp.inf)
         # all -inf logits make categorical undefined: guard with -1
-        choice = jax.random.categorical(key, logits, shape=(T,))
+        choice = jax.random.categorical(key, logits, shape=(T,)).astype(
+            jnp.int32
+        )
         choice = jnp.where(jnp.any(ok), choice, -1)
         return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
-    else:
-        raise ValueError(f"unknown policy {policy}")
 
-    scores = jnp.where(avail[None, :], scores, _BIG)
-    # all-inf rows (early publishes before any advertisement, with the
-    # MIPS=0 registration) must still pick fog 0, like the C++ `<` scan
-    scores = jnp.nan_to_num(scores, posinf=_BIG)
-    choice = jnp.argmin(scores, axis=1).astype(jnp.int32)
-    # no available fog at all -> -1 (caller routes to Stage.NO_RESOURCE);
-    # matters for ENERGY_AWARE, where avail can be empty while registered
-    # fogs exist (all dead)
-    choice = jnp.where(jnp.any(avail), choice, -1)
-    return jnp.where(mask, choice, -1), rr_cursor
+    branches = {
+        int(Policy.MIN_BUSY): b_min_busy,
+        int(Policy.ROUND_ROBIN): b_round_robin,
+        int(Policy.MIN_LATENCY): b_min_latency,
+        int(Policy.ENERGY_AWARE): b_energy_aware,
+        int(Policy.RANDOM): b_random,
+    }
+    if policy == int(Policy.DYNAMIC):
+        if policy_id is None:
+            raise ValueError("Policy.DYNAMIC needs a traced policy_id")
+        ordered = [branches[p] for p in range(5)]  # ids 0..4 by enum value
+        return jax.lax.switch(
+            jnp.clip(policy_id, 0, 4).astype(jnp.int32), ordered
+        )
+    if policy not in branches:
+        raise ValueError(f"unknown policy {policy}")
+    return branches[policy]()

@@ -1,17 +1,20 @@
 """Policy × load sweep driver (the EP axis of SURVEY.md §2.3).
 
 Reproduces the shape of the BASELINE.json sweep configs ("10k nodes × 4
-schedulers × 256 load levels"): the *policy* axis is static (each policy is
-a different compiled branch — one compile per policy, reused across all
-loads), while the *load* axis is dynamic — the per-user publish interval is
-a state array (``users.send_interval``, the reference's volatile
-``sendInterval`` NED parameter), so every load level × Monte-Carlo replica
-runs inside one ``vmap`` and shards over the mesh with zero extra compiles.
+schedulers × 256 load levels").  The *load* axis is always dynamic — the
+per-user publish interval is a state array (``users.send_interval``, the
+reference's volatile ``sendInterval`` NED parameter), so every load level
+× Monte-Carlo replica runs inside one ``vmap``.  The *policy* axis has two
+modes: static (one compile per policy — any policy, incl. LOCAL_FIRST/
+MAX_MIPS) or ``dynamic=True`` (``Policy.DYNAMIC``: the policy id rides in
+``BrokerView.policy_id`` as traced data, so the ENTIRE grid is one
+compile; argmin family only).  Either way the grid shards over the mesh.
 """
 from __future__ import annotations
 
 from typing import Callable, Dict, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
@@ -28,6 +31,7 @@ def sweep_policies(
     seed: int = 0,
     mesh: Optional[Mesh] = None,
     n_ticks: Optional[int] = None,
+    dynamic: bool = False,
     **build_kwargs,
 ) -> Dict[int, Dict[str, np.ndarray]]:
     """Run every (policy, load, replica) combination; return counter grids.
@@ -36,33 +40,84 @@ def sweep_policies(
     accepting ``policy=`` and returning ``(spec, state, net, bounds)``.
     ``load_intervals`` are publish intervals in seconds (smaller = heavier).
 
+    ``dynamic=True`` runs the whole grid under ONE compile: the world is
+    built with ``Policy.DYNAMIC`` and each replica carries its policy id as
+    data (argmin-family policies 0-4 only).  The static path compiles one
+    program per policy — prefer it when a policy outside that family is in
+    the grid.
+
     Returns ``{policy: {counter: (n_loads, n_replicas) array}}``.
     """
     n_loads = len(load_intervals)
-    R = n_loads * n_replicas_per_load
-    out: Dict[int, Dict[str, np.ndarray]] = {}
     # Build the world for the HEAVIEST load level so capacity-derived shapes
     # (max_sends_per_user, arrival_window) fit every level; lighter levels
     # just publish less.  Overriding send_interval only post-build would
     # silently cap heavy loads at the light-load send budget.
     build_kwargs.setdefault("send_interval", min(load_intervals))
+
+    def load_axis(batch, spec, R):
+        si = jnp.tile(
+            jnp.repeat(
+                jnp.asarray(load_intervals, jnp.float32), n_replicas_per_load
+            ),
+            R // (n_loads * n_replicas_per_load),
+        )  # (R,)
+        return batch.replace(
+            users=batch.users.replace(
+                send_interval=jnp.broadcast_to(si[:, None], (R, spec.n_users))
+            )
+        )
+
+    def advance(spec, batch, net, bounds):
+        if mesh is not None:
+            return run_sharded(spec, batch, net, bounds, mesh, n_ticks=n_ticks)
+        return run_replicated(spec, batch, net, bounds, n_ticks=n_ticks)
+
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    if dynamic:
+        from ..spec import Policy
+
+        if any(not 0 <= int(p) <= 4 for p in policies):
+            raise ValueError(
+                "dynamic sweeps cover the argmin family (policy ids 0-4)"
+            )
+        spec, state, net, bounds = build(
+            policy=int(Policy.DYNAMIC), **build_kwargs
+        )
+        P = len(policies)
+        nlr = n_loads * n_replicas_per_load
+        R = P * nlr
+        # one nlr-wide replica block, tiled per policy: every policy sees
+        # the SAME per-replica PRNG keys/start times a static per-policy
+        # sweep would use, so dynamic == static exactly
+        base = replicate_state(spec, state, nlr, seed=seed)
+        batch = jax.tree.map(
+            lambda x: jnp.concatenate([x] * P, axis=0), base
+        )
+        # replica order: (policy, load, rep); the load axis tiles per policy
+        pol_ids = jnp.repeat(
+            jnp.asarray([int(p) for p in policies], jnp.int32), nlr
+        )
+        batch = batch.replace(
+            broker=batch.broker.replace(policy_id=pol_ids)
+        )
+        batch = load_axis(batch, spec, R)
+        final = advance(spec, batch, net, bounds)
+        counters = replica_counters(final)
+        for i, pol in enumerate(policies):
+            sl = slice(i * nlr, (i + 1) * nlr)
+            out[int(pol)] = {
+                k: v[sl].reshape(n_loads, n_replicas_per_load)
+                for k, v in counters.items()
+            }
+        return out
+
+    R = n_loads * n_replicas_per_load
     for pol in policies:
         spec, state, net, bounds = build(policy=int(pol), **build_kwargs)
         batch = replicate_state(spec, state, R, seed=seed)
-        si = jnp.repeat(
-            jnp.asarray(load_intervals, jnp.float32), n_replicas_per_load
-        )  # (R,)
-        batch = batch.replace(
-            users=batch.users.replace(
-                send_interval=jnp.broadcast_to(
-                    si[:, None], (R, spec.n_users)
-                )
-            )
-        )
-        if mesh is not None:
-            final = run_sharded(spec, batch, net, bounds, mesh, n_ticks=n_ticks)
-        else:
-            final = run_replicated(spec, batch, net, bounds, n_ticks=n_ticks)
+        batch = load_axis(batch, spec, R)
+        final = advance(spec, batch, net, bounds)
         out[int(pol)] = {
             k: v.reshape(n_loads, n_replicas_per_load)
             for k, v in replica_counters(final).items()

@@ -90,6 +90,9 @@ class Policy(enum.IntEnum):
     MAX_MIPS = 6  # v1/v2 offload pick: the buggy "max MIPS" scan that
     #               compares every candidate to brokers[0]
     #               (BrokerBaseApp.cc:228-240; see BugCompat.v1_max_scan)
+    DYNAMIC = 7  # policy chosen by the *traced* BrokerView.policy_id
+    #              (ids 0-4, the argmin family): one compile covers a whole
+    #              policy x load x replica sweep grid (EP axis as data)
 
 
 class FogModel(enum.IntEnum):

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from .spec import NodeKind, Stage, WorldSpec
+from .spec import NodeKind, Policy, Stage, WorldSpec
 
 # Sentinel for "no task": valid task ids are [0, T).
 NO_TASK = -1
@@ -119,6 +119,9 @@ class BrokerView:
     adv_arrive_t: jax.Array  # (F,) f32 arrival time (+inf = none in flight)
     rr_next: jax.Array  # () i32 round-robin cursor (Policy.ROUND_ROBIN)
     local_pool: jax.Array  # () f32 broker's own MIPS pool (v1 LOCAL_FIRST)
+    policy_id: jax.Array  # () i32 — the live policy under Policy.DYNAMIC
+    #   (ids 0-4; ignored otherwise).  Traced, so replicas in one vmap can
+    #   each run a different scheduler (single-compile EP sweeps).
 
 
 @struct.dataclass
@@ -276,6 +279,10 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         adv_arrive_t=jnp.full((F,), jnp.inf, f32),
         rr_next=jnp.zeros((), jnp.int32),
         local_pool=jnp.asarray(spec.broker_mips, f32),
+        policy_id=jnp.asarray(
+            0 if spec.policy == int(Policy.DYNAMIC) else spec.policy,
+            jnp.int32,
+        ),
     )
 
     tasks = TaskState(

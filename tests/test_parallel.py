@@ -135,3 +135,56 @@ def test_sweep_policies(world):
         # heavier load (shorter interval) publishes strictly more
         assert (grid["n_published"][1] > grid["n_published"][0]).all(), pol
         assert (grid["n_scheduled"] > 0).all()
+
+
+def test_dynamic_policy_matches_static():
+    """Policy.DYNAMIC (policy as traced data) == the static compile."""
+    import jax.numpy as jnp
+
+    from fognetsimpp_tpu.core.engine import run as run_engine
+
+    for pol in (Policy.MIN_BUSY, Policy.ROUND_ROBIN):
+        spec_s, state_s, net, bounds = smoke.build(
+            horizon=HORIZON, policy=int(pol), start_time_max=0.05
+        )
+        want, _ = run_engine(spec_s, state_s, net, bounds)
+        spec_d, state_d, net_d, bounds_d = smoke.build(
+            horizon=HORIZON, policy=int(Policy.DYNAMIC), start_time_max=0.05
+        )
+        state_d = state_d.replace(
+            broker=state_d.broker.replace(
+                policy_id=jnp.asarray(int(pol), jnp.int32)
+            )
+        )
+        got, _ = run_engine(spec_d, state_d, net_d, bounds_d)
+        np.testing.assert_array_equal(
+            np.asarray(want.tasks.fog), np.asarray(got.tasks.fog), err_msg=pol
+        )
+        np.testing.assert_array_equal(
+            np.asarray(want.tasks.t_ack6), np.asarray(got.tasks.t_ack6)
+        )
+
+
+def test_sweep_dynamic_single_compile_matches_static():
+    static = sweep_policies(
+        smoke.build,
+        policies=[int(Policy.MIN_BUSY), int(Policy.MIN_LATENCY)],
+        load_intervals=[0.05, 0.02],
+        n_replicas_per_load=2,
+        horizon=HORIZON,
+        start_time_max=0.05,
+    )
+    dynamic = sweep_policies(
+        smoke.build,
+        policies=[int(Policy.MIN_BUSY), int(Policy.MIN_LATENCY)],
+        load_intervals=[0.05, 0.02],
+        n_replicas_per_load=2,
+        horizon=HORIZON,
+        start_time_max=0.05,
+        dynamic=True,
+    )
+    for pol in static:
+        for k in ("n_published", "n_scheduled", "n_completed"):
+            np.testing.assert_array_equal(
+                static[pol][k], dynamic[pol][k], err_msg=f"{pol}:{k}"
+            )

@@ -115,6 +115,30 @@ def test_parity_under_queueing():
     assert rel.max() < 0.01
 
 
+def test_parity_fixed_bug_modes():
+    """Both simulators honour the repaired-bug switches identically
+    (per-candidate MIPS divisor, true-argmax offload scan)."""
+    from fognetsimpp_tpu.spec import BugCompat
+
+    spec, state, net, bounds = smoke.build(
+        horizon=1.0,
+        send_interval=0.05,
+        dt=1e-4,
+        n_users=2,
+        n_fogs=2,
+        fog_mips=(20000.0, 30000.0),
+        start_time_max=0.02,
+        bug_compat=BugCompat(mips0_divisor=False, v1_max_scan=False),
+    )
+    final, _ = run(spec, state, net, bounds)
+    des, used = bridge.replay_engine_world(spec, final, net)
+    np.testing.assert_array_equal(np.asarray(final.tasks.fog)[used], des["fog"])
+    e = _eng(final, used, "t_ack6")
+    both = np.isfinite(e) & np.isfinite(des["t_ack6"])
+    assert both.sum() >= 20
+    np.testing.assert_allclose(e[both], des["t_ack6"][both], rtol=1e-5)
+
+
 def test_parity_v1_local_first():
     """v1 generation: LOCAL_FIRST pool debits, the buggy MAX_MIPS offload
     scan, pool fogs, TaskAck-dropped completions — vs the native DES."""
