@@ -13,7 +13,7 @@ JSON line per config:
 Measured results are recorded in BENCHMARKS.md.  Each config times the
 second invocation of the jitted program (compile excluded).
 
-Run: ``python benchmarks.py [2 3 4 5]``
+Run: ``python benchmarks.py [2 3 4 5 5b]``
 """
 from __future__ import annotations
 
@@ -124,8 +124,12 @@ def config4():
            "alive_min": int(np.asarray(f.nodes.alive).sum(-1).min())})
 
 
-def config5():
-    """4 schedulers x 16 load levels (EP x load sweep)."""
+def config5(dynamic: bool = False):
+    """4 schedulers x 16 load levels (EP x load sweep).
+
+    ``dynamic=True`` (config "5b") runs the whole grid under one compile
+    via Policy.DYNAMIC.
+    """
     import numpy as np
 
     from fognetsimpp_tpu.parallel import sweep_policies
@@ -143,22 +147,29 @@ def config5():
         policies=policies,
         load_intervals=loads,
         n_replicas_per_load=n_rep,
+        dynamic=dynamic,
         n_users=256, n_fogs=8, horizon=horizon, dt=dt,
         arrival_window=512, start_time_max=0.05,
     )
-    wall = time.perf_counter() - t0  # includes the per-policy compiles
+    wall = time.perf_counter() - t0  # includes the compile(s)
     decisions = sum(int(g["n_scheduled"].sum()) for g in grids.values())
     n_ticks = int(round(horizon / dt)) * len(policies) * len(loads) * n_rep
-    _emit("5:policy-x-load-sweep", wall, decisions, n_ticks,
+    name = "5b:policy-sweep-dynamic" if dynamic else "5:policy-x-load-sweep"
+    note = ("wall includes ONE whole-grid compile (Policy.DYNAMIC)"
+            if dynamic else
+            f"wall includes {len(policies)} policy compiles")
+    _emit(name, wall, decisions, n_ticks,
           {"grid": f"{len(policies)} policies x {len(loads)} loads x "
                    f"{n_rep} replicas",
-           "note": f"wall includes {len(policies)} policy compiles"})
+           "note": note})
 
 
 if __name__ == "__main__":
     from fognetsimpp_tpu.compile_cache import enable_compile_cache
 
     enable_compile_cache()
-    which = [int(a) for a in sys.argv[1:]] or [2, 3, 4, 5]
+    table = {"2": config2, "3": config3, "4": config4, "5": config5,
+             "5b": lambda: config5(dynamic=True)}
+    which = sys.argv[1:] or ["2", "3", "4", "5"]
     for n in which:
-        {2: config2, 3: config3, 4: config4, 5: config5}[n]()
+        table[n]()

@@ -226,7 +226,7 @@ def _phase_spawn(
         # after stopTime cannot publish
         due = due & (t_create < spec.send_stop_time)
 
-    key, k_mips, k_jit = jax.random.split(state.key, 3)
+    key, k_mips, k_jit, k_loss = jax.random.split(state.key, 4)
     if spec.fixed_mips_required is not None:
         mips_req = jnp.full((U,), float(spec.fixed_mips_required), jnp.float32)
     else:
@@ -248,12 +248,29 @@ def _phase_spawn(
             jnp.float32
         ) * jnp.float32(spec.link_drain_s)
         t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
+    # wireless uplink loss (MAC retry exhaustion): the publish is sent and
+    # costs tx energy, but never reaches the broker (spec.uplink_loss_prob).
+    # Packets buffered during the link warm-up deliver reliably once the
+    # link is up (the committed demo trace loses only steady-state packets)
+    lost = jnp.zeros((U,), bool)
+    if spec.uplink_loss_prob > 0:
+        lost = (
+            jax.random.bernoulli(k_loss, spec.uplink_loss_prob, (U,))
+            & net.is_wireless[uidx]
+        )
+        if spec.link_up_s > 0:
+            lost = lost & (t_create + d_ub >= spec.link_up_s)
+    stage_new = jnp.where(
+        lost, jnp.int8(int(Stage.LOST)), jnp.int8(int(Stage.PUB_INFLIGHT))
+    )
     tasks = tasks.replace(
-        stage=tasks.stage.at[slot].set(jnp.int8(int(Stage.PUB_INFLIGHT)), mode="drop"),
+        stage=tasks.stage.at[slot].set(stage_new, mode="drop"),
         topic=tasks.topic.at[slot].set(users.pub_topic, mode="drop"),
         mips_req=scat(tasks.mips_req, mips_req),
         t_create=scat(tasks.t_create, t_create),
-        t_at_broker=scat(tasks.t_at_broker, t_arrive),
+        t_at_broker=tasks.t_at_broker.at[slot].set(
+            jnp.where(lost, jnp.inf, t_arrive), mode="drop"
+        ),
     )
     interval = users.send_interval
     if spec.send_interval_jitter > 0:
@@ -266,7 +283,9 @@ def _phase_spawn(
         send_count=jnp.where(due, users.send_count + 1, users.send_count),
     )
     metrics = state.metrics.replace(
-        n_published=state.metrics.n_published + jnp.sum(due.astype(jnp.int32))
+        n_published=state.metrics.n_published + jnp.sum(due.astype(jnp.int32)),
+        n_lost=state.metrics.n_lost
+        + jnp.sum((due & lost).astype(jnp.int32)),
     )
     buf = buf._replace(tx=buf.tx.at[uidx].add(due.astype(jnp.int32)))
     return state.replace(users=users, tasks=tasks, metrics=metrics, key=key), buf

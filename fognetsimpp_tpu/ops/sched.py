@@ -156,10 +156,17 @@ def schedule_batch(
     if policy == int(Policy.DYNAMIC):
         if policy_id is None:
             raise ValueError("Policy.DYNAMIC needs a traced policy_id")
-        ordered = [branches[p] for p in range(5)]  # ids 0..4 by enum value
-        return jax.lax.switch(
-            jnp.clip(policy_id, 0, 4).astype(jnp.int32), ordered
-        )
+
+        def b_invalid():
+            # out-of-family id: fail loudly — nothing schedules (all
+            # NO_RESOURCE) instead of silently running a remapped policy
+            return jnp.full((T,), -1, jnp.int32), rr_cursor
+
+        ordered = [branches[p] for p in range(5)] + [b_invalid]
+        idx = jnp.where(
+            (policy_id < 0) | (policy_id > 4), 5, policy_id
+        ).astype(jnp.int32)
+        return jax.lax.switch(idx, ordered)
     if policy not in branches:
         raise ValueError(f"unknown policy {policy}")
     return branches[policy]()

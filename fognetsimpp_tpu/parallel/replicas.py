@@ -103,5 +103,7 @@ def replica_counters(final_batch: WorldState) -> Dict[str, np.ndarray]:
             "n_connected",
             "n_rejected",
             "n_local",
+            "n_lost",
+            "n_adverts",
         )
     }

@@ -76,6 +76,7 @@ def summarize(final: WorldState) -> Dict[str, float]:
         n_rejected=int(m.n_rejected),
         n_local=int(m.n_local),
         n_adverts=int(m.n_adverts),
+        n_lost=int(m.n_lost),
     )
     for name, v in sig.items():
         out[f"{name}_n"] = int(v.size)

@@ -68,6 +68,9 @@ class Stage(enum.IntEnum):
     #               TaskAck, BrokerBaseApp2.cc:139-141, so the task dies) or
     #               the v1 offload scan found no fog with MIPS > required
     #               (BrokerBaseApp.cc:244 guard: nothing is sent at all)
+    LOST = 10  # publish lost on the wireless uplink (MAC retry exhaustion:
+    #            the reference's demo run records only 52 of 67 sent —
+    #            simulations/example/results/General-0.sca sentPk vs n)
 
 
 class Policy(enum.IntEnum):
@@ -210,6 +213,13 @@ class WorldSpec:
     # arrivals wait a tick).  See _phase_pool_arrivals.
     pool_phases: int = 4
 
+    # --- wireless uplink loss ------------------------------------------
+    # Probability a publish is lost before reaching the broker (802.11 MAC
+    # retry exhaustion, emergent in INET; e.g. the committed demo run loses
+    # 15 of 67 publishes).  Applied per publish via the kernel PRNG; lost
+    # tasks enter Stage.LOST and are counted in metrics.n_lost.
+    uplink_loss_prob: float = 0.0
+
     # --- link warm-up (INET ARP/802.11-association transient) ----------
     # In every committed reference wireless run the first ~1 s of uplink
     # packets buffer below the app while ARP + association resolve, then
@@ -295,6 +305,9 @@ class WorldSpec:
         assert self.max_sends_per_user > 0 and self.queue_capacity > 0
         assert self.dt > 0 and self.horizon > 0
         assert self.n_topics >= 1 and self.pool_phases >= 1
+        assert 0.0 <= self.uplink_loss_prob <= 1.0, (
+            f"uplink_loss_prob is a probability, got {self.uplink_loss_prob}"
+        )
         if self.arrival_window is not None:
             assert self.arrival_window > 0
         if self.policy == int(Policy.LOCAL_FIRST):

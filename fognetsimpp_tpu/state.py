@@ -176,6 +176,7 @@ class Metrics:
     n_adverts: jax.Array  # () i32 FognetMsgAdvertiseMIPS delivered to the
     #                        broker (latest-wins slot: superseded in-flight
     #                        adverts are merged, as in BrokerView)
+    n_lost: jax.Array  # () i32 publishes lost on the wireless uplink
 
 
 @struct.dataclass
@@ -317,6 +318,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         n_rejected=jnp.zeros((), jnp.int32),
         n_local=jnp.zeros((), jnp.int32),
         n_adverts=jnp.zeros((), jnp.int32),
+        n_lost=jnp.zeros((), jnp.int32),
     )
 
     return WorldState(

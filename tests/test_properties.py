@@ -14,7 +14,8 @@ from fognetsimpp_tpu.core.engine import prime_initial_advertisements
 from fognetsimpp_tpu.runtime import summarize
 from fognetsimpp_tpu.scenarios import smoke
 
-TERMINAL = (Stage.DONE, Stage.NO_RESOURCE, Stage.DROPPED, Stage.REJECTED)
+TERMINAL = (Stage.DONE, Stage.NO_RESOURCE, Stage.DROPPED, Stage.REJECTED,
+            Stage.LOST)
 IN_FLIGHT = (Stage.PUB_INFLIGHT, Stage.TASK_INFLIGHT, Stage.QUEUED,
              Stage.RUNNING, Stage.LOCAL_RUN)
 

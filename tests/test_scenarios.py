@@ -14,7 +14,8 @@ from fognetsimpp_tpu.net.topology import associate
 from fognetsimpp_tpu.runtime import extract_signals, summarize
 from fognetsimpp_tpu.scenarios import example, wireless
 
-TERMINAL = (Stage.DONE, Stage.NO_RESOURCE, Stage.DROPPED, Stage.REJECTED)
+TERMINAL = (Stage.DONE, Stage.NO_RESOURCE, Stage.DROPPED, Stage.REJECTED,
+            Stage.LOST)
 IN_FLIGHT = (Stage.PUB_INFLIGHT, Stage.TASK_INFLIGHT, Stage.QUEUED,
              Stage.RUNNING, Stage.LOCAL_RUN)
 
@@ -107,20 +108,29 @@ def test_paper_topology():
 def test_example_matches_committed_trace():
     """The shipped demo analog vs simulations/example/results/General-0.vec.
 
-    Committed delay vector (1093): mean 0.502, min 0.401, max 0.9814
-    (n=52 of 67 sent; the engine models no packet loss, so every publish
-    yields a sample).
+    Committed ground truth: 67 publishes sent, 52 delay samples recorded
+    (15 lost to MAC retries), delay mean 0.502 / min 0.401 / max 0.9814.
+    With the calibrated warm-up + steady transit + uplink-loss model the
+    default-seed run reproduces all four statistics.
     """
     spec, state, net, bounds = example.build()
     final, _ = run(spec, state, net, bounds)
     sig = extract_signals(final)
     d = sig["delay"] / 1e3  # ms -> s
-    assert d.size >= 52
-    assert abs(d.mean() - 0.502) < 0.01, d.mean()
+    s = summarize(final)
+    assert s["n_published"] == 66  # 67 in the 3.35 s reference run
+    assert d.size == 52  # exactly the committed sample count
+    assert s["n_lost"] == 14
+    assert abs(d.mean() - 0.502) < 0.005, d.mean()
     assert abs(d.min() - 0.401) < 0.005, d.min()
     assert abs(d.max() - 0.9814) < 0.005, d.max()
     # v2 semantics actually exercised: pool fogs completed tasks at
     # requiredTime expiry and acked status 6
-    s = summarize(final)
-    assert s["n_completed"] > 40
-    assert np.isfinite(sig["task_time"]).all() and sig["task_time"].size > 40
+    assert s["n_completed"] > 35
+    assert np.isfinite(sig["task_time"]).all() and sig["task_time"].size > 35
+    # other seeds stay within binomial noise of the trace
+    spec2, state2, net2, bounds2 = example.build(seed=3)
+    final2, _ = run(spec2, state2, net2, bounds2)
+    d2 = extract_signals(final2)["delay"] / 1e3
+    assert 44 <= d2.size <= 60
+    assert abs(d2.mean() - 0.502) < 0.02
