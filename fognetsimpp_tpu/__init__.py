@@ -29,6 +29,7 @@ from .core.engine import (  # noqa: F401
     make_step,
     prime_initial_advertisements,
     run,
+    run_chunked,
     run_jit,
 )
 

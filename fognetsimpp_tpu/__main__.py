@@ -38,6 +38,10 @@ def main(argv=None) -> int:
                     help="defaults to config output.run_id, else General-0")
     ap.add_argument("--ticks", action="store_true",
                     help="record per-tick series vectors")
+    ap.add_argument("--progress", type=int, metavar="N", default=None,
+                    help="run in N-tick chunks, printing a progress line "
+                    "per chunk (the Cmdenv status-line analog; excludes "
+                    "--ticks)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (cpu/tpu)")
     ap.add_argument("--analyze", metavar="DIR", default=None,
@@ -81,7 +85,27 @@ def main(argv=None) -> int:
 
     spec, state, net, bounds = build_from_config(cfg, seed=args.seed)
     t0 = time.perf_counter()
-    final, series = run(spec, state, net, bounds)
+    if args.progress:
+        if args.ticks:
+            ap.error("--progress and --ticks are mutually exclusive "
+                     "(chunked runs record via snapshots, not series)")
+        from .core.engine import run_chunked
+        from .runtime.signals import summarize as _sumz
+
+        def _cb(s, tick):
+            m = _sumz(s)
+            print(json.dumps({
+                "tick": tick, "t": round(tick * spec.dt, 6),
+                "n_published": m["n_published"],
+                "n_completed": m["n_completed"],
+                "wall_s": round(time.perf_counter() - t0, 2),
+            }), flush=True)
+
+        final = run_chunked(spec, state, net, bounds,
+                            chunk_ticks=args.progress, callback=_cb)
+        series = None
+    else:
+        final, series = run(spec, state, net, bounds)
     import jax
 
     jax.block_until_ready(final)
