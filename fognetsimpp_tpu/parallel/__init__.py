@@ -21,4 +21,5 @@ from .replicas import replicate_state, run_replicated, replica_counters  # noqa:
 from .mesh import make_mesh, replica_sharding, shard_replicas, run_sharded  # noqa: F401
 from .multihost import global_mesh, initialize  # noqa: F401
 from .sweep import sweep_policies  # noqa: F401
+from .taskshard import run_node_sharded, shard_state_by_node  # noqa: F401
 from .tp import sharded_min_busy  # noqa: F401

@@ -1,0 +1,100 @@
+"""Node-axis (TP) sharding of the live engine: the HBM-scaling path.
+
+When the task table outgrows one chip (it dominates world memory:
+``T = n_users * max_sends`` rows × ~17 columns), the per-task and per-user
+arrays shard across the mesh with ``NamedSharding(P("node"))`` and the
+*unmodified* engine step runs under XLA's SPMD partitioner: per-shard
+phases (spawn, masks, compaction scans) stay local, and GSPMD inserts the
+collectives where a phase genuinely needs a global view (the K-sized
+compacted windows, fog/broker reductions) — exactly the
+"state sharded over mesh axes when node count exceeds one chip's HBM" row
+of SURVEY.md §2.3, with zero hand-written communication.
+
+Division of labour with the other axes: replica-DP
+(:mod:`fognetsimpp_tpu.parallel.mesh`) is the *throughput* path (zero
+collectives); this module is the *capacity* path (per-device task memory
+= T / n_devices, paying K-sized gathers per tick).  Results are
+bit-identical to the unsharded engine (tested), and input shardings
+propagate to the outputs, so chained calls keep the table distributed.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.engine import run
+from ..net.mobility import MobilityBounds
+from ..net.topology import NetParams
+from ..spec import WorldSpec
+from ..state import WorldState
+from .mesh import replica_sharding
+
+NODE_AXIS = "node"
+
+
+def shard_state_by_node(
+    spec: WorldSpec, state: WorldState, mesh: Mesh,
+    axis_name: str = NODE_AXIS,
+) -> WorldState:
+    """Place the world on the mesh: big per-row arrays sharded, rest
+    replicated.
+
+    The task/user arrays (the memory that scales with world size) split
+    row-wise; the small pytrees (node platform state, fogs, broker view,
+    metrics) are committed replicated to every device — they are KBs.
+    """
+    n = mesh.shape[axis_name]
+    if spec.n_users % n or spec.task_capacity % n:
+        raise ValueError(
+            f"the {n}-device mesh axis must divide n_users "
+            f"({spec.n_users}) and task capacity ({spec.task_capacity}) — "
+            "pad users/max_sends_per_user to a multiple"
+        )
+    leaf = replica_sharding(mesh, axis_name)  # leading-axis row sharding
+    repl = NamedSharding(mesh, P())
+
+    def rows(tree):
+        return jax.tree.map(lambda x: jax.device_put(x, leaf(x)), tree)
+
+    def replicated(tree):
+        return jax.tree.map(lambda x: jax.device_put(x, repl), tree)
+
+    return state.replace(
+        tasks=rows(state.tasks),
+        users=rows(state.users),
+        nodes=replicated(state.nodes),
+        fogs=replicated(state.fogs),
+        broker=replicated(state.broker),
+        metrics=replicated(state.metrics),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _advance(
+    spec: WorldSpec, n_ticks: Optional[int], state: WorldState,
+    net: NetParams, bounds: MobilityBounds,
+) -> WorldState:
+    final, _ = run(spec, state, net, bounds, n_ticks=n_ticks)
+    return final
+
+
+def run_node_sharded(
+    spec: WorldSpec,
+    state: WorldState,
+    net: NetParams,
+    bounds: MobilityBounds,
+    mesh: Mesh,
+    n_ticks: Optional[int] = None,
+    axis_name: str = NODE_AXIS,
+) -> WorldState:
+    """Advance a node-sharded world over the horizon.
+
+    The jitted program is cached on (spec, n_ticks) — repeat/chained calls
+    trace once — and GSPMD propagates the input shardings to the outputs,
+    so the table never gathers onto one device between calls.
+    """
+    state = shard_state_by_node(spec, state, mesh, axis_name)
+    return _advance(spec, n_ticks, state, net, bounds)
