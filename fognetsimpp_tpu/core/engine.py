@@ -381,7 +381,7 @@ def _phase_broker(
         spec.policy, offl, mips_g, b.view_busy, b.view_mips,
         b.registered, fog_alive, fog_efrac, rtt_bf, b.rr_next, k_sched,
         spec.bug_compat.mips0_divisor, spec.bug_compat.v1_max_scan,
-        policy_id=b.policy_id,
+        policy_id=b.policy_id, order_t=t_ab_g,
     )
     choice_ok = choice >= 0
     guard_fail = jnp.zeros((K,), bool)

@@ -173,9 +173,9 @@ def replay_engine_world(
         raise NotImplementedError(
             "replay_engine_world requires stationary nodes"
         )
-    if spec.policy not in (0, 5, 6):  # MIN_BUSY, LOCAL_FIRST, MAX_MIPS
-        # the DES implements only the reference's real schedulers; feeding
-        # it ROUND_ROBIN etc. would silently compare different policies
+    # MIN_BUSY, ROUND_ROBIN, MIN_LATENCY, LOCAL_FIRST, MAX_MIPS; the DES
+    # has no ENERGY_AWARE (no energy model) or RANDOM (no shared PRNG)
+    if spec.policy not in (0, 1, 2, 5, 6):
         raise NotImplementedError(
             f"native DES has no parity path for policy {spec.policy}"
         )

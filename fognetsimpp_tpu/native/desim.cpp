@@ -58,8 +58,16 @@ enum Stage : int {
   kRejected = 9,
 };
 
-// Policy codes matching fognetsimpp_tpu.spec.Policy (subset with DES parity).
-enum Policy : int { kMinBusy = 0, kLocalFirst = 5, kMaxMips = 6 };
+// Policy codes matching fognetsimpp_tpu.spec.Policy (subset with DES
+// parity; ENERGY_AWARE needs the energy model and RANDOM a shared PRNG —
+// neither has a sequential baseline here).
+enum Policy : int {
+  kMinBusy = 0,
+  kRoundRobin = 1,
+  kMinLatency = 2,
+  kLocalFirst = 5,
+  kMaxMips = 6,
+};
 
 enum FogModel : int { kFifo = 0, kPool = 1 };
 
@@ -124,7 +132,7 @@ struct Task {
 struct Params {
   int n_users, n_fogs, n_tasks;
   const double* d_ub;
-  const double* d_bf;
+  const double* d_bf;  // also yields MIN_LATENCY's rtt = 2 * d_bf
   double horizon;
   int policy, fog_model, app_gen;
   int mips0_divisor, zero_initial_view, adv_on_completion, adv_periodic;
@@ -140,6 +148,7 @@ struct World {
   std::vector<double> view_mips, view_busy;  // brokers[] stale view
   std::vector<char> registered;
   double local_pool = 0.0;
+  int64_t rr_cursor = 0;  // ROUND_ROBIN position among registered fogs
   std::priority_queue<Event, std::vector<Event>, EventLater> heap;
   int64_t seq = 0;
 
@@ -148,8 +157,9 @@ struct World {
   }
 
   // v3 `<` scan over brokers[] (BrokerBaseApp3.cc:267-281): first-wins
-  // tie-break, +inf estimates while the view MIPS is 0.
-  int pick_min_busy(double req) const {
+  // tie-break, +inf estimates while the view MIPS is 0.  MIN_LATENCY is
+  // the same scan with the broker->fog round trip added per candidate.
+  int pick_min_score(double req, bool add_rtt) const {
     int best = -1;
     double best_score = kInf;
     bool any = false;
@@ -158,6 +168,7 @@ struct World {
       double div = p.mips0_divisor ? view_mips[0] : view_mips[f];
       double est = div > 0.0 ? req / div : kInf;
       double score = view_busy[f] + est;
+      if (add_rtt) score += 2.0 * p.d_bf[f];
       if (!any || score < best_score) {
         best = f;
         best_score = score;
@@ -165,6 +176,19 @@ struct World {
       }
     }
     return any ? best : -1;
+  }
+
+  // ROUND_ROBIN over the registered set; the cursor advances per decision
+  // (the batched engine advances it by the masked count per window and
+  // ranks same-window arrivals by arrival time — the same sequence).
+  int pick_round_robin() {
+    std::vector<int> avail;
+    for (int f = 0; f < p.n_fogs; ++f)
+      if (registered[f]) avail.push_back(f);
+    if (avail.empty()) return -1;
+    int choice = avail[rr_cursor % avail.size()];
+    rr_cursor = (rr_cursor + 1) % avail.size();
+    return choice;
   }
 
   // v1/v2 offload scan (BrokerBaseApp.cc:228-240): with the faithful bug,
@@ -202,13 +226,26 @@ struct World {
     }
     // every non-local publish gets the "forwarded" status-4 (:146-150)
     tk.t_ack4_fwd = now + p.d_ub[tk.user];
-    int choice = (p.policy == kMinBusy) ? pick_min_busy(tk.mips_req)
-                                        : pick_max_mips();
+    int choice;
+    switch (p.policy) {
+      case kMinBusy:
+        choice = pick_min_score(tk.mips_req, /*add_rtt=*/false);
+        break;
+      case kRoundRobin:
+        choice = pick_round_robin();
+        break;
+      case kMinLatency:
+        choice = pick_min_score(tk.mips_req, /*add_rtt=*/true);
+        break;
+      default:
+        choice = pick_max_mips();
+    }
     if (choice < 0) {  // "no compute resource available" (:306-319)
       tk.stage = kNoResource;
       return;
     }
-    if (p.policy != kMinBusy && !(tk.mips_req < view_mips[choice])) {
+    if ((p.policy == kLocalFirst || p.policy == kMaxMips) &&
+        !(tk.mips_req < view_mips[choice])) {
       // v1 guard: an oversized task is never sent (BrokerBaseApp.cc:244)
       tk.stage = kRejected;
       return;

@@ -54,6 +54,9 @@ def schedule_batch(
     mips0_divisor: bool,  # static bug-compat switch (SURVEY App. B item 1)
     v1_max_scan: bool = True,  # static bug-compat switch (MAX_MIPS scan)
     policy_id: Optional[jax.Array] = None,  # () i32, traced (DYNAMIC only)
+    order_t: Optional[jax.Array] = None,  # (T,) f32 arrival times: orders
+    #   same-window ROUND_ROBIN slots by event time (ties by index) the way
+    #   a sequential broker would; None = compacted-index order
 ) -> Tuple[jax.Array, jax.Array]:
     """Pick a fog node for every masked task. Returns ((T,) i32 fog, rr').
 
@@ -107,8 +110,16 @@ def schedule_batch(
         return from_scores(view_busy[None, :] + est, avail)
 
     def b_round_robin():
-        # k-th masked task of this tick gets fog (rr + k) % F among avail
-        k = jnp.cumsum(mask.astype(jnp.int32)) - 1  # rank within batch
+        # k-th masked task of this tick gets fog (rr + k) % F among avail;
+        # k follows the event order a sequential broker would see (arrival
+        # time, ties by task index) when order_t is supplied
+        if order_t is None:
+            k = jnp.cumsum(mask.astype(jnp.int32)) - 1  # rank within batch
+        else:
+            ids = jnp.arange(T, dtype=jnp.int32)
+            order = jnp.lexsort((ids, jnp.where(mask, order_t, jnp.inf)))
+            rank_sorted = jnp.cumsum(mask[order].astype(jnp.int32)) - 1
+            k = jnp.zeros((T,), jnp.int32).at[order].set(rank_sorted)
         n_avail = jnp.maximum(jnp.sum(avail.astype(jnp.int32)), 1)
         slot = (rr_cursor + k) % n_avail
         # map slot -> index of the slot-th available fog

@@ -115,6 +115,52 @@ def test_parity_under_queueing():
     assert rel.max() < 0.01
 
 
+@pytest.mark.parametrize("policy", [1, 2])  # ROUND_ROBIN, MIN_LATENCY
+def test_parity_other_policies(policy):
+    """The realised `algo` policies also match the sequential DES exactly.
+
+    Power-of-two fog MIPS make every service time exactly representable,
+    so the engine's f32 busyTime and the DES's f64 carry identical values
+    and score ties break identically (non-representable rates leave
+    different rounding dust in the two precisions and flip near-ties —
+    an arithmetic artefact, not a scheduling divergence).
+    """
+    import jax.numpy as jnp
+
+    from fognetsimpp_tpu.core.engine import prime_initial_advertisements
+
+    spec, state, net, bounds = smoke.build(
+        horizon=1.0,
+        send_interval=0.05,
+        dt=1e-4,
+        n_users=2,
+        n_fogs=3,
+        fog_mips=(16384.0, 32768.0, 8192.0),
+        start_time_max=0.02,
+        policy=policy,
+    )
+    # heterogeneous fog access delays: without these MIN_LATENCY would
+    # degenerate to MIN_BUSY + const and its rtt term would go untested
+    fog_nodes = np.arange(spec.n_fogs) + spec.n_users
+    acc = np.asarray(net.node_acc).copy()
+    acc[fog_nodes] += np.asarray([5e-4, 0.0, 1e-3])
+    net = net.replace(node_acc=jnp.asarray(acc))
+    state = prime_initial_advertisements(spec, state, net)
+
+    final, _ = run(spec, state, net, bounds)
+    des, used = bridge.replay_engine_world(spec, final, net)
+    ef = np.asarray(final.tasks.fog)[used]
+    np.testing.assert_array_equal(ef, des["fog"])
+    if policy == 2:
+        # the rtt term really decided: the cheapest-link fog dominates
+        # (a pure min-busy tie-break would prefer fog 0)
+        assert (ef == 1).sum() > (ef == 0).sum(), np.bincount(ef[ef >= 0])
+    e = _eng(final, used, "t_ack6")
+    both = np.isfinite(e) & np.isfinite(des["t_ack6"])
+    assert both.sum() >= 20
+    np.testing.assert_allclose(e[both], des["t_ack6"][both], rtol=1e-5)
+
+
 def test_parity_fixed_bug_modes():
     """Both simulators honour the repaired-bug switches identically
     (per-candidate MIPS divisor, true-argmax offload scan)."""
