@@ -92,6 +92,12 @@ def test_recorder_roundtrip(tiny_run, tmp_path):
     sca = load_scalars(paths["sca"])
     assert sca["scalars"]["n_published"] > 0
     assert sca["spec"]["n_users"] == spec.n_users
+    # per-module rows (the reference's per-host .sca section)
+    mods = sca["modules"]
+    assert len(mods["user"]) == spec.n_users
+    assert len(mods["fog"]) == spec.n_fogs
+    assert sum(u["sent"] for u in mods["user"]) == sca["scalars"]["n_published"]
+    assert sum(f["assigned"] for f in mods["fog"]) == sca["scalars"]["n_scheduled"]
     vec = load_vectors(paths["vec"])
     assert "latency_h1" in vec and vec["latency_h1"].size > 0
     assert "delay" in vec
