@@ -146,6 +146,29 @@ def test_node_sharded_engine_bit_identical():
         run_node_sharded(spec2, state2, net2, bounds2, mesh)
 
 
+def test_node_sharded_wireless_world():
+    """GSPMD also partitions the wireless machinery (mobility, per-tick AP
+    association/handover) with sharded task/user state."""
+    from fognetsimpp_tpu.parallel import run_node_sharded
+    from fognetsimpp_tpu.parallel.mesh import make_mesh
+    from fognetsimpp_tpu.scenarios import wireless
+
+    spec, state, net, bounds = wireless.wireless4(
+        numb_users=8, horizon=2.0, dt=5e-3
+    )
+    from fognetsimpp_tpu import run as run_plain
+
+    ref, _ = run_plain(spec, state, net, bounds)
+    mesh = make_mesh(8, axis_name="node")
+    got = run_node_sharded(spec, state, net, bounds, mesh)
+    for name in ("t_create", "t_ack6", "stage", "fog"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.tasks, name)),
+            np.asarray(getattr(got.tasks, name)),
+            err_msg=name,
+        )
+
+
 def test_multihost_single_process_path():
     from fognetsimpp_tpu.parallel import global_mesh, initialize
 
