@@ -34,13 +34,19 @@ def _stats(v: np.ndarray) -> Dict[str, float]:
 def analyze(results_dir: str) -> Dict[str, Dict]:
     """Per-run signal statistics for every recorded run in a directory.
 
-    Returns ``{run_id: {"scalars": {...}, "signals": {name: stats}}}``.
+    Returns ``{run_id: {"scalars": {...}, "modules": {"user": [...],
+    "fog": [...]}, "signals": {name: stats}}}`` (``modules`` is empty for
+    runs recorded before per-module scalars existed).
     """
     out: Dict[str, Dict] = {}
     for sca_path in sorted(glob.glob(os.path.join(results_dir, "*.sca.json"))):
         run_id = os.path.basename(sca_path)[: -len(".sca.json")]
         sca = load_scalars(sca_path)
-        entry: Dict = {"scalars": sca.get("scalars", {}), "signals": {}}
+        entry: Dict = {
+            "scalars": sca.get("scalars", {}),
+            "modules": sca.get("modules", {}),
+            "signals": {},
+        }
         vec_path = os.path.join(results_dir, f"{run_id}.vec.npz")
         if os.path.exists(vec_path):
             for name, v in load_vectors(vec_path).items():
@@ -82,4 +88,16 @@ def render_report(results: Dict[str, Dict]) -> str:
                 f"   {name:<16}{s['n']:>7}{s['mean']:>10.2f}{s['min']:>10.2f}"
                 f"{s['p50']:>10.2f}{s['p95']:>10.2f}{s['max']:>10.2f}"
             )
+        fogs = entry.get("modules", {}).get("fog", [])
+        if fogs:
+            lines.append(
+                f"   {'fog':<6}{'assigned':>9}{'completed':>10}"
+                f"{'busy':>9}{'q_len':>7}{'drops':>7}"
+            )
+            for f, row in enumerate(fogs):
+                lines.append(
+                    f"   {f:<6}{row['assigned']:>9}{row['completed']:>10}"
+                    f"{row['busy_time']:>9.2f}{row['q_len']:>7}"
+                    f"{row['q_drops']:>7}"
+                )
     return "\n".join(lines)
