@@ -60,10 +60,33 @@ class TickBuf(NamedTuple):
     Counts are booked in the tick where the send/receive is *decided*; the
     at-most-one-tick skew vs the exact event time is far below the energy
     model's own granularity.
+
+    Segmented by node role ([users | fogs | broker] of the node layout)
+    so the per-phase updates are elementwise adds and scalar adds that XLA
+    fuses into the surrounding kernels — a flat (N,) buffer would force a
+    ~25 us scatter kernel per phase per counter (profiled r3).  The energy
+    phase reassembles the flat view once per tick.
     """
 
-    tx: jax.Array  # (N,) i32
-    rx: jax.Array  # (N,) i32
+    tx_u: jax.Array  # (U,) i32
+    rx_u: jax.Array  # (U,) i32
+    tx_f: jax.Array  # (F,) i32
+    rx_f: jax.Array  # (F,) i32
+    tx_b: jax.Array  # () i32 — the single base broker
+    rx_b: jax.Array  # () i32
+
+
+def _per_fog(
+    mask: jax.Array, fog: jax.Array, n_fogs: int
+) -> jax.Array:
+    """(F, K) membership matrix: row f marks masked tasks bound for fog f.
+
+    One comparison kernel replaces per-counter scatter-adds (a TPU scatter
+    costs ~6 ns/element serialized + ~25 us fixed; the (F, K) reduces over
+    this matrix vectorise on the VPU instead).
+    """
+    F = n_fogs
+    return (fog[None, :] == jnp.arange(F, dtype=jnp.int32)[:, None]) & mask[None, :]
 
 
 def _fog_node_idx(spec: WorldSpec, fog: jax.Array) -> jax.Array:
@@ -130,18 +153,17 @@ def _phase_connect(
     """
     users, b = state.users, state.broker
     U = spec.n_users
-    uidx = jnp.arange(U, dtype=jnp.int32)
     # (a) fog registrations mature (brokers.push_back at Connect arrival)
     b = b.replace(registered=b.register_t <= t1)
 
     # (b) users whose start fired send Connect; stamp the Connack round-trip
     pending = (
-        state.nodes.alive[uidx]
+        state.nodes.alive[:U]
         & ~users.connected
         & jnp.isinf(users.connack_at)
         & (users.start_t < t1)
     )
-    d_ub = cache.d2b[uidx]
+    d_ub = cache.d2b[:U]
     t_send = jnp.maximum(users.start_t, t0)
     connack_at = jnp.where(pending, t_send + 2.0 * d_ub, users.connack_at)
 
@@ -156,22 +178,27 @@ def _phase_connect(
     )
     # message accounting: Connect + per-topic Subscribe from the user;
     # Connack + per-topic Suback from the broker
-    up_msgs = pending.astype(jnp.int32) + jnp.where(acked, n_subs, 0)
+    acked_subs = jnp.where(acked, n_subs, 0)
+    up_msgs = pending.astype(jnp.int32) + acked_subs
     down_msgs = acked.astype(jnp.int32) * (1 + n_subs)
-    tx = buf.tx.at[uidx].add(up_msgs)
-    tx = tx.at[spec.broker_index].add(jnp.sum(down_msgs))
-    rx = buf.rx.at[uidx].add(down_msgs)
-    rx = rx.at[spec.broker_index].add(jnp.sum(up_msgs))
-
+    # one stacked reduction for all the scalar sums of this phase
+    sums = jnp.sum(
+        jnp.stack(
+            [down_msgs, up_msgs, acked.astype(jnp.int32), acked_subs]
+        ),
+        axis=1,
+    )
+    buf = buf._replace(
+        tx_u=buf.tx_u + up_msgs,
+        rx_u=buf.rx_u + down_msgs,
+        tx_b=buf.tx_b + sums[0],
+        rx_b=buf.rx_b + sums[1],
+    )
     metrics = state.metrics.replace(
-        n_connected=state.metrics.n_connected + jnp.sum(acked.astype(jnp.int32)),
-        n_subscribed=state.metrics.n_subscribed
-        + jnp.sum(jnp.where(acked, n_subs, 0)),
+        n_connected=state.metrics.n_connected + sums[2],
+        n_subscribed=state.metrics.n_subscribed + sums[3],
     )
-    return (
-        state.replace(users=users, broker=b, metrics=metrics),
-        TickBuf(tx=tx, rx=rx),
-    )
+    return state.replace(users=users, broker=b, metrics=metrics), buf
 
 
 def _phase_adverts(state: WorldState, t1: jax.Array) -> WorldState:
@@ -209,7 +236,7 @@ def _phase_spawn(
     U, T, S = spec.n_users, spec.task_capacity, spec.max_sends_per_user
     users, tasks = state.users, state.tasks
     uidx = jnp.arange(U, dtype=jnp.int32)
-    alive_u = state.nodes.alive[uidx]
+    alive_u = state.nodes.alive[:U]
 
     due = (
         alive_u
@@ -234,7 +261,7 @@ def _phase_spawn(
             k_mips, (U,), spec.mips_required_min, spec.mips_required_max + 1
         ).astype(jnp.float32)
 
-    d_ub = cache.d2b[uidx]  # (U,)
+    d_ub = cache.d2b[:U]  # (U,)
     slot = jnp.where(due, uidx * S + users.send_count, T)
 
     def scat(col, val):
@@ -265,7 +292,6 @@ def _phase_spawn(
     )
     tasks = tasks.replace(
         stage=tasks.stage.at[slot].set(stage_new, mode="drop"),
-        topic=tasks.topic.at[slot].set(users.pub_topic, mode="drop"),
         mips_req=scat(tasks.mips_req, mips_req),
         t_create=scat(tasks.t_create, t_create),
         t_at_broker=tasks.t_at_broker.at[slot].set(
@@ -282,12 +308,15 @@ def _phase_spawn(
         next_send=jnp.where(due, t_create + interval, users.next_send),
         send_count=jnp.where(due, users.send_count + 1, users.send_count),
     )
-    metrics = state.metrics.replace(
-        n_published=state.metrics.n_published + jnp.sum(due.astype(jnp.int32)),
-        n_lost=state.metrics.n_lost
-        + jnp.sum((due & lost).astype(jnp.int32)),
+    sums = jnp.sum(
+        jnp.stack([due.astype(jnp.int32), (due & lost).astype(jnp.int32)]),
+        axis=1,
     )
-    buf = buf._replace(tx=buf.tx.at[uidx].add(due.astype(jnp.int32)))
+    metrics = state.metrics.replace(
+        n_published=state.metrics.n_published + sums[0],
+        n_lost=state.metrics.n_lost + sums[1],
+    )
+    buf = buf._replace(tx_u=buf.tx_u + due.astype(jnp.int32))
     return state.replace(users=users, tasks=tasks, metrics=metrics, key=key), buf
 
 
@@ -318,34 +347,39 @@ def _phase_broker(
     """
     tasks, b = state.tasks, state.broker
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    S = spec.max_sends_per_user
     mask = (tasks.stage == jnp.int8(int(Stage.PUB_INFLIGHT))) & (
         tasks.t_at_broker <= t1
     )
     idx, idxc, valid = _compact(mask, K, T)
 
     mips_g = tasks.mips_req[idxc]
-    user_g = tasks.user[idxc]
-    topic_g = tasks.topic[idxc]
+    user_g = idxc // S  # slot layout u*S+k makes the owner a pure index op
     t_ab_g = tasks.t_at_broker[idxc]
-
-    rx = buf.rx.at[spec.broker_index].add(jnp.sum(valid.astype(jnp.int32)))
-    tx = buf.tx
 
     # ---- topic fan-out (publishAll as a live feature) -----------------
     metrics = state.metrics
     users = state.users
+    n_del = jnp.zeros((), jnp.int32)
     if spec.fanout_enabled:
-        per_topic = jnp.zeros((spec.n_topics,), jnp.float32).at[
-            jnp.where(valid, topic_g, spec.n_topics)
-        ].add(1.0, mode="drop")
+        topic_g = users.pub_topic[user_g]
+        # (topics, K) membership reduce instead of a serialized scatter-add
+        per_topic = jnp.sum(
+            (
+                topic_g[None, :]
+                == jnp.arange(spec.n_topics, dtype=jnp.int32)[:, None]
+            )
+            & valid[None, :],
+            axis=1,
+            dtype=jnp.float32,
+        )
         deliveries = (users.sub_mask.astype(jnp.float32) @ per_topic).astype(
             jnp.int32
         )  # (U,)
         n_del = jnp.sum(deliveries)
         users = users.replace(n_delivered=users.n_delivered + deliveries)
         metrics = metrics.replace(n_fanout=metrics.n_fanout + n_del)
-        tx = tx.at[spec.broker_index].add(n_del)
-        rx = rx.at[jnp.arange(spec.n_users, dtype=jnp.int32)].add(deliveries)
+        buf = buf._replace(rx_u=buf.rx_u + deliveries)
 
     # ---- LOCAL_FIRST: debit the broker's own pool in arrival order ----
     local = jnp.zeros((K,), bool)
@@ -369,11 +403,11 @@ def _phase_broker(
     # ---- offload scheduling ------------------------------------------
     any_fog = jnp.any(b.registered)
     key, k_sched = jax.random.split(state.key)
-    fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
-    rtt_bf = 2.0 * cache.d2b[fog_nodes]
-    fog_alive = state.nodes.alive[fog_nodes]
-    fog_efrac = state.nodes.energy[fog_nodes] / jnp.maximum(
-        state.nodes.energy_capacity[fog_nodes], 1e-12
+    U = spec.n_users
+    rtt_bf = 2.0 * cache.d2b[U : U + F]
+    fog_alive = state.nodes.alive[U : U + F]
+    fog_efrac = state.nodes.energy[U : U + F] / jnp.maximum(
+        state.nodes.energy_capacity[U : U + F], 1e-12
     )
 
     offl = valid & ~local
@@ -441,23 +475,29 @@ def _phase_broker(
             ),
         )
     i32 = jnp.int32
+    # one stacked reduction for every scalar count of this phase
+    sums = jnp.sum(
+        jnp.stack([sched, no_res, rejected, local, valid]).astype(i32), axis=1
+    )
     metrics = metrics.replace(
-        n_scheduled=metrics.n_scheduled + jnp.sum(sched.astype(i32)),
-        n_no_resource=metrics.n_no_resource + jnp.sum(no_res.astype(i32)),
-        n_rejected=metrics.n_rejected + jnp.sum(rejected.astype(i32)),
-        n_local=metrics.n_local + jnp.sum(local.astype(i32)),
+        n_scheduled=metrics.n_scheduled + sums[0],
+        n_no_resource=metrics.n_no_resource + sums[1],
+        n_rejected=metrics.n_rejected + sums[2],
+        n_local=metrics.n_local + sums[3],
     )
-    # broker sends: FognetMsgTask per scheduled + one ack per decided task
-    tx = tx.at[spec.broker_index].add(
-        jnp.sum(sched.astype(i32)) + jnp.sum(valid.astype(i32))
+    # broker sends: FognetMsgTask per scheduled + one ack per decided task;
+    # rx: the decided publishes arrived at the broker this tick
+    buf = buf._replace(
+        tx_b=buf.tx_b + sums[0] + sums[4] + n_del,
+        rx_b=buf.rx_b + sums[4],
+        rx_u=buf.rx_u.at[user_g].add(valid.astype(i32), mode="drop"),
     )
-    rx = rx.at[user_g].add(valid.astype(i32), mode="drop")  # ack arrives
     return (
         state.replace(
             tasks=tasks, users=users, broker=b.replace(rr_next=rr_new),
             metrics=metrics, key=key,
         ),
-        TickBuf(tx=tx, rx=rx),
+        buf,
     )
 
 
@@ -472,18 +512,17 @@ def _phase_completions(
     ``busy_until + svc``, and a fresh advertisement put in flight.
     """
     tasks, fogs, b = state.tasks, state.fogs, state.broker
-    F = spec.n_fogs
+    F, U = spec.n_fogs, spec.n_users
     i32 = jnp.int32
-    fog_nodes = jnp.arange(F, dtype=i32) + spec.n_users
-    fog_alive = state.nodes.alive[fog_nodes]
+    fog_alive = state.nodes.alive[U : U + F]
 
     comp = (fogs.current_task != NO_TASK) & (fogs.busy_until <= t1) & fog_alive
     done_task = jnp.where(comp, fogs.current_task, spec.task_capacity)
     t_done = fogs.busy_until  # exact completion times per fog
 
     # ack6 path: fog -> broker -> client (relay, BrokerBaseApp3.cc:164-175)
-    user_of = tasks.user[jnp.clip(done_task, 0, spec.task_capacity - 1)]
-    d_fb = cache.d2b[fog_nodes]
+    user_of = jnp.clip(done_task, 0, spec.task_capacity - 1) // spec.max_sends_per_user
+    d_fb = cache.d2b[U : U + F]
     d_bu = cache.d2b[user_of]
     t_ack6 = t_done + d_fb + d_bu
 
@@ -545,13 +584,16 @@ def _phase_completions(
     metrics = state.metrics.replace(n_completed=state.metrics.n_completed + n_comp)
     # fog sends ack6 (+ advert); broker relays to the user
     n_adv = n_comp if spec.adv_on_completion else 0
-    tx = buf.tx.at[fog_nodes].add(comp.astype(i32) * (2 if spec.adv_on_completion else 1))
-    tx = tx.at[spec.broker_index].add(n_comp)
-    rx = buf.rx.at[spec.broker_index].add(n_comp + n_adv)
-    rx = rx.at[user_of].add(comp.astype(i32), mode="drop")
+    buf = buf._replace(
+        tx_f=buf.tx_f
+        + comp.astype(i32) * (2 if spec.adv_on_completion else 1),
+        tx_b=buf.tx_b + n_comp,
+        rx_b=buf.rx_b + n_comp + n_adv,
+        rx_u=buf.rx_u.at[user_of].add(comp.astype(i32), mode="drop"),
+    )
     return (
         state.replace(tasks=tasks, fogs=fogs, broker=b, metrics=metrics),
-        TickBuf(tx=tx, rx=rx),
+        buf,
     )
 
 
@@ -569,9 +611,9 @@ def _phase_fog_arrivals(
     """
     tasks, fogs = state.tasks, state.fogs
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    U = spec.n_users
     i32 = jnp.int32
-    fog_nodes_all = jnp.arange(F, dtype=i32) + spec.n_users
-    fog_alive = state.nodes.alive[fog_nodes_all]
+    fog_alive = state.nodes.alive[U : U + F]
 
     arr_full = (tasks.stage == jnp.int8(int(Stage.TASK_INFLIGHT))) & (
         tasks.t_at_fog <= t1
@@ -581,18 +623,19 @@ def _phase_fog_arrivals(
     fog_gc = jnp.clip(fog_g, 0, F - 1)
     t_af_g = tasks.t_at_fog[idxc]
     mips_g = tasks.mips_req[idxc]
-    user_g = tasks.user[idxc]
+    user_g = idxc // spec.max_sends_per_user
 
     dead_dst = valid & ~fog_alive[fog_gc]  # packets to a dead node are lost
     arr = valid & ~dead_dst
 
     svc_g = _svc_time(spec, mips_g, fogs.mips[fog_gc])
-    add_busy = jnp.zeros((F + 1,), jnp.float32).at[
-        jnp.where(arr, fog_g, F)
-    ].add(jnp.where(arr, svc_g, 0.0), mode="drop")[:F]
+    per_fog_arr = _per_fog(arr, fog_g, F)  # (F, K) membership
+    add_busy = jnp.sum(
+        jnp.where(per_fog_arr, svc_g[None, :], 0.0), axis=1
+    )
 
     idle = fogs.current_task == NO_TASK
-    plan = plan_arrivals(arr, fog_g, t_af_g, F, idle)
+    plan = plan_arrivals(arr, fog_g, t_af_g, F, idle, per_fog=per_fog_arr)
 
     # --- immediate assignment on idle fogs ---
     a_pos = plan.assign_task  # (F,) position in the K-buffer or NO_TASK
@@ -604,8 +647,8 @@ def _phase_fog_arrivals(
     # became free, if that was later within this same tick (free_since fix)
     t_start = jnp.maximum(tasks.t_at_fog[a_taskc], fogs.free_since)
     svc_a = _svc_time(spec, tasks.mips_req[a_taskc], fogs.mips)
-    d_fb = cache.d2b[fog_nodes_all]
-    d_bu_a = cache.d2b[tasks.user[a_taskc]]
+    d_fb = cache.d2b[U : U + F]
+    d_bu_a = cache.d2b[a_taskc // spec.max_sends_per_user]
     t_ack5 = t_start + d_fb + d_bu_a
 
     scat_a = jnp.where(assigned, a_task, T)
@@ -650,26 +693,26 @@ def _phase_fog_arrivals(
         ),
     )
     fogs = fogs.replace(queue=queue, q_len=q_len, q_drops=fogs.q_drops + dropped)
-    metrics = state.metrics.replace(
-        n_dropped=state.metrics.n_dropped
-        + jnp.sum((to_queue & ~enq_ok).astype(i32))
-        + jnp.sum(dead_dst.astype(i32))
-    )
     # every live arrival is a fog rx + one ack (assigned/queued) relayed
     # through the broker to the user
     acked = (assigned[fog_gc] & (idx == a_task[fog_gc])) | enq_ok
-    tx = buf.tx.at[fog_nodes_all].add(
-        jnp.zeros((F + 1,), i32).at[jnp.where(arr, fog_g, F)].add(1, mode="drop")[:F]
+    sums = jnp.sum(
+        jnp.stack([to_queue & ~enq_ok, dead_dst, acked]).astype(i32), axis=1
     )
-    tx = tx.at[spec.broker_index].add(jnp.sum(acked.astype(i32)))
-    rx = buf.rx.at[fog_nodes_all].add(
-        jnp.zeros((F + 1,), i32).at[jnp.where(arr, fog_g, F)].add(1, mode="drop")[:F]
+    metrics = state.metrics.replace(
+        n_dropped=state.metrics.n_dropped + sums[0] + sums[1]
     )
-    rx = rx.at[spec.broker_index].add(jnp.sum(acked.astype(i32)))
-    rx = rx.at[user_g].add(acked.astype(i32), mode="drop")
+    arr_per_fog = jnp.sum(per_fog_arr, axis=1, dtype=i32)
+    buf = buf._replace(
+        tx_f=buf.tx_f + arr_per_fog,
+        rx_f=buf.rx_f + arr_per_fog,
+        tx_b=buf.tx_b + sums[2],
+        rx_b=buf.rx_b + sums[2],
+        rx_u=buf.rx_u.at[user_g].add(acked.astype(i32), mode="drop"),
+    )
     return (
         state.replace(tasks=tasks, fogs=fogs, metrics=metrics),
-        TickBuf(tx=tx, rx=rx),
+        buf,
     )
 
 
@@ -706,14 +749,14 @@ def _phase_pool_completions(
     idx, idxc, valid = _compact(comp_full, K, T)
     fog_g = jnp.clip(tasks.fog[idxc], 0, F - 1)
     mips_g = tasks.mips_req[idxc]
-    user_g = tasks.user[idxc]
+    user_g = idxc // spec.max_sends_per_user
     t_done = tasks.t_complete[idxc]
 
-    pool_avail = state.fogs.pool_avail.at[jnp.where(valid, fog_g, F)].add(
-        jnp.where(valid, mips_g, 0.0), mode="drop"
+    per_fog_v = _per_fog(valid, fog_g, F)  # (F, K)
+    pool_avail = state.fogs.pool_avail + jnp.sum(
+        jnp.where(per_fog_v, mips_g[None, :], 0.0), axis=1
     )
 
-    fog_nodes = jnp.arange(F, dtype=i32) + spec.n_users
     d_fb = cache.d2b[fog_g + spec.n_users]
     d_bu = cache.d2b[user_g]
     t_ack6 = t_done + d_fb + d_bu
@@ -729,20 +772,21 @@ def _phase_pool_completions(
         )
     n_comp = jnp.sum(valid.astype(i32))
     metrics = state.metrics.replace(n_completed=state.metrics.n_completed + n_comp)
-    per_fog = jnp.zeros((F + 1,), i32).at[jnp.where(valid, fog_g, F)].add(
-        1, mode="drop"
-    )[:F]
-    tx = buf.tx.at[fog_nodes].add(per_fog)
-    rx = buf.rx.at[spec.broker_index].add(n_comp)
+    buf = buf._replace(
+        tx_f=buf.tx_f + jnp.sum(per_fog_v, axis=1, dtype=i32),
+        rx_b=buf.rx_b + n_comp,
+    )
     if spec.app_gen >= 2:
-        tx = tx.at[spec.broker_index].add(n_comp)
-        rx = rx.at[user_g].add(valid.astype(i32), mode="drop")
+        buf = buf._replace(
+            tx_b=buf.tx_b + n_comp,
+            rx_u=buf.rx_u.at[user_g].add(valid.astype(i32), mode="drop"),
+        )
     return (
         state.replace(
             tasks=tasks, fogs=state.fogs.replace(pool_avail=pool_avail),
             metrics=metrics,
         ),
-        TickBuf(tx=tx, rx=rx),
+        buf,
     )
 
 
@@ -763,9 +807,9 @@ def _phase_pool_arrivals(
     """
     tasks = state.tasks
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    U = spec.n_users
     i32 = jnp.int32
-    fog_nodes_all = jnp.arange(F, dtype=i32) + spec.n_users
-    fog_alive = state.nodes.alive[fog_nodes_all]
+    fog_alive = state.nodes.alive[U : U + F]
 
     arr_full = (tasks.stage == jnp.int8(int(Stage.TASK_INFLIGHT))) & (
         tasks.t_at_fog <= t1
@@ -775,23 +819,22 @@ def _phase_pool_arrivals(
     fog_gc = jnp.clip(fog_g, 0, F - 1)
     t_af_g = tasks.t_at_fog[idxc]
     mips_g = tasks.mips_req[idxc]
-    user_g = tasks.user[idxc]
 
     dead_dst = valid & ~fog_alive[fog_gc]
     arr = valid & ~dead_dst
-    plan = plan_arrivals(arr, fog_g, t_af_g, F, jnp.ones((F,), bool))
+    per_fog_arr = _per_fog(arr, fog_g, F)  # (F, K)
+    plan = plan_arrivals(
+        arr, fog_g, t_af_g, F, jnp.ones((F,), bool), per_fog=per_fog_arr
+    )
 
     pool = state.fogs.pool_avail
     accept = jnp.zeros((K,), bool)
     reject = jnp.zeros((K,), bool)
     for r in range(spec.pool_phases):
         sel = arr & (plan.rank == r)
-        req_f = jnp.zeros((F + 1,), jnp.float32).at[
-            jnp.where(sel, fog_g, F)
-        ].add(jnp.where(sel, mips_g, 0.0), mode="drop")[:F]
-        has_f = jnp.zeros((F + 1,), bool).at[jnp.where(sel, fog_g, F)].set(
-            True, mode="drop"
-        )[:F]
+        sel_f = per_fog_arr & (plan.rank == r)[None, :]  # (F, K)
+        req_f = jnp.sum(jnp.where(sel_f, mips_g[None, :], 0.0), axis=1)
+        has_f = jnp.any(sel_f, axis=1)
         acc_f = has_f & (req_f < pool)  # strict <, ComputeBrokerApp2.cc:269
         pool = pool - jnp.where(acc_f, req_f, 0.0)
         accept = accept | (sel & acc_f[fog_gc])
@@ -822,18 +865,15 @@ def _phase_pool_arrivals(
     )
     # arrivals are fog rx; each decided arrival sends a TaskAck to the broker
     decided = accept | reject
-    per_fog_rx = jnp.zeros((F + 1,), i32).at[jnp.where(arr, fog_g, F)].add(
-        1, mode="drop"
-    )[:F]
-    per_fog_tx = jnp.zeros((F + 1,), i32).at[jnp.where(decided, fog_g, F)].add(
-        1, mode="drop"
-    )[:F]
-    tx = buf.tx.at[fog_nodes_all].add(per_fog_tx)
-    rx = buf.rx.at[fog_nodes_all].add(per_fog_rx)
-    rx = rx.at[spec.broker_index].add(jnp.sum(decided.astype(i32)))
+    buf = buf._replace(
+        tx_f=buf.tx_f
+        + jnp.sum(per_fog_arr & decided[None, :], axis=1, dtype=i32),
+        rx_f=buf.rx_f + jnp.sum(per_fog_arr, axis=1, dtype=i32),
+        rx_b=buf.rx_b + jnp.sum(decided.astype(i32)),
+    )
     return (
         state.replace(tasks=tasks, fogs=fogs, metrics=metrics),
-        TickBuf(tx=tx, rx=rx),
+        buf,
     )
 
 
@@ -855,7 +895,7 @@ def _phase_local_completions(
         tasks.t_complete <= t1
     )
     idx, idxc, valid = _compact(comp_full, K, T)
-    user_g = tasks.user[idxc]
+    user_g = idxc // spec.max_sends_per_user
     t_done = tasks.t_complete[idxc]
     d_bu = cache.d2b[user_g]
     tasks = tasks.replace(
@@ -872,11 +912,13 @@ def _phase_local_completions(
         )
     n_comp = jnp.sum(valid.astype(i32))
     metrics = state.metrics.replace(n_completed=state.metrics.n_completed + n_comp)
-    tx = buf.tx.at[spec.broker_index].add(n_comp)
-    rx = buf.rx.at[user_g].add(valid.astype(i32), mode="drop")
+    buf = buf._replace(
+        tx_b=buf.tx_b + n_comp,
+        rx_u=buf.rx_u.at[user_g].add(valid.astype(i32), mode="drop"),
+    )
     return (
         state.replace(tasks=tasks, broker=b, metrics=metrics),
-        TickBuf(tx=tx, rx=rx),
+        buf,
     )
 
 
@@ -891,14 +933,13 @@ def _phase_periodic_adverts(
     is the remaining pool (the reference mutates ``MIPS`` itself,
     ``ComputeBrokerApp2.cc:272``) — and lands after the fog->broker delay.
     """
-    F = spec.n_fogs
-    fog_nodes = jnp.arange(F, dtype=jnp.int32) + spec.n_users
-    alive = state.nodes.alive[fog_nodes]
+    F, U = spec.n_fogs, spec.n_users
+    alive = state.nodes.alive[U : U + F]
     k0 = jnp.floor(t0 / spec.adv_interval)
     k1 = jnp.floor(t1 / spec.adv_interval)
     fire = (k1 > k0) & alive
     t_fire = (k0 + 1.0) * spec.adv_interval
-    d_fb = cache.d2b[fog_nodes]
+    d_fb = cache.d2b[U : U + F]
     adv_mips = (
         state.fogs.pool_avail
         if spec.fog_model == int(FogModel.POOL)
@@ -974,9 +1015,14 @@ def make_step(
     def step(state: WorldState, net: NetParams, bounds: MobilityBounds):
         t0 = state.tick.astype(jnp.float32) * spec.dt
         t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
+        i32 = jnp.int32
         buf = TickBuf(
-            tx=jnp.zeros((spec.n_nodes,), jnp.int32),
-            rx=jnp.zeros((spec.n_nodes,), jnp.int32),
+            tx_u=jnp.zeros((spec.n_users,), i32),
+            rx_u=jnp.zeros((spec.n_users,), i32),
+            tx_f=jnp.zeros((spec.n_fogs,), i32),
+            rx_f=jnp.zeros((spec.n_fogs,), i32),
+            tx_b=jnp.zeros((), i32),
+            rx_b=jnp.zeros((), i32),
         )
 
         # 1. mobility (positions at end-of-tick; delays in this tick use them)
@@ -1011,17 +1057,30 @@ def make_step(
 
         # 8. energy + lifecycle
         if spec.energy_enabled:
-            N = spec.n_nodes
-            fog_nodes = jnp.arange(spec.n_fogs, dtype=jnp.int32) + spec.n_users
+            n_rest = spec.n_aps + spec.n_routers
+            rest_i = jnp.zeros((n_rest,), i32)
+            # flat (N,) view of the segmented counters, [users|fogs|broker|..]
+            tx = jnp.concatenate(
+                [buf.tx_u, buf.tx_f, buf.tx_b[None], rest_i]
+            )
+            rx = jnp.concatenate(
+                [buf.rx_u, buf.rx_f, buf.rx_b[None], rest_i]
+            )
             if spec.fog_model == int(FogModel.POOL):
                 fog_busy = state.fogs.pool_avail < state.fogs.mips
             else:
                 fog_busy = state.fogs.current_task != NO_TASK
-            computing = jnp.zeros((N,), bool).at[fog_nodes].set(fog_busy)
+            computing = jnp.concatenate(
+                [
+                    jnp.zeros((spec.n_users,), bool),
+                    fog_busy,
+                    jnp.zeros((1 + n_rest,), bool),
+                ]
+            )
             energy, alive = step_energy(
                 spec, state.nodes.energy, state.nodes.energy_capacity,
                 state.nodes.has_energy, state.nodes.alive, t1,
-                buf.tx, buf.rx, computing,
+                tx, rx, computing,
             )
             state = state.replace(
                 nodes=state.nodes.replace(energy=energy, alive=alive)

@@ -52,6 +52,8 @@ def plan_arrivals(
     t_arrive: jax.Array,  # (K,) f32 — exact arrival time
     n_fogs: int,
     fog_idle: jax.Array,  # (F,) bool — fog can take a task immediately
+    per_fog: jax.Array = None,  # (F, K) bool membership (fog[k]==f & mask),
+    #   precomputed by the caller when it already needs the matrix
 ) -> ArrivalPlan:
     """Compute per-fog arrival order for a batch of same-tick arrivals.
 
@@ -59,14 +61,18 @@ def plan_arrivals(
     O(K^2) pairwise comparison + row-sum — dramatically cheaper on TPU than
     a bitonic ``lexsort`` chain (tens of sequential sort stages per tick for
     a few thousand elements).  Larger windows fall back to the sort path.
-    The first arrival per fog comes from two scatter-mins (time, then id
-    among time-ties), preserving the (t_arrive, id) tie-break of the
-    sequential event order.
+    The per-fog counts and first arrival (min time, ties by id) are (F, K)
+    masked reduces over the membership matrix — vectorised VPU rows instead
+    of serialized ~6 ns/element scatter-min/add kernels (profiled r3).
     """
     K = mask.shape[0]
     ids = jnp.arange(K, dtype=jnp.int32)
     f_key = jnp.where(mask, fog, n_fogs).astype(jnp.int32)
     t_key = jnp.where(mask, t_arrive, jnp.inf)
+    if per_fog is None:
+        per_fog = (
+            fog[None, :] == jnp.arange(n_fogs, dtype=jnp.int32)[:, None]
+        ) & mask[None, :]
 
     from .pallas_kernels import pairwise_rank, pallas_rank_applicable
 
@@ -94,19 +100,14 @@ def plan_arrivals(
         rank_sorted = jnp.where(valid_sorted, idx - seg_start, -1)
         rank = jnp.zeros((K,), jnp.int32).at[order].set(rank_sorted)
 
-    counts = (
-        jnp.zeros((n_fogs + 1,), jnp.int32).at[f_key].add(mask.astype(jnp.int32))
-    )[:n_fogs]
+    counts = jnp.sum(per_fog, axis=1, dtype=jnp.int32)
 
-    # first arrival per fog: scatter-min on time, then min id among ties
-    scatter_f = jnp.where(mask, f_key, n_fogs)
-    t_min = jnp.full((n_fogs + 1,), jnp.inf, jnp.float32).at[scatter_f].min(
-        t_key, mode="drop"
-    )[:n_fogs]
-    is_tmin = mask & (t_key == t_min[jnp.clip(f_key, 0, n_fogs - 1)])
-    first = jnp.full((n_fogs + 1,), jnp.iinfo(jnp.int32).max, jnp.int32).at[
-        jnp.where(is_tmin, f_key, n_fogs)
-    ].min(ids, mode="drop")[:n_fogs]
+    # first arrival per fog: masked min on time, then min id among ties
+    t_min = jnp.min(jnp.where(per_fog, t_key[None, :], jnp.inf), axis=1)
+    is_tmin = per_fog & (t_key[None, :] == t_min[:, None])
+    first = jnp.min(
+        jnp.where(is_tmin, ids[None, :], jnp.iinfo(jnp.int32).max), axis=1
+    )
     has_arrival = counts > 0
     assign_task = jnp.where(
         fog_idle & has_arrival, first, NO_TASK
@@ -140,12 +141,11 @@ def batched_enqueue(
     flat = flat.at[flat_idx].set(task_ids, mode="drop")
     queue = flat.reshape(F, Q)
 
-    added = jnp.zeros((F + 1,), jnp.int32).at[
-        jnp.where(fits, fog, F)
-    ].add(1, mode="drop")[:F]
-    dropped_per_fog = jnp.zeros((F + 1,), jnp.int32).at[
-        jnp.where(mask & ~fits, fog, F)
-    ].add(1, mode="drop")[:F]
+    fog_eq = fog[None, :] == jnp.arange(F, dtype=jnp.int32)[:, None]  # (F, K)
+    added = jnp.sum(fog_eq & fits[None, :], axis=1, dtype=jnp.int32)
+    dropped_per_fog = jnp.sum(
+        fog_eq & (mask & ~fits)[None, :], axis=1, dtype=jnp.int32
+    )
     q_len = q_len + added
     return queue, q_len, fits, dropped_per_fog
 

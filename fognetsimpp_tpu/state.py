@@ -139,9 +139,12 @@ class TaskState:
     """
 
     stage: jax.Array  # (T,) int8 Stage
-    user: jax.Array  # (T,) i32 originating user index
+    user: jax.Array  # (T,) i32 originating user index — static (slot layout
+    #   u*S+k); kept as a materialised column for host-side readers
+    #   (recorder, parity replay); the engine derives it as idx // S instead
+    #   of gathering.  The publish topic is likewise derived
+    #   (users.pub_topic[user], MqttMsgPublish.msg:22), not stored.
     fog: jax.Array  # (T,) i32 assigned fog index (NO_TASK before)
-    topic: jax.Array  # (T,) i32 publish topic id (MqttMsgPublish.msg:22)
     mips_req: jax.Array  # (T,) f32 MIPSRequired
     t_create: jax.Array  # (T,) f32 publish creation time
     t_at_broker: jax.Array  # (T,) f32 publish arrival at base broker
@@ -290,7 +293,6 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         stage=jnp.zeros((T,), jnp.int8),
         user=jnp.repeat(jnp.arange(U, dtype=jnp.int32), spec.max_sends_per_user),
         fog=jnp.full((T,), NO_TASK, jnp.int32),
-        topic=jnp.zeros((T,), jnp.int32),
         mips_req=jnp.zeros((T,), f32),
         t_create=jnp.full((T,), jnp.inf, f32),
         t_at_broker=jnp.full((T,), jnp.inf, f32),
