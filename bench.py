@@ -8,10 +8,12 @@ into per-tick compacted argmin kernels under one ``lax.scan``).
 World: 10,000 users publishing every 2.5 ms to 32 heterogeneous fog nodes
 (4M offload decisions per simulated second), full v3 semantics: MQTT
 connect gating, advertisement staleness, FIFO queues, exact event-time ack
-chain.  The whole horizon runs as one jitted device-resident scan; wall
-time is measured on the second invocation (compile excluded) with a fresh
-PRNG key (same compiled executable).  Measured 2026-07 on the tunneled
-v5e chip: ~1.3-1.4M decisions/s/chip (vs_baseline ~1.35).
+chain.  The whole horizon runs as one jitted device-resident scan; the
+timed measurement enqueues BENCH_PIPELINE back-to-back runs (fresh PRNG
+key each, same executable) and syncs once — sustained throughput, since
+the tunneled runtime charges a flat ~95 ms per blocking fetch regardless
+of queued work.  Measured 2026-07 (round 3) on the tunneled v5e chip:
+~3.1M decisions/s/chip (vs_baseline ~3.1); device time 0.80 ms/tick.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` is value / 1e6 (the ≥1M decisions/sec/chip target; the
@@ -99,26 +101,38 @@ def main() -> None:
         arg0 = state
         rekey = lambda s, k: s.replace(key=k)
 
+    def fetch(m):
+        # force a real device->host sync: on the tunneled (axon) runtime
+        # jax.block_until_ready resolves before device completion; only a
+        # value fetch round-trips (measured: a fetch costs ~95 ms flat
+        # regardless of queued work — pure tunnel latency, not chip time)
+        return int(np.sum(np.asarray(m.n_scheduled)))
+
     # compile + warm
     t_c0 = time.perf_counter()
-    metrics = go(arg0)
-    jax.block_until_ready(metrics)
+    fetch(go(arg0))
     compile_s = time.perf_counter() - t_c0
 
-    # timed runs: same executable, fresh key per rep; report the median rep
-    # (run-to-run spread on the tunneled chip is ~10%, BENCHMARKS.md r2)
-    n_reps = _env_int("BENCH_REPS", 5)
-    walls = []
+    # timed: enqueue a pipeline of runs (fresh key each, same executable)
+    # and sync once at the end — sustained throughput, amortizing the
+    # harness's fixed ~95 ms sync latency the way any real sweep would.
+    # BENCH_REPS outer repetitions; the median repetition is reported.
+    n_pipeline = _env_int("BENCH_PIPELINE", 5)
+    n_reps = _env_int("BENCH_REPS", 3)
+    walls, decs = [], []
     for rep in range(n_reps):
-        arg1 = rekey(arg0, jax.random.PRNGKey(rep + 1))
+        args = [
+            rekey(arg0, jax.random.PRNGKey(1 + rep * n_pipeline + i))
+            for i in range(n_pipeline)
+        ]
         t0 = time.perf_counter()
-        metrics = go(arg1)
-        jax.block_until_ready(metrics)
+        ms = [go(a) for a in args]
+        d = sum(fetch(m) for m in ms)
         walls.append(time.perf_counter() - t0)
+        decs.append(d)
     wall = float(np.median(walls))
-
-    decisions = int(np.sum(np.asarray(metrics.n_scheduled)))
-    n_ticks = spec.n_ticks * n_replicas
+    decisions = decs[walls.index(wall)]
+    n_ticks = spec.n_ticks * n_replicas * n_pipeline
     value = decisions / wall
 
     print(
