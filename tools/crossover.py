@@ -1,0 +1,129 @@
+"""CPU-DES vs TPU-engine crossover measurement.
+
+Runs the SAME synthetic publish workloads through the native sequential
+event-driven core (``native/desim.cpp``, one CPU core) and the batched
+TPU engine, and prints one JSON line per (world, backend) with
+events/s (DES) and decisions/s (both).  The honest "when does the TPU
+win" answer demanded by the r2 verdict lands in BENCHMARKS.md.
+
+Usage: python tools/crossover.py [des|tpu|both]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+WORLDS = {
+    # name: (n_users, n_fogs, send_interval, horizon)
+    "example-ish:1u": (1, 5, 0.05, 3.35),
+    "smoke:2u": (2, 2, 0.05, 3.35),
+    "grid:96u": (96, 4, 0.01, 1.0),
+    "mid:1000u": (1000, 24, 0.01, 0.25),
+    "headline:10ku": (10_000, 32, 0.0025, 0.1),
+}
+
+
+def schedule(n_users, interval, horizon, seed=0):
+    """Synthetic client workload: staggered periodic publishes."""
+    rng = np.random.default_rng(seed)
+    start = rng.uniform(0.0, min(0.05, horizon / 4), n_users)
+    per_user = [
+        np.arange(start[u], horizon, interval) for u in range(n_users)
+    ]
+    user = np.concatenate(
+        [np.full(len(t), u, np.int32) for u, t in enumerate(per_user)]
+    )
+    t_create = np.concatenate(per_user)
+    order = np.argsort(t_create, kind="stable")
+    user, t_create = user[order], t_create[order]
+    mips = rng.integers(200, 901, len(user)).astype(np.float64)
+    return user, t_create, mips
+
+
+def run_des(name, n_users, n_fogs, interval, horizon):
+    from fognetsimpp_tpu.native.bridge import run_gen
+
+    user, t_create, mips = schedule(n_users, interval, horizon)
+    d_ub = np.full(n_users, 2.0424e-4)  # wired_star 1e-4 + ser(128B)
+    d_bf = np.full(n_fogs, 2.0424e-4)
+    fog_mips = np.asarray(
+        [(1000.0, 2000.0, 3000.0, 4000.0)[i % 4] for i in range(n_fogs)]
+    )
+    kw = dict(
+        task_user=user, task_t_create=t_create, task_mips_req=mips,
+        d_ub=d_ub, d_bf=d_bf, fog_mips=fog_mips,
+        register_t=d_bf.copy(), adv0_t=3 * d_bf, horizon=horizon,
+        queue_capacity=128,
+    )
+    run_gen(**kw)  # warm (JIT-free, but page in)
+    t0 = time.perf_counter()
+    out = run_gen(**kw)
+    wall = time.perf_counter() - t0
+    n_events = int(out["n_events"])
+    print(json.dumps({
+        "config": name, "backend": "des-1-cpu-core",
+        "tasks": len(user), "events": n_events,
+        "wall_s": round(wall, 4),
+        "events_per_sec": round(n_events / wall, 1),
+        "decisions_per_sec": round(len(user) / wall, 1),
+    }), flush=True)
+
+
+def run_tpu(name, n_users, n_fogs, interval, horizon):
+    import jax
+
+    from fognetsimpp_tpu.compile_cache import enable_compile_cache
+    from fognetsimpp_tpu.core.engine import run
+    from fognetsimpp_tpu.scenarios import smoke
+
+    enable_compile_cache()
+    spec, state, net, bounds = smoke.build(
+        n_users=n_users, n_fogs=n_fogs,
+        fog_mips=(1000.0, 2000.0, 3000.0, 4000.0),
+        send_interval=interval, horizon=horizon, dt=1e-3,
+        max_sends_per_user=int(horizon / interval) + 4,
+        arrival_window=min(
+            4096, max(64, int(1.1 * n_users * 1e-3 / interval))
+        ),
+        queue_capacity=128,
+        start_time_max=min(0.05, horizon / 4),
+    )
+
+    @jax.jit
+    def go(s):
+        return run(spec, s, net, bounds)[0].metrics
+
+    def fetch(m):
+        return int(np.sum(np.asarray(m.n_scheduled)))
+
+    fetch(go(state))  # compile + sync
+    n_pipe = 3
+    args = [state.replace(key=jax.random.PRNGKey(i + 1)) for i in range(n_pipe)]
+    t0 = time.perf_counter()
+    ms = [go(a) for a in args]
+    dec = sum(fetch(m) for m in ms)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "config": name, "backend": "tpu-batched-engine",
+        "decisions": dec, "wall_s": round(wall, 4),
+        "decisions_per_sec": round(dec / wall, 1),
+    }), flush=True)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    for name, (u, f, iv, hz) in WORLDS.items():
+        if which in ("des", "both"):
+            run_des(name, u, f, iv, hz)
+        if which in ("tpu", "both"):
+            run_tpu(name, u, f, iv, hz)
+
+
+if __name__ == "__main__":
+    main()
