@@ -1212,7 +1212,7 @@ def make_step(
         if spec.connect_gating:
             state, buf = _phase_connect(spec, state, net, cache, buf, t0, t1)
         state = _phase_adverts(state, t1)
-        if spec.adv_periodic:
+        if spec.adv_periodic and spec.fog_model != int(FogModel.POOL):
             state = _phase_periodic_adverts(spec, state, net, cache, t0, t1)
         state, buf = _phase_spawn(spec, state, net, cache, buf, t0, t1)
         if _broker_dense_ok(spec):
@@ -1222,6 +1222,28 @@ def make_step(
         if spec.n_fogs > 0:  # a fog-less world exercises only the
             # "no compute resource available" branch (BrokerBaseApp3.cc:306)
             if spec.fog_model == int(FogModel.POOL):
+                if spec.adv_periodic:
+                    # sub-tick advert-boundary phasing: the periodic
+                    # advertisement's payload is the pool *at the fire
+                    # time* (the reference reads this->MIPS when the timer
+                    # fires, ComputeBrokerApp2.cc:202-220), so fog events
+                    # up to the boundary must settle first, then the
+                    # capture, then the rest of the tick.  Exactness r3:
+                    # the r2 gate tolerated 5% choice divergence from the
+                    # start-of-tick capture.
+                    t_fire = (
+                        jnp.floor(t0 / spec.adv_interval) + 1.0
+                    ) * spec.adv_interval
+                    t_a = jnp.minimum(t_fire, t1)
+                    state, buf = _phase_pool_completions(
+                        spec, state, net, cache, buf, t_a
+                    )
+                    state, buf = _phase_pool_arrivals(
+                        spec, state, net, cache, buf, t_a
+                    )
+                    state = _phase_periodic_adverts(
+                        spec, state, net, cache, t0, t1
+                    )
                 state, buf = _phase_pool_completions(
                     spec, state, net, cache, buf, t1
                 )

@@ -231,21 +231,22 @@ def test_parity_v2_pool():
     )
     final, _ = run(spec, state, net, bounds)
     des, used = bridge.replay_engine_world(spec, final, net)
-    eng_stage = np.asarray(final.tasks.stage)[used]
-    # decisions depend on the advertised-pool view whose refresh the tick
-    # engine batches per tick; allow rare boundary divergences
-    agree = (np.asarray(final.tasks.fog)[used] == des["fog"]).mean()
-    assert agree > 0.95, agree
-    same = np.asarray(final.tasks.fog)[used] == des["fog"]
-    assert (eng_stage[same] == des["stage"][same]).all()
+    # exact gate (r3): the engine splits the POOL fog phases at the
+    # periodic-advert boundary so the advertised pool is captured at the
+    # exact fire time (engine.py make_step) — decisions now agree 100%,
+    # like the v3/v1 gates (the r2 gate tolerated 5% divergence)
+    np.testing.assert_array_equal(np.asarray(final.tasks.fog)[used], des["fog"])
+    np.testing.assert_array_equal(
+        np.asarray(final.tasks.stage)[used], des["stage"]
+    )
     ack6 = _eng(final, used, "t_ack6")
-    both = same & np.isfinite(ack6) & np.isfinite(des["t_ack6"])
+    both = np.isfinite(ack6) & np.isfinite(des["t_ack6"])
     assert both.sum() >= 40
     t0 = _eng(final, used, "t_create")[both]
     lat_e = ack6[both] - t0
     lat_d = des["t_ack6"][both] - t0
     rel = np.abs(lat_e - lat_d) / np.maximum(lat_d, 1e-9)
-    assert rel.max() < 0.01
+    assert rel.max() < 1e-3
 
 
 def test_queue_times_match(worlds):
