@@ -46,7 +46,7 @@ from ..net.mobility import MobilityBounds, step_mobility
 from ..net.energy import step_energy
 from ..net.topology import LinkCache, NetParams, associate
 from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
-from ..ops.sched import schedule_batch
+from ..ops.sched import schedule_batch, task_uniform
 from ..spec import FogModel, Policy, Stage, WorldSpec
 from ..state import WorldState
 
@@ -586,11 +586,19 @@ def _phase_broker(
     )
 
     offl = valid & ~local
+    if spec.policy in (int(Policy.RANDOM), int(Policy.DYNAMIC)):
+        # the RANDOM stream is keyed on the global task id (shared with
+        # the native DES, see ops/sched.py::task_uniform)
+        rand_u = task_uniform(
+            jax.random.PRNGKey(spec.policy_seed), idxc
+        )
+    else:
+        rand_u = None
     choice, rr_new = schedule_batch(
         spec.policy, offl, mips_g, b.view_busy, b.view_mips,
         b.registered, fog_alive, fog_efrac, rtt_bf, b.rr_next, k_sched,
         spec.bug_compat.mips0_divisor, spec.bug_compat.v1_max_scan,
-        policy_id=b.policy_id, order_t=t_ab_g,
+        policy_id=b.policy_id, order_t=t_ab_g, rand_u=rand_u,
     )
     choice_ok = choice >= 0
     guard_fail = jnp.zeros((K,), bool)

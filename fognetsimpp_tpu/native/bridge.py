@@ -64,7 +64,11 @@ def _load() -> ctypes.CDLL:
             + [ctypes.c_double]  # horizon
             + [ctypes.c_int] * 10  # policy..queue_capacity
             + [ctypes.c_double] * 3  # broker_mips, required_time, adv_interval
+            + [dp, dp]  # fog_energy0, fog_energy_cap (nullable)
+            + [ctypes.c_double] * 4  # tx_j, rx_j, idle_w, compute_w
+            + [dp]  # rand_u (nullable)
             + [dp, ip] + [dp] * 9 + [ip]
+            + [dp]  # o_fog_energy (nullable)
         )
         _lib = lib
     return _lib
@@ -93,6 +97,13 @@ def run_gen(
     broker_mips: float = 0.0,
     required_time: float = 0.01,
     adv_interval: float = 0.01,
+    fog_energy0: Optional[np.ndarray] = None,  # enables the energy model
+    fog_energy_cap: Optional[np.ndarray] = None,
+    tx_energy_j: float = 0.0,
+    rx_energy_j: float = 0.0,
+    idle_power_w: float = 0.0,
+    compute_power_w: float = 0.0,
+    rand_u: Optional[np.ndarray] = None,  # RANDOM's shared per-task draws
 ) -> Dict[str, np.ndarray]:
     """Run the native DES over an explicit publish schedule."""
     lib = _load()
@@ -120,6 +131,18 @@ def run_gen(
     def pi(a):
         return a.ctypes.data_as(ip)
 
+    null_d = ctypes.cast(None, dp)
+    e0 = d(fog_energy0) if fog_energy0 is not None else None
+    ecap = (
+        d(fog_energy_cap)
+        if fog_energy_cap is not None
+        else (np.ones_like(e0) if e0 is not None else None)
+    )
+    ru = d(rand_u) if rand_u is not None else None
+    fog_energy_out = (
+        np.empty((len(d_bf),), np.float64) if e0 is not None else None
+    )
+
     n_events = lib.desim_run_gen(
         len(d_ub), len(d_bf), n_tasks,
         pi(task_user), pd(ins[0]), pd(ins[1]),
@@ -131,16 +154,24 @@ def run_gen(
         int(queue_capacity),
         ctypes.c_double(broker_mips), ctypes.c_double(required_time),
         ctypes.c_double(adv_interval),
+        pd(e0) if e0 is not None else null_d,
+        pd(ecap) if ecap is not None else null_d,
+        ctypes.c_double(tx_energy_j), ctypes.c_double(rx_energy_j),
+        ctypes.c_double(idle_power_w), ctypes.c_double(compute_power_w),
+        pd(ru) if ru is not None else null_d,
         pd(outs_d["t_at_broker"]), pi(fog), pd(outs_d["t_at_fog"]),
         pd(outs_d["t_service_start"]), pd(outs_d["t_complete"]),
         pd(outs_d["t_ack3"]), pd(outs_d["t_ack4_fwd"]), pd(outs_d["t_ack5"]),
         pd(outs_d["t_ack4_queued"]), pd(outs_d["t_ack6"]),
         pd(outs_d["queue_time"]), pi(stage),
+        pd(fog_energy_out) if fog_energy_out is not None else null_d,
     )
     out = dict(outs_d)
     out["fog"] = fog
     out["stage"] = stage
     out["n_events"] = np.asarray(n_events)
+    if fog_energy_out is not None:
+        out["fog_energy"] = fog_energy_out
     return out
 
 
@@ -173,9 +204,10 @@ def replay_engine_world(
         raise NotImplementedError(
             "replay_engine_world requires stationary nodes"
         )
-    # MIN_BUSY, ROUND_ROBIN, MIN_LATENCY, LOCAL_FIRST, MAX_MIPS; the DES
-    # has no ENERGY_AWARE (no energy model) or RANDOM (no shared PRNG)
-    if spec.policy not in (0, 1, 2, 5, 6):
+    # all 7 policies have a sequential baseline (r3): ENERGY_AWARE runs on
+    # the DES's per-fog energy model (fed the spec's joule parameters) and
+    # RANDOM consumes the same task-id-keyed stream as the engine
+    if spec.policy not in (0, 1, 2, 3, 4, 5, 6):
         raise NotImplementedError(
             f"native DES has no parity path for policy {spec.policy}"
         )
@@ -194,6 +226,43 @@ def replay_engine_world(
     state0 = prime_initial_advertisements(spec, init_state(spec), net)
     register_t = np.asarray(state0.broker.register_t, np.float64)
     adv0_t = np.asarray(state0.broker.adv_arrive_t, np.float64)
+
+    energy_kw = {}
+    if spec.policy == 3 or spec.energy_enabled:
+        # feed the DES the same joule model (net/energy.py parameters) and
+        # the scenario's initial fog energies; harvesting and lifecycle
+        # thresholds are not modelled in the DES (parity scenarios run
+        # them off)
+        fog_sl = slice(spec.n_users, spec.n_users + spec.n_fogs)
+        caps = np.asarray(final_state.nodes.energy_capacity, np.float64)[
+            fog_sl
+        ]
+        energy_kw = dict(
+            # nodes boot with a full battery (init_state; scenario
+            # builders that drain fogs pre-run have no replay path)
+            fog_energy0=caps.copy(),
+            fog_energy_cap=caps,
+            tx_energy_j=spec.tx_energy_j if spec.energy_enabled else 0.0,
+            rx_energy_j=spec.rx_energy_j if spec.energy_enabled else 0.0,
+            idle_power_w=spec.idle_power_w if spec.energy_enabled else 0.0,
+            compute_power_w=(
+                spec.compute_power_w if spec.energy_enabled else 0.0
+            ),
+        )
+    rand_kw = {}
+    if spec.policy == 4:
+        from ..ops.sched import task_uniform
+        import jax
+
+        ids = np.nonzero(used)[0].astype(np.int32)
+        rand_kw = dict(
+            rand_u=np.asarray(
+                task_uniform(
+                    jax.random.PRNGKey(spec.policy_seed), jnp.asarray(ids)
+                ),
+                np.float64,
+            )
+        )
 
     return run_gen(
         task_user=np.asarray(tasks.user)[used],
@@ -218,4 +287,6 @@ def replay_engine_world(
         broker_mips=spec.broker_mips,
         required_time=spec.required_time,
         adv_interval=spec.adv_interval,
+        **energy_kw,
+        **rand_kw,
     ), used

@@ -58,13 +58,17 @@ enum Stage : int {
   kRejected = 9,
 };
 
-// Policy codes matching fognetsimpp_tpu.spec.Policy (subset with DES
-// parity; ENERGY_AWARE needs the energy model and RANDOM a shared PRNG —
-// neither has a sequential baseline here).
+// Policy codes matching fognetsimpp_tpu.spec.Policy.  r3: ENERGY_AWARE
+// runs on the native per-fog energy model below (same joule accounting as
+// net/energy.py) and RANDOM consumes the caller-provided per-task unit
+// draws (ops/sched.py::task_uniform) — all 7 policies have a sequential
+// baseline.
 enum Policy : int {
   kMinBusy = 0,
   kRoundRobin = 1,
   kMinLatency = 2,
+  kEnergyAware = 3,
+  kRandom = 4,
   kLocalFirst = 5,
   kMaxMips = 6,
 };
@@ -107,6 +111,16 @@ struct Fog {
   double busy_until = kInf;
   std::vector<int> fifo;   // requests[] vector (head = front)
   size_t head = 0;
+  // per-fog energy (net/energy.py joule model, continuous-time form):
+  // linear idle/compute drain integrated lazily at each touching event,
+  // per-message costs deducted at the event, clipped to [0, cap].
+  // (No harvesting or lifecycle thresholds here: the parity scenarios run
+  // them off; the engine's tick model books message costs in the deciding
+  // tick, so the skew between the two accountings is <= one tick.)
+  bool has_energy = false;
+  double energy = 0.0;
+  double cap = 1.0;
+  double t_energy = 0.0;  // last integration time
 };
 
 struct Task {
@@ -139,6 +153,9 @@ struct Params {
   int v1_max_scan, local_pool_leak;
   int queue_capacity;
   double broker_mips, required_time, adv_interval;
+  // energy model (spec.tx_energy_j etc.) + RANDOM's shared stream
+  double tx_j, rx_j, idle_w, compute_w;
+  const double* rand_u;  // (n_tasks) or nullptr
 };
 
 struct World {
@@ -156,10 +173,27 @@ struct World {
     heap.push(Event{t, seq++, kind, a, x, y});
   }
 
+  // Lazy linear drain (idle + compute-while-serving), then optional
+  // per-message joule cost; clip to [0, cap] like net/energy.py.
+  void touch_energy(int f, double now, double msg_j = 0.0) {
+    Fog& fg = fogs[f];
+    if (!fg.has_energy) return;
+    double drain =
+        (p.idle_w + (fg.current >= 0 ? p.compute_w : 0.0)) *
+        (now - fg.t_energy);
+    fg.t_energy = now;
+    fg.energy -= drain + msg_j;
+    if (fg.energy < 0.0) fg.energy = 0.0;
+    if (fg.energy > fg.cap) fg.energy = fg.cap;
+  }
+
   // v3 `<` scan over brokers[] (BrokerBaseApp3.cc:267-281): first-wins
   // tie-break, +inf estimates while the view MIPS is 0.  MIN_LATENCY is
-  // the same scan with the broker->fog round trip added per candidate.
-  int pick_min_score(double req, bool add_rtt) const {
+  // the same scan with the broker->fog round trip added per candidate;
+  // ENERGY_AWARE adds 10*(1 - energy fraction) evaluated at decision time
+  // (the dead `algo` parameter realised — same formula as ops/sched.py).
+  int pick_min_score(double req, bool add_rtt, bool add_energy,
+                     double now) {
     int best = -1;
     double best_score = kInf;
     bool any = false;
@@ -169,6 +203,11 @@ struct World {
       double est = div > 0.0 ? req / div : kInf;
       double score = view_busy[f] + est;
       if (add_rtt) score += 2.0 * p.d_bf[f];
+      if (add_energy) {
+        touch_energy(f, now);
+        double cap = fogs[f].cap > 1e-12 ? fogs[f].cap : 1e-12;
+        score += 10.0 * (1.0 - fogs[f].energy / cap);
+      }
       if (!any || score < best_score) {
         best = f;
         best_score = score;
@@ -176,6 +215,21 @@ struct World {
       }
     }
     return any ? best : -1;
+  }
+
+  // RANDOM: slot = floor(u * n_registered) computed in f32 exactly like
+  // the engine (ops/sched.py b_random) over the shared per-task stream.
+  int pick_random(int task) {
+    std::vector<int> avail;
+    for (int f = 0; f < p.n_fogs; ++f)
+      if (registered[f]) avail.push_back(f);
+    if (avail.empty() || p.rand_u == nullptr) return avail.empty() ? -1 : avail[0];
+    float u = static_cast<float>(p.rand_u[task]);
+    int slot = static_cast<int>(u * static_cast<float>(avail.size()));
+    if (slot < 0) slot = 0;
+    if (slot >= static_cast<int>(avail.size()))
+      slot = static_cast<int>(avail.size()) - 1;
+    return avail[slot];
   }
 
   // ROUND_ROBIN over the registered set; the cursor advances per decision
@@ -229,13 +283,19 @@ struct World {
     int choice;
     switch (p.policy) {
       case kMinBusy:
-        choice = pick_min_score(tk.mips_req, /*add_rtt=*/false);
+        choice = pick_min_score(tk.mips_req, false, false, now);
         break;
       case kRoundRobin:
         choice = pick_round_robin();
         break;
       case kMinLatency:
-        choice = pick_min_score(tk.mips_req, /*add_rtt=*/true);
+        choice = pick_min_score(tk.mips_req, true, false, now);
+        break;
+      case kEnergyAware:
+        choice = pick_min_score(tk.mips_req, false, true, now);
+        break;
+      case kRandom:
+        choice = pick_random(i);
         break;
       default:
         choice = pick_max_mips();
@@ -259,6 +319,9 @@ struct World {
   void fifo_arrive(int i, double now) {  // ComputeBrokerApp3.cc:269-320
     Task& tk = tasks[i];
     Fog& fg = fogs[tk.fog];
+    // fog rx (the task) + tx (the assigned/queued ack) — the engine books
+    // both per arrival (engine.py _phase_fog_arrivals tx_f/rx_f)
+    touch_energy(tk.fog, now, p.rx_j + p.tx_j);
     tk.svc = tk.mips_req / fg.mips;       // tskTime (:276)
     fg.busy_time += tk.svc;               // busyTime += tskTime (:279)
     if (fg.current < 0) {                 // idle: assign (:282-303)
@@ -286,6 +349,10 @@ struct World {
     if (fg.current < 0) return;
     Task& done = tasks[fg.current];
     double t_done = fg.busy_until;
+    // ack6 tx (+ advert tx when adv_on_completion) — engine books
+    // comp * (2 | 1) in _phase_completions.  Touch BEFORE clearing
+    // `current` so the compute drain integrates over the service time.
+    touch_energy(f, t_done, p.tx_j * (p.adv_on_completion ? 2.0 : 1.0));
     done.stage = kDone;
     done.t_complete = t_done;
     done.t_ack6 = t_done + p.d_bf[f] + p.d_ub[done.user];  // "performed"
@@ -309,6 +376,7 @@ struct World {
   void pool_arrive(int i, double now) {  // ComputeBrokerApp2.cc:258-310
     Task& tk = tasks[i];
     Fog& fg = fogs[tk.fog];
+    touch_energy(tk.fog, now, p.rx_j + p.tx_j);  // task rx + TaskAck tx
     if (tk.mips_req < fg.pool) {  // strict <, :269
       fg.pool -= tk.mips_req;     // :272
       tk.stage = kRunning;
@@ -322,6 +390,7 @@ struct World {
 
   void pool_done(int i, double now) {  // releaseResource (:222-245)
     Task& tk = tasks[i];
+    touch_energy(tk.fog, now, p.tx_j);  // status-6 Puback tx
     fogs[tk.fog].pool += tk.mips_req;
     tk.stage = kDone;
     if (p.app_gen >= 2)  // v1 acks via FognetMsgTaskAck, which the broker
@@ -403,17 +472,24 @@ long desim_run_gen(
     int adv_periodic, int v1_max_scan, int local_pool_leak,
     int queue_capacity, double broker_mips, double required_time,
     double adv_interval,
+    // energy model (r3; nullptr fog_energy0 disables) + RANDOM stream
+    const double* fog_energy0,  // (n_fogs) initial joules or nullptr
+    const double* fog_energy_cap,  // (n_fogs)
+    double tx_j, double rx_j, double idle_w, double compute_w,
+    const double* rand_u,  // (n_tasks) RANDOM unit draws or nullptr
     // outputs (n_tasks):
     double* o_t_at_broker, int* o_fog, double* o_t_at_fog,
     double* o_t_service_start, double* o_t_complete, double* o_t_ack3,
     double* o_t_ack4_fwd, double* o_t_ack5, double* o_t_ack4_queued,
-    double* o_t_ack6, double* o_queue_time, int* o_stage) {
+    double* o_t_ack6, double* o_queue_time, int* o_stage,
+    double* o_fog_energy  // (n_fogs) final joules (energy model on)
+    ) {
   World w;
   w.p = Params{n_users, n_fogs, n_tasks, d_ub, d_bf, horizon, policy,
                fog_model, app_gen, mips0_divisor, zero_initial_view,
                adv_on_completion, adv_periodic, v1_max_scan,
                local_pool_leak, queue_capacity, broker_mips, required_time,
-               adv_interval};
+               adv_interval, tx_j, rx_j, idle_w, compute_w, rand_u};
   w.fogs.resize(n_fogs);
   w.tasks.resize(n_tasks);
   w.view_mips.assign(n_fogs, 0.0);
@@ -424,6 +500,11 @@ long desim_run_gen(
   for (int f = 0; f < n_fogs; ++f) {
     w.fogs[f].mips = fog_mips[f];
     w.fogs[f].pool = fog_mips[f];
+    if (fog_energy0 != nullptr) {
+      w.fogs[f].has_energy = true;
+      w.fogs[f].energy = fog_energy0[f];
+      w.fogs[f].cap = fog_energy_cap[f];
+    }
     if (!zero_initial_view) w.view_mips[f] = fog_mips[f];
     if (std::isfinite(register_t[f])) w.push(register_t[f], kEvRegister, f);
     if (std::isfinite(adv0_t[f]))
@@ -444,6 +525,12 @@ long desim_run_gen(
 
   long n_events = w.run();
 
+  if (o_fog_energy != nullptr) {
+    for (int f = 0; f < n_fogs; ++f) {
+      w.touch_energy(f, horizon);  // settle drains to the horizon
+      o_fog_energy[f] = w.fogs[f].energy;
+    }
+  }
   for (int i = 0; i < n_tasks; ++i) {
     const Task& tk = w.tasks[i];
     o_t_at_broker[i] = tk.t_at_broker;

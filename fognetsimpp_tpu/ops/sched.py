@@ -29,6 +29,18 @@ from ..spec import Policy
 _BIG = jnp.float32(3.4e38)
 
 
+def task_uniform(base_key: jax.Array, task_ids: jax.Array) -> jax.Array:
+    """Per-task unit draws: u[i] = U(fold_in(base_key, task_ids[i])).
+
+    A pure function of the task id, independent of tick batching or
+    execution order — the RANDOM policy's shared stream.  The native DES
+    receives these exact f32 values (``bridge.replay_engine_world``).
+    """
+    return jax.vmap(
+        lambda i: jax.random.uniform(jax.random.fold_in(base_key, i))
+    )(task_ids)
+
+
 def _safe_div(a: jax.Array, b: jax.Array) -> jax.Array:
     """a / b with b==0 -> +inf (matches C++ double division by zero).
 
@@ -57,6 +69,11 @@ def schedule_batch(
     order_t: Optional[jax.Array] = None,  # (T,) f32 arrival times: orders
     #   same-window ROUND_ROBIN slots by event time (ties by index) the way
     #   a sequential broker would; None = compacted-index order
+    rand_u: Optional[jax.Array] = None,  # (T,) f32 per-task unit draws for
+    #   RANDOM — a pure function of the global task id (engine supplies
+    #   task_uniform(spec.policy_seed, ids)) so the native DES can consume
+    #   the identical stream; None derives a stream from `key` + index
+    #   (unit-test convenience, no DES parity)
 ) -> Tuple[jax.Array, jax.Array]:
     """Pick a fog node for every masked task. Returns ((T,) i32 fog, rr').
 
@@ -149,12 +166,24 @@ def schedule_batch(
 
     def b_random():
         ok = avail & fog_alive
-        logits = jnp.where(ok, 0.0, -jnp.inf)
-        # all -inf logits make categorical undefined: guard with -1
-        choice = jax.random.categorical(key, logits, shape=(T,)).astype(
-            jnp.int32
+        n_ok = jnp.sum(ok.astype(jnp.int32))
+        if rand_u is None:
+            u = task_uniform(key, jnp.arange(T, dtype=jnp.int32))
+        else:
+            u = rand_u
+        # slot = floor(u * n_ok) in f32 — the DES computes the identical
+        # float expression so boundary rounding agrees bit-for-bit
+        slot = jnp.clip(
+            (u * n_ok.astype(jnp.float32)).astype(jnp.int32),
+            0,
+            jnp.maximum(n_ok - 1, 0),
         )
-        choice = jnp.where(jnp.any(ok), choice, -1)
+        ok_rank = jnp.cumsum(ok.astype(jnp.int32)) - 1  # (F,)
+        fog_of_slot = jnp.zeros((F,), jnp.int32).at[
+            jnp.where(ok, ok_rank, F)
+        ].set(jnp.arange(F, dtype=jnp.int32), mode="drop")
+        choice = fog_of_slot[slot]
+        choice = jnp.where(n_ok > 0, choice, -1)
         return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
 
     branches = {

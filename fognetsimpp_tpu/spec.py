@@ -203,6 +203,11 @@ class WorldSpec:
 
     # --- scheduling / fog model ---------------------------------------
     policy: int = int(Policy.MIN_BUSY)
+    # RANDOM policy: the per-task unit draw is a pure function of the task
+    # id keyed on this seed (threefry fold_in), NOT of the tick batching —
+    # so the native DES consumes the identical stream and the RANDOM
+    # policy is exact-parity-gated like the deterministic ones (r3).
+    policy_seed: int = 0
     fog_model: int = int(FogModel.FIFO)
     adv_interval: float = 0.01  # v1/v2 periodic re-advertise
     adv_on_completion: bool = True  # v3 (ComputeBrokerApp3.cc:254)
