@@ -261,7 +261,10 @@ def _phase_spawn(
         # after stopTime cannot publish
         due = due & (t_create < spec.send_stop_time)
 
-    key, k_mips, k_jit, k_loss = jax.random.split(state.key, 4)
+    if spec.wired_queue_enabled:
+        key, k_mips, k_jit, k_loss, k_dtail = jax.random.split(state.key, 5)
+    else:
+        key, k_mips, k_jit, k_loss = jax.random.split(state.key, 4)
     if spec.fixed_mips_required is not None:
         mips_req = jnp.full((U,), float(spec.fixed_mips_required), jnp.float32)
     else:
@@ -291,6 +294,15 @@ def _phase_spawn(
         )
         if spec.link_up_s > 0:
             lost = lost & (t_create + d_ub >= spec.link_up_s)
+    if spec.wired_queue_enabled:
+        # DropTail: a publish entering a full egress queue (its own link
+        # or the broker's) is tail-dropped with last tick's overflow
+        # fraction.  Acks/adverts are delayed, not dropped (batched
+        # approximation; drops are counted in metrics.n_link_drops).
+        p_u = state.nodes.link_drop_p[:U]
+        p_b = state.nodes.link_drop_p[spec.broker_index]
+        p_eff = 1.0 - (1.0 - p_u) * (1.0 - p_b)
+        lost = lost | (jax.random.uniform(k_dtail, (U,)) < p_eff)
     stage_new = jnp.where(
         lost, jnp.int8(int(Stage.LOST)), jnp.int8(int(Stage.PUB_INFLIGHT))
     )
@@ -1215,6 +1227,13 @@ def make_step(
 
         # 2. connectivity / association snapshot for this tick
         cache = associate(net, pos, nodes.alive, broker=spec.broker_index)
+        if spec.wired_queue_enabled:
+            # DropTailQueue backpressure (wireless5.ini:72-73): last
+            # tick's egress backlog serializes ahead of new messages
+            qdelay = state.nodes.link_backlog * (8.0 / spec.link_rate_bps)
+            cache = cache.replace(
+                d2b=cache.d2b + qdelay + qdelay[spec.broker_index]
+            )
 
         # 3-7. protocol phases
         if spec.connect_gating:
@@ -1262,6 +1281,40 @@ def make_step(
                 state, buf = _phase_fog_arrivals(spec, state, net, cache, buf, t1)
         if spec.policy == int(Policy.LOCAL_FIRST):
             state, buf = _phase_local_completions(spec, state, net, cache, buf, t1)
+
+        # 7b. wired-link DropTail queues: integrate this tick's egress
+        # traffic into each wired node's serialization backlog; overflow
+        # beyond frameCapacity becomes next tick's tail-drop probability
+        if spec.wired_queue_enabled:
+            n_rest_q = spec.n_aps + spec.n_routers
+            tx_all = jnp.concatenate(
+                [buf.tx_u, buf.tx_f, buf.tx_b[None],
+                 jnp.zeros((n_rest_q,), i32)]
+            )
+            add_bytes = tx_all.astype(jnp.float32) * float(spec.task_bytes)
+            drain = jnp.float32(spec.link_rate_bps / 8.0 * spec.dt)
+            raw = state.nodes.link_backlog + add_bytes - drain
+            cap_bytes = float(spec.link_queue_frames * spec.task_bytes)
+            wired = ~net.is_wireless
+            backlog = jnp.where(
+                wired, jnp.clip(raw, 0.0, cap_bytes), 0.0
+            )
+            overflow = jnp.where(wired, jnp.maximum(raw - cap_bytes, 0.0), 0.0)
+            drop_p = jnp.clip(
+                overflow / jnp.maximum(add_bytes, 1.0), 0.0, 1.0
+            )
+            n_drops = jnp.sum(overflow).astype(jnp.float32) / float(
+                spec.task_bytes
+            )
+            state = state.replace(
+                nodes=state.nodes.replace(
+                    link_backlog=backlog, link_drop_p=drop_p
+                ),
+                metrics=state.metrics.replace(
+                    n_link_drops=state.metrics.n_link_drops
+                    + n_drops.astype(i32)
+                ),
+            )
 
         # 8. energy + lifecycle
         if spec.energy_enabled:

@@ -10,9 +10,12 @@ time), composed of
 
 where ``core`` is a small all-pairs base-delay matrix over *infrastructure
 attach points* (wired hosts, APs, routers — shortest path over link
-propagation + serialization, Floyd–Warshall at build time; wired-link
-queueing is deliberately not modeled, as no reference scenario drives its
-100 Mbps eth links anywhere near saturation),
+propagation + serialization, Floyd–Warshall at build time; the
+DropTailQueue on every eth interface — ``wireless5.ini:72-73`` — has a
+batched analog in the engine, ``spec.wired_queue_enabled``: per-node
+egress backlog with serialization backpressure and frameCapacity tail
+drops, off by default since no reference scenario drives its 100 Mbps
+links near saturation, a claim ``tests/test_link_queue.py`` now tests),
 ``attach`` maps a node to its attach point (itself if wired, its associated
 AP if wireless — association is argmin distance within range, recomputed
 every tick so handover is emergent, mirroring INET's 802.11 mgmt), and

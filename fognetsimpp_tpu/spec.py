@@ -225,6 +225,20 @@ class WorldSpec:
     # tasks enter Stage.LOST and are counted in metrics.n_lost.
     uplink_loss_prob: float = 0.0
 
+    # --- wired-link queueing (DropTailQueue, wireless5.ini:72-73) ------
+    # The reference runs a frameCapacity=40 DropTailQueue on every eth
+    # interface; under load wired links delay and drop.  When enabled,
+    # each node's access link carries a serialization backlog: per tick
+    # backlog += message_bytes - rate*dt, added delay = backlog/rate, and
+    # overflow beyond 40 frames becomes a DropTail loss probability
+    # applied to next-tick publishes (acks are delayed, not dropped — the
+    # batched analog of tail-dropping a full queue).  Off by default: no
+    # committed reference scenario drives links near saturation
+    # (tests/test_link_queue.py validates that claim).
+    wired_queue_enabled: bool = False
+    link_rate_bps: float = 100e6  # DatarateChannel 100 Mbps
+    link_queue_frames: int = 40  # frameCapacity
+
     # --- link warm-up (INET ARP/802.11-association transient) ----------
     # In every committed reference wireless run the first ~1 s of uplink
     # packets buffer below the app while ARP + association resolve, then

@@ -47,6 +47,9 @@ class NodeState:
     energy: jax.Array  # (N,) f32 joules
     energy_capacity: jax.Array  # (N,) f32 joules
     has_energy: jax.Array  # (N,) bool — node participates in energy model
+    # wired-link DropTailQueue analog (spec.wired_queue_enabled):
+    link_backlog: jax.Array  # (N,) f32 bytes queued on the access link
+    link_drop_p: jax.Array  # (N,) f32 next-tick DropTail loss probability
 
 
 @struct.dataclass
@@ -179,7 +182,10 @@ class Metrics:
     n_adverts: jax.Array  # () i32 FognetMsgAdvertiseMIPS delivered to the
     #                        broker (latest-wins slot: superseded in-flight
     #                        adverts are merged, as in BrokerView)
-    n_lost: jax.Array  # () i32 publishes lost on the wireless uplink
+    n_lost: jax.Array  # () i32 publishes lost on the wireless uplink or
+    #                      to a DropTail wired-queue overflow
+    n_link_drops: jax.Array  # () i32 frames dropped by full wired queues
+    #                           (spec.wired_queue_enabled)
 
 
 @struct.dataclass
@@ -235,6 +241,8 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         energy=jnp.full((N,), spec.energy_capacity_j, f32),
         energy_capacity=jnp.full((N,), spec.energy_capacity_j, f32),
         has_energy=jnp.zeros((N,), bool),
+        link_backlog=jnp.zeros((N,), f32),
+        link_drop_p=jnp.zeros((N,), f32),
     )
 
     key, k_start = jax.random.split(key)
@@ -321,6 +329,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         n_local=jnp.zeros((), jnp.int32),
         n_adverts=jnp.zeros((), jnp.int32),
         n_lost=jnp.zeros((), jnp.int32),
+        n_link_drops=jnp.zeros((), jnp.int32),
     )
 
     return WorldState(
