@@ -277,10 +277,22 @@ def _phase_spawn(
     t_arrive = t_create + d_ub
     if spec.link_up_s > 0:
         # ARP/association warm-up: a publish that would arrive before the
-        # link is up instead arrives at its drain slot (spec.link_up_s)
-        drained = spec.link_up_s + users.send_count.astype(
-            jnp.float32
-        ) * jnp.float32(spec.link_drain_s)
+        # link is up instead arrives at its drain slot (spec.link_up_s).
+        # Two-phase drain when link_burst_n > 0: the first burst pours at
+        # link_drain_s gaps, the rest of the backlog at link_drain2_s
+        # (committed demo trace, General-0.vec vector 1093)
+        k = users.send_count.astype(jnp.float32)
+        if spec.link_burst_n > 0:
+            nb = float(spec.link_burst_n - 1)
+            pos = jnp.where(
+                k <= nb,
+                k * jnp.float32(spec.link_drain_s),
+                nb * jnp.float32(spec.link_drain_s)
+                + (k - nb) * jnp.float32(spec.link_drain2_s),
+            )
+        else:
+            pos = k * jnp.float32(spec.link_drain_s)
+        drained = spec.link_up_s + pos
         t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
     # wireless uplink loss (MAC retry exhaustion): the publish is sent and
     # costs tx energy, but never reaches the broker (spec.uplink_loss_prob).
@@ -342,6 +354,97 @@ def _phase_spawn(
     )
     buf = buf._replace(tx_u=buf.tx_u + due.astype(jnp.int32))
     return state.replace(users=users, tasks=tasks, metrics=metrics, key=key), buf
+
+
+def _phase_v2_release(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array, before_broker: bool,
+) -> Tuple[WorldState, TickBuf]:
+    """The v2 broker's shared-timer releaseResource (BrokerBaseApp2.cc:
+    284-312 via the selfMsg dance at :221-224).
+
+    One pending RELEASERESOURCE self-message exists at a time; each local
+    accept cancels and reschedules it, so only the LAST accepted task's
+    expiry ever fires, and each firing releases exactly ONE stored request
+    — the first in insertion (decision) order whose requiredTime passed:
+    pool += its MIPS (offloaded requests were stored without a debit,
+    BrokerBaseApp2.cc:244-252, so their release *inflates* the pool) and a
+    status-6 Puback goes straight to the client.  Local tasks complete
+    only here — a cancelled timer leaves them (and the pool) hanging,
+    which is exactly the leak that drains the pool during sub-requiredTime
+    publish bursts and forces the offloads observed in the committed demo
+    run (ComputeBroker1 received every forwarded task).
+
+    Called twice per tick: before the broker phase for fire times that
+    precede this tick's first publish arrival (the event-order case
+    "timer < arrival"), and after it for fire times the tick's decisions
+    did not cancel.
+    """
+    tasks, b = state.tasks, state.broker
+    T, S = spec.task_capacity, spec.max_sends_per_user
+    U = spec.n_users
+    i32 = jnp.int32
+    fire_t = b.release_timer_t
+    if before_broker:
+        # cancelEvent semantics: a local accept earlier than the fire time
+        # would cancel it, and any arrival must be *decided* first if it
+        # precedes the fire — so this pass only fires timers that precede
+        # every pending arrival
+        arr2 = (
+            tasks.stage.reshape(U, S) == jnp.int8(int(Stage.PUB_INFLIGHT))
+        ) & (tasks.t_at_broker.reshape(U, S) <= t1)
+        t_first_arr = jnp.min(
+            jnp.where(arr2, tasks.t_at_broker.reshape(U, S), jnp.inf)
+        )
+        fire = (fire_t <= t1) & (fire_t <= t_first_arr)
+    else:
+        fire = fire_t <= t1
+
+    # first stored request in insertion (= decision-time, ties by slot id)
+    # order whose requiredTime expired before the fire
+    expiry = tasks.t_at_broker + spec.required_time
+    open_m = (tasks.req_open > 0) & (expiry < fire_t)
+    key1 = jnp.where(open_m, tasks.t_at_broker, jnp.inf)
+    tmin = jnp.min(key1)
+    cand = open_m & (key1 == tmin)
+    sel = jnp.min(jnp.where(cand, jnp.arange(T, dtype=i32), T))
+    have = fire & (sel < T)
+    selc = jnp.clip(sel, 0, T - 1)
+    user_sel = selc // S
+    ack_t = fire_t + cache.d2b[user_sel]
+    was_local = tasks.stage[selc] == jnp.int8(int(Stage.LOCAL_RUN))
+
+    b = b.replace(
+        local_pool=b.local_pool
+        + jnp.where(have, tasks.mips_req[selc], 0.0),
+        # the self-message is spent whether or not a request matched
+        release_timer_t=jnp.where(fire, jnp.inf, fire_t),
+    )
+    scat = jnp.where(have, sel, T)
+    scat_local = jnp.where(have & was_local, sel, T)
+    tasks = tasks.replace(
+        req_open=tasks.req_open.at[scat].set(0, mode="drop"),
+        # duplicate status-6 for offloaded requests: the client acts on
+        # whichever lands first (mqttApp2.cc:279-291 erases the entry)
+        t_ack6=tasks.t_ack6.at[scat].min(
+            jnp.where(have, ack_t, jnp.inf), mode="drop"
+        ),
+        stage=tasks.stage.at[scat_local].set(
+            jnp.int8(int(Stage.DONE)), mode="drop"
+        ),
+        t_complete=tasks.t_complete.at[scat_local].set(
+            jnp.where(have, fire_t, 0.0), mode="drop"
+        ),
+    )
+    n_done = (have & was_local).astype(i32)
+    metrics = state.metrics.replace(
+        n_completed=state.metrics.n_completed + n_done
+    )
+    buf = buf._replace(
+        tx_b=buf.tx_b + have.astype(i32),
+        rx_u=buf.rx_u.at[user_sel].add(have.astype(i32), mode="drop"),
+    )
+    return state.replace(tasks=tasks, broker=b, metrics=metrics), buf
 
 
 def _broker_dense_ok(spec: WorldSpec) -> bool:
@@ -586,6 +689,19 @@ def _phase_broker(
         )
         local = jnp.zeros((K,), bool).at[order].set(local_sorted)
         b = b.replace(local_pool=pool_after)
+        if spec.v2_local_broker:
+            # every local accept cancels + reschedules the shared release
+            # self-message: only the LAST accept's expiry survives
+            # (BrokerBaseApp2.cc:221-224)
+            any_local = jnp.any(local)
+            t_last_acc = jnp.max(jnp.where(local, t_ab_g, -jnp.inf))
+            b = b.replace(
+                release_timer_t=jnp.where(
+                    any_local,
+                    t_last_acc + spec.required_time,
+                    b.release_timer_t,
+                )
+            )
 
     # ---- offload scheduling ------------------------------------------
     any_fog = jnp.any(b.registered)
@@ -664,11 +780,25 @@ def _phase_broker(
             t_service_start=tasks.t_service_start.at[idx].set(
                 jnp.where(local, t_ab_g, jnp.inf), mode="drop"
             ),
-            t_complete=tasks.t_complete.at[idx].set(
-                jnp.where(local, t_ab_g + spec.required_time, jnp.inf),
-                mode="drop",
-            ),
         )
+        if spec.v2_local_broker:
+            # v2 stores a Request for local accepts AND for every decided
+            # offload-branch publish when fogs exist (BrokerBaseApp2.cc:
+            # 212,244 — stored even when the MIPS guard then refuses to
+            # send); completion happens only at a release firing
+            store = local | (offl & any_fog)
+            tasks = tasks.replace(
+                req_open=tasks.req_open.at[
+                    jnp.where(store, idx, spec.task_capacity)
+                ].set(jnp.int8(1), mode="drop"),
+            )
+        else:
+            tasks = tasks.replace(
+                t_complete=tasks.t_complete.at[idx].set(
+                    jnp.where(local, t_ab_g + spec.required_time, jnp.inf),
+                    mode="drop",
+                ),
+            )
     i32 = jnp.int32
     # one stacked reduction for every scalar count of this phase
     sums = jnp.sum(
@@ -1242,10 +1372,21 @@ def make_step(
         if spec.adv_periodic and spec.fog_model != int(FogModel.POOL):
             state = _phase_periodic_adverts(spec, state, net, cache, t0, t1)
         state, buf = _phase_spawn(spec, state, net, cache, buf, t0, t1)
+        v2_local = (
+            spec.policy == int(Policy.LOCAL_FIRST) and spec.v2_local_broker
+        )
+        if v2_local:  # shared-timer fires that precede every arrival
+            state, buf = _phase_v2_release(
+                spec, state, net, cache, buf, t1, before_broker=True
+            )
         if _broker_dense_ok(spec):
             state, buf = _phase_broker_dense(spec, state, net, cache, buf, t1)
         else:
             state, buf = _phase_broker(spec, state, net, cache, buf, t1)
+        if v2_local:  # fires this tick's decisions did not cancel
+            state, buf = _phase_v2_release(
+                spec, state, net, cache, buf, t1, before_broker=False
+            )
         if spec.n_fogs > 0:  # a fog-less world exercises only the
             # "no compute resource available" branch (BrokerBaseApp3.cc:306)
             if spec.fog_model == int(FogModel.POOL):
@@ -1279,7 +1420,7 @@ def make_step(
                 for _ in range(spec.completions_per_tick):
                     state, buf = _phase_completions(spec, state, net, cache, buf, t1)
                 state, buf = _phase_fog_arrivals(spec, state, net, cache, buf, t1)
-        if spec.policy == int(Policy.LOCAL_FIRST):
+        if spec.policy == int(Policy.LOCAL_FIRST) and not spec.v2_local_broker:
             state, buf = _phase_local_completions(spec, state, net, cache, buf, t1)
 
         # 7b. wired-link DropTail queues: integrate this tick's egress

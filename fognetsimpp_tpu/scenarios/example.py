@@ -5,21 +5,32 @@ One 802.11 user circling (300, 300) at r=250 m / 40 mps
 (MIPS 1000, the v2 MIPS-pool model) behind routerD; three APs each
 backhauled through an own router to the broker (``wirelessNet.ned:94-114``).
 Apps are generation 2: ``BrokerBaseApp2`` / ``ComputeBrokerApp2`` /
-``mqttApp2`` (``wirelessNet.ini:56,62``) — POOL fogs with periodic
-advertisement, the v1/v2 offload scan, requiredTime expiry.
+``mqttApp2`` (``wirelessNet.ini:56,62``).
+
+The v2 base broker is a *hybrid*: ``MIPSRequired < MIPS`` runs locally on
+the broker's own 1000-MIPS pool (``wirelessNet.ini:58``,
+``BrokerBaseApp2.cc:181``); only pool-exhausted publishes offload via the
+buggy MAX_MIPS scan — with all fogs advertising 1000 MIPS the winner is
+always the first registered fog, which is why the committed run's
+ComputeBroker1 received every forwarded task (1 Connack + 4 tasks = 5
+"packets received") while ComputeBroker2–5 received only their Connack
+(``example/results/General-0.sca``).  The pool only exhausts because
+releaseResource runs off ONE shared self-message (each accept cancels the
+pending release, spec.v2_local_broker) — during the sub-requiredTime
+warm-up burst the pool leaks and a handful of offloads escape.
 
 Calibration: the reference's only committed ground truth is this run's
 ``delay`` vector — publish→broker transit, mean 0.502 s (n=52, min 0.401,
 max 0.981; BASELINE.md).  Reading the committed samples
-(``example/results/General-0.vec`` vector 1093) shows two regimes: a
-~1.04 s link warm-up during which the first 12 publishes buffer below the
-app and then drain as a burst (first sample's delay is exactly
-``link_up - app_start`` = 0.9814), settling to a *constant* 0.4015 s
-steady-state transit.  The parameters below reproduce both: ``link_up_s``/
-``link_drain_s`` model the warm-up (``WorldSpec`` link warm-up block) and
-``w_base`` carries the steady transit.
-``tests/test_scenarios.py::test_example_matches_committed_trace`` pins the
-resulting mean/min/max/n to the committed trace.
+(``example/results/General-0.vec`` vector 1093) shows three regimes: a
+~1.04 s link warm-up buffering the first 12 publishes; a drain burst with
+4–10 ms gaps (7 packets pour out from 1.0414 to 1.0755); a slow backlog
+trickle (samples near 0.90 s); then a *constant* 0.4015 s steady transit.
+``link_up_s``/``link_drain_s``/``link_burst_n``/``link_drain2_s`` model
+the warm-up and ``w_base`` the steady transit.  Two tests pin the same
+constants (no per-test refit): ``test_example_matches_committed_trace``
+(delay mean/min/max/n) and ``test_example_per_fog_traffic_split`` (the
+per-fog .sca counters above).
 """
 from __future__ import annotations
 
@@ -30,10 +41,13 @@ from .wireless import InfraGraph, assemble, _deg
 # (and the .sca sent-vs-recorded counts: 67 sent, 52 delay samples):
 CALIB_START = 0.06  # first publish creation time in the committed run
 CALIB_LINK_UP = 1.0414  # link-up instant (max delay = 1.0414 - 0.06)
-CALIB_DRAIN = 0.0237  # backlog drain spacing -> trace mean 0.502
+CALIB_BURST_N = 7  # packets in the fast drain burst (vec: 1.0414..1.0755)
+CALIB_DRAIN = 0.00505  # burst gap (committed gaps 3.6-10 ms)
+CALIB_DRAIN2 = 0.0873  # backlog trickle -> trace mean 0.502
 CALIB_W_BASE = 0.4013  # steady transit 0.4015 minus the wired core hops
 CALIB_LOSS = 0.26  # steady-state uplink loss (~14 of 54 post-warm-up)
 CALIB_AP_RANGE = 600.0
+CALIB_BROKER_MIPS = 1000.0  # wirelessNet.ini:58
 
 
 def build(horizon: float = 3.35, dt: float = 1e-3, seed: int = 0,
@@ -41,7 +55,10 @@ def build(horizon: float = 3.35, dt: float = 1e-3, seed: int = 0,
     """Returns (spec, state, net, bounds) for the WirelessNet demo world."""
     overrides.setdefault("app_gen", 2)
     overrides.setdefault("fog_model", int(FogModel.POOL))
-    overrides.setdefault("policy", int(Policy.MAX_MIPS))
+    # the v2 hybrid broker: local pool first, MAX_MIPS offload overflow
+    overrides.setdefault("policy", int(Policy.LOCAL_FIRST))
+    overrides.setdefault("broker_mips", CALIB_BROKER_MIPS)
+    overrides.setdefault("v2_local_broker", True)
     overrides.setdefault("adv_on_completion", False)
     overrides.setdefault("adv_periodic", True)
     overrides.setdefault("required_time", 0.01)
@@ -52,6 +69,8 @@ def build(horizon: float = 3.35, dt: float = 1e-3, seed: int = 0,
     overrides.setdefault("start_time_max", CALIB_START + 1e-6)
     overrides.setdefault("link_up_s", CALIB_LINK_UP)
     overrides.setdefault("link_drain_s", CALIB_DRAIN)
+    overrides.setdefault("link_burst_n", CALIB_BURST_N)
+    overrides.setdefault("link_drain2_s", CALIB_DRAIN2)
     overrides.setdefault("uplink_loss_prob", CALIB_LOSS)
     overrides.setdefault("task_bytes", 1024)  # messageLength = 1024B
     spec = WorldSpec(

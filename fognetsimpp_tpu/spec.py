@@ -213,6 +213,15 @@ class WorldSpec:
     adv_on_completion: bool = True  # v3 (ComputeBrokerApp3.cc:254)
     adv_periodic: bool = False  # v1/v2 (ComputeBrokerApp2.cc:219)
     broker_mips: float = 0.0  # broker's own pool for LOCAL_FIRST (v1)
+    # v2 base broker (BrokerBaseApp2.cc:176-270): a LOCAL_FIRST hybrid —
+    # MIPSRequired < pool runs locally — whose releaseResource runs off
+    # ONE shared self-message: every accept cancels the pending release
+    # and reschedules it (+requiredTime), and each firing releases at most
+    # one stored request (SURVEY App. B item 8, live in v2).  Offloaded
+    # publishes are ALSO stored in requests[] (BrokerBaseApp2.cc:244-252),
+    # so their release refunds pool MIPS that was never debited and sends
+    # a duplicate status-6.  Requires policy == LOCAL_FIRST.
+    v2_local_broker: bool = False
     # POOL fog model: how many arrival ranks are pool-checked per tick (the
     # sequential accept/reject chain is exact up to this depth; deeper
     # arrivals wait a tick).  See _phase_pool_arrivals.
@@ -248,6 +257,13 @@ class WorldSpec:
     # ``link_up_s + send_index * link_drain_s``.
     link_up_s: float = 0.0  # 0 = disabled
     link_drain_s: float = 0.02  # backlog drain spacing once the link is up
+    # Two-phase drain (committed demo trace, example/results/General-0.vec
+    # vector 1093: the first ~7 buffered packets pour out with 4-10 ms
+    # gaps, the rest of the backlog trickles at tens of ms): sends with
+    # in-backlog index k < link_burst_n drain at link_drain_s, the rest at
+    # link_drain2_s.  link_burst_n = 0 keeps the single-gap model.
+    link_burst_n: int = 0
+    link_drain2_s: float = 0.0
 
     # --- MQTT control plane (BrokerBaseApp3.cc:86-121, 201-218) --------
     # When True, users/fogs start unconnected: a Connect must round-trip to
@@ -332,5 +348,10 @@ class WorldSpec:
         if self.policy == int(Policy.LOCAL_FIRST):
             assert self.broker_mips > 0, (
                 "LOCAL_FIRST needs a broker-side MIPS pool (broker_mips)"
+            )
+        if self.v2_local_broker:
+            assert self.policy == int(Policy.LOCAL_FIRST), (
+                "v2_local_broker models BrokerBaseApp2's hybrid broker: "
+                "set policy=Policy.LOCAL_FIRST (+ broker_mips)"
             )
         return self

@@ -122,6 +122,9 @@ class BrokerView:
     adv_arrive_t: jax.Array  # (F,) f32 arrival time (+inf = none in flight)
     rr_next: jax.Array  # () i32 round-robin cursor (Policy.ROUND_ROBIN)
     local_pool: jax.Array  # () f32 broker's own MIPS pool (v1 LOCAL_FIRST)
+    release_timer_t: jax.Array  # () f32 — the v2 broker's single shared
+    #   RELEASERESOURCE self-message (spec.v2_local_broker): +inf = none
+    #   pending; every accept overwrites it (cancelEvent + scheduleAt)
     policy_id: jax.Array  # () i32 — the live policy under Policy.DYNAMIC
     #   (ids 0-4; ignored otherwise).  Traced, so replicas in one vmap can
     #   each run a different scheduler (single-compile EP sweeps).
@@ -163,6 +166,10 @@ class TaskState:
     t_ack5: jax.Array  # (T,) relayed "assigned" status-5
     t_ack6: jax.Array  # (T,) relayed "performed" status-6
     queue_time_ms: jax.Array  # (T,) f32 fog queueTime signal (ms)
+    req_open: jax.Array  # (T,) i8 — task sits in the v2 broker's
+    #   requests[] table awaiting its releaseResource (local accepts AND
+    #   offloaded publishes, BrokerBaseApp2.cc:212/:244); always 0 when
+    #   spec.v2_local_broker is off
 
 
 @struct.dataclass
@@ -291,6 +298,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         adv_arrive_t=jnp.full((F,), jnp.inf, f32),
         rr_next=jnp.zeros((), jnp.int32),
         local_pool=jnp.asarray(spec.broker_mips, f32),
+        release_timer_t=jnp.asarray(jnp.inf, f32),
         policy_id=jnp.asarray(
             0 if spec.policy == int(Policy.DYNAMIC) else spec.policy,
             jnp.int32,
@@ -314,6 +322,7 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         t_ack5=jnp.full((T,), jnp.inf, f32),
         t_ack6=jnp.full((T,), jnp.inf, f32),
         queue_time_ms=jnp.full((T,), jnp.nan, f32),
+        req_open=jnp.zeros((T,), jnp.int8),
     )
 
     metrics = Metrics(

@@ -124,13 +124,53 @@ def test_example_matches_committed_trace():
     assert abs(d.mean() - 0.502) < 0.005, d.mean()
     assert abs(d.min() - 0.401) < 0.005, d.min()
     assert abs(d.max() - 0.9814) < 0.005, d.max()
-    # v2 semantics actually exercised: pool fogs completed tasks at
-    # requiredTime expiry and acked status 6
-    assert s["n_completed"] > 35
-    assert np.isfinite(sig["task_time"]).all() and sig["task_time"].size > 35
+    # v2 semantics actually exercised: broker-local releases and pool-fog
+    # expiries both completed tasks with status-6 acks (the shared-timer
+    # leak leaves a few locals unreleased at the horizon, as in the
+    # reference — requests[] grows, App. B item 7)
+    assert s["n_completed"] >= 30
+    assert s["n_local"] > 0 and s["n_scheduled"] > 0
+    assert np.isfinite(sig["task_time"]).all() and sig["task_time"].size >= 30
     # other seeds stay within binomial noise of the trace
     spec2, state2, net2, bounds2 = example.build(seed=3)
     final2, _ = run(spec2, state2, net2, bounds2)
     d2 = extract_signals(final2)["delay"] / 1e3
     assert 44 <= d2.size <= 60
     assert abs(d2.mean() - 0.502) < 0.02
+
+
+def test_example_per_fog_traffic_split():
+    """Second calibration anchor (r3): the committed run's per-fog app
+    traffic split — ComputeBroker1 received every forwarded task (5
+    "packets received" = 1 Connack + 4 tasks) while ComputeBroker2-5 got
+    only their Connack (``example/results/General-0.sca``).
+
+    The mechanism is the v2 hybrid broker (``BrokerBaseApp2.cc:181``):
+    publishes run on the broker's own 1000-MIPS pool; the shared
+    release-timer leak during the sub-requiredTime warm-up burst exhausts
+    the pool and the overflow offloads via the last-wins MAX_MIPS scan —
+    with every fog advertising equal MIPS the winner is the FIRST
+    registered fog.  Same calibration constants as the delay test (no
+    per-test refit).  Residual deviation (documented in PARITY.md): our
+    leak dynamics free more overflow than the committed run's 4 tasks,
+    and late overflow diverts to the LAST fog once CB1's reduced pool
+    advert lands — the same scan mechanism, so the middle fogs stay at
+    exactly zero either way.
+    """
+    spec, state, net, bounds = example.build()
+    final, _ = run(spec, state, net, bounds)
+    used = np.isfinite(np.asarray(final.tasks.t_create))
+    fog = np.asarray(final.tasks.fog)[used]
+    per_fog_tasks = np.bincount(fog[fog >= 0], minlength=5)
+    # the committed run's signature: CB1 dominates, CB2-4 receive nothing
+    assert per_fog_tasks[0] >= 4, per_fog_tasks
+    assert per_fog_tasks[0] == per_fog_tasks.max()
+    assert (per_fog_tasks[1:4] == 0).all(), per_fog_tasks
+    # overflow is the exception, local execution the rule (48/52 local in
+    # the committed run)
+    n_local = int(final.metrics.n_local)
+    assert n_local > per_fog_tasks.sum(), (n_local, per_fog_tasks)
+    # per-fog app "packets received" analog: Connack + delivered tasks
+    received = 1 + per_fog_tasks
+    assert received[0] > received[1]
+    assert (received[1:4] == 1).all()
