@@ -1423,15 +1423,32 @@ def make_step(
         if spec.policy == int(Policy.LOCAL_FIRST) and not spec.v2_local_broker:
             state, buf = _phase_local_completions(spec, state, net, cache, buf, t1)
 
-        # 7b. wired-link DropTail queues: integrate this tick's egress
+        # 7b. flat per-node views of this tick's message counts, feeding
+        # the cumulative per-module counters, the DropTail queues and the
+        # energy model
+        n_rest_q = spec.n_aps + spec.n_routers
+        rest_zeros = jnp.zeros((n_rest_q,), i32)
+        tx_all = jnp.concatenate(
+            [buf.tx_u, buf.tx_f, buf.tx_b[None], rest_zeros]
+        )
+        rx_all = jnp.concatenate(
+            [buf.rx_u, buf.rx_f, buf.rx_b[None], rest_zeros]
+        )
+        nodes2 = state.nodes.replace(
+            tx_count=state.nodes.tx_count + tx_all,
+            rx_count=state.nodes.rx_count + rx_all,
+        )
+        if spec.n_aps > 0:
+            a0, a1 = spec.ap_slice
+            nodes2 = nodes2.replace(
+                assoc_sum=nodes2.assoc_sum.at[a0:a1].add(cache.n_assoc)
+            )
+        state = state.replace(nodes=nodes2)
+
+        # wired-link DropTail queues: integrate this tick's egress
         # traffic into each wired node's serialization backlog; overflow
         # beyond frameCapacity becomes next tick's tail-drop probability
         if spec.wired_queue_enabled:
-            n_rest_q = spec.n_aps + spec.n_routers
-            tx_all = jnp.concatenate(
-                [buf.tx_u, buf.tx_f, buf.tx_b[None],
-                 jnp.zeros((n_rest_q,), i32)]
-            )
             add_bytes = tx_all.astype(jnp.float32) * float(spec.task_bytes)
             drain = jnp.float32(spec.link_rate_bps / 8.0 * spec.dt)
             raw = state.nodes.link_backlog + add_bytes - drain
@@ -1460,14 +1477,6 @@ def make_step(
         # 8. energy + lifecycle
         if spec.energy_enabled:
             n_rest = spec.n_aps + spec.n_routers
-            rest_i = jnp.zeros((n_rest,), i32)
-            # flat (N,) view of the segmented counters, [users|fogs|broker|..]
-            tx = jnp.concatenate(
-                [buf.tx_u, buf.tx_f, buf.tx_b[None], rest_i]
-            )
-            rx = jnp.concatenate(
-                [buf.rx_u, buf.rx_f, buf.rx_b[None], rest_i]
-            )
             if spec.fog_model == int(FogModel.POOL):
                 fog_busy = state.fogs.pool_avail < state.fogs.mips
             else:
@@ -1482,7 +1491,7 @@ def make_step(
             energy, alive = step_energy(
                 spec, state.nodes.energy, state.nodes.energy_capacity,
                 state.nodes.has_energy, state.nodes.alive, t1,
-                tx, rx, computing,
+                tx_all, rx_all, computing,
             )
             state = state.replace(
                 nodes=state.nodes.replace(energy=energy, alive=alive)

@@ -50,6 +50,13 @@ class NodeState:
     # wired-link DropTailQueue analog (spec.wired_queue_enabled):
     link_backlog: jax.Array  # (N,) f32 bytes queued on the access link
     link_drop_p: jax.Array  # (N,) f32 next-tick DropTail loss probability
+    # cumulative per-node message counters (the reference's per-module
+    # "packets sent"/"packets received" .sca rows; INET's per-NIC
+    # statistics analog — persisted by runtime/recorder.py)
+    tx_count: jax.Array  # (N,) i32 messages sent over the whole run
+    rx_count: jax.Array  # (N,) i32 messages received
+    assoc_sum: jax.Array  # (N,) i32 — AP slots: summed per-tick station
+    #   counts (mean occupancy = assoc_sum / ticks); zero elsewhere
 
 
 @struct.dataclass
@@ -250,6 +257,9 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         has_energy=jnp.zeros((N,), bool),
         link_backlog=jnp.zeros((N,), f32),
         link_drop_p=jnp.zeros((N,), f32),
+        tx_count=jnp.zeros((N,), jnp.int32),
+        rx_count=jnp.zeros((N,), jnp.int32),
+        assoc_sum=jnp.zeros((N,), jnp.int32),
     )
 
     key, k_start = jax.random.split(key)

@@ -98,9 +98,32 @@ def test_recorder_roundtrip(tiny_run, tmp_path):
     assert len(mods["fog"]) == spec.n_fogs
     assert sum(u["sent"] for u in mods["user"]) == sca["scalars"]["n_published"]
     assert sum(f["assigned"] for f in mods["fog"]) == sca["scalars"]["n_scheduled"]
+    # stack-level rows (r3): per-node message counters + broker row
+    for u in mods["user"]:
+        assert u["tx_msgs"] >= u["sent"]  # Connect + publishes at least
+        assert u["rx_msgs"] > 0  # Connack + acks came back
+        assert u["link_bytes"] == (u["tx_msgs"] + u["rx_msgs"]) * spec.task_bytes
+    assert mods["broker"]["rx_msgs"] > 0  # the echoedPk:count analog
+    assert mods["broker"]["tx_msgs"] > 0
+    assert sum(f["rx_msgs"] for f in mods["fog"]) >= sum(
+        f["assigned"] for f in mods["fog"]
+    )
     vec = load_vectors(paths["vec"])
     assert "latency_h1" in vec and vec["latency_h1"].size > 0
     assert "delay" in vec
+
+
+def test_recorder_ap_occupancy(tmp_path):
+    """Per-AP association occupancy rows (INET per-NIC stats analog)."""
+    from fognetsimpp_tpu.scenarios import wireless
+
+    spec, state, net, bounds = wireless.wireless2(horizon=0.3)
+    final, _ = run(spec, state, net, bounds)
+    paths = record_run(str(tmp_path), spec, final, run_id="ap0")
+    mods = load_scalars(paths["sca"])["modules"]
+    assert len(mods["ap"]) == spec.n_aps
+    # the stations associate somewhere: total mean occupancy is positive
+    assert sum(a["assoc_mean"] for a in mods["ap"]) > 1.0
 
 
 def test_checkpoint_resume_bit_identical(tiny_run, tmp_path):
