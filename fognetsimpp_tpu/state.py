@@ -331,7 +331,8 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         t_ack4_queued=jnp.full((T,), jnp.inf, f32),
         t_ack5=jnp.full((T,), jnp.inf, f32),
         t_ack6=jnp.full((T,), jnp.inf, f32),
-        queue_time_ms=jnp.full((T,), jnp.nan, f32),
+        queue_time_ms=jnp.full((T,), jnp.inf, f32),  # inf (not NaN): NaN != NaN
+        #   breaks cross-process equality checks in multihost device_put
         req_open=jnp.zeros((T,), jnp.int8),
     )
 

@@ -1,0 +1,84 @@
+"""Worker process for tests/test_multihost.py.
+
+Run as: python tests/_multihost_worker.py <process_id> <port>
+
+Joins a 2-process jax.distributed cluster over localhost (2 virtual CPU
+devices per process -> a 4-device global mesh), runs a replica-sharded
+world across BOTH processes, and asserts its addressable shards match the
+locally-computed unsharded reference bit-for-bit.
+"""
+import os
+import sys
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# distributed init MUST precede anything that touches the XLA backend —
+# importing the framework creates module-level jnp constants, so it comes
+# after
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+
+import numpy as np  # noqa: E402
+
+from fognetsimpp_tpu.parallel import multihost  # noqa: E402
+from fognetsimpp_tpu.parallel.mesh import run_sharded  # noqa: E402
+from fognetsimpp_tpu.parallel.replicas import (  # noqa: E402
+    replicate_state,
+    run_replicated,
+)
+from fognetsimpp_tpu.scenarios import smoke  # noqa: E402
+
+n = jax.process_count()
+assert n == 2, f"expected 2 processes, got {n}"
+assert len(jax.local_devices()) == 2, jax.local_devices()
+assert jax.device_count() == 4, jax.devices()
+
+mesh = multihost.global_mesh()
+assert mesh.devices.size == 4  # spans both processes
+
+R = 4
+spec, state, net, bounds = smoke.build(
+    horizon=0.1, n_users=2, n_fogs=2, send_interval=0.01
+)
+batch = replicate_state(spec, state, R, seed=0)
+
+# the distributed run: replica axis sharded over the 2-process mesh
+final = run_sharded(spec, batch, net, bounds, mesh)
+# the local reference: same batch, plain single-process vmap
+ref = run_replicated(spec, batch, net, bounds)
+
+checked = 0
+for name, arr in [
+    ("n_scheduled", final.metrics.n_scheduled),
+    ("n_completed", final.metrics.n_completed),
+    ("t_ack6", final.tasks.t_ack6),
+    ("stage", final.tasks.stage),
+]:
+    ref_arr = np.asarray(
+        {
+            "n_scheduled": ref.metrics.n_scheduled,
+            "n_completed": ref.metrics.n_completed,
+            "t_ack6": ref.tasks.t_ack6,
+            "stage": ref.tasks.stage,
+        }[name]
+    )
+    for shard in arr.addressable_shards:
+        got = np.asarray(shard.data)
+        want = ref_arr[shard.index]
+        np.testing.assert_array_equal(got, want, err_msg=name)
+        checked += 1
+assert checked >= 8, checked  # 2 local shards x 4 arrays
+
+print(f"MULTIHOST-OK pid={pid} procs={n} devices={jax.device_count()}")

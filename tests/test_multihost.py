@@ -1,0 +1,54 @@
+"""Real 2-process jax.distributed multihost test (r2 weakness #6).
+
+Spawns two local processes that join one jax.distributed cluster over a
+localhost coordinator (2 virtual CPU devices each -> a 4-device global
+mesh spanning both), runs a replica-sharded world through the unmodified
+engine, and asserts every process's addressable shards are bit-identical
+to the single-process reference.  This exercises the actual DCN-analog
+path — process-spanning mesh + cross-process program launch — that the
+in-process tests cannot (``tests/test_parallel.py`` covers the
+single-process passthrough).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster_matches_single_process():
+    port = _free_port()
+    env = dict(os.environ)
+    # the workers pin their own platform/device-count flags
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"MULTIHOST-OK pid={pid}" in out, out
