@@ -521,14 +521,20 @@ def _phase_broker_dense(
     avail = b.registered
 
     # ---- scalar winner -----------------------------------------------
+    # ``brokers[0]`` anchors = the FIRST REGISTERED fog (see ops/sched.py)
+    first_reg = jnp.argmax(avail).astype(i32) if F > 0 else jnp.zeros((), i32)
     if F == 0:
         choice_s = jnp.full((), -1, i32)
     elif spec.policy == int(Policy.MAX_MIPS):
         idx = jnp.arange(F, dtype=i32)
         if spec.bug_compat.v1_max_scan:
-            cand = avail & (idx > 0) & (b.view_mips > b.view_mips[0])
+            cand = (
+                avail
+                & (idx > first_reg)
+                & (b.view_mips > b.view_mips[first_reg])
+            )
             last = jnp.max(jnp.where(cand, idx, -1))
-            choice_s = jnp.where(last >= 0, last, 0).astype(i32)
+            choice_s = jnp.where(last >= 0, last, first_reg).astype(i32)
         else:
             choice_s = jnp.argmax(
                 jnp.where(avail, b.view_mips, -jnp.inf)
@@ -550,10 +556,10 @@ def _phase_broker_dense(
             jnp.where(avail_, base, _BIG_F32), posinf=_BIG_F32
         )
         choice0 = jnp.argmin(scores).astype(i32)
-        # est = mips_req / view_mips[0] is +inf when no advert has landed
-        # (MIPS=0 registration): every candidate scores BIG and the
+        # est = mips_req / brokers[0].MIPS is +inf when no advert has
+        # landed (MIPS=0 registration): every candidate scores BIG and the
         # compacted argmin picks index 0 — replicate that tie.
-        choice0 = jnp.where(b.view_mips[0] > 0, choice0, 0)
+        choice0 = jnp.where(b.view_mips[first_reg] > 0, choice0, 0)
         choice_s = jnp.where(jnp.any(avail_), choice0, -1)
 
     choice_ok = choice_s >= 0

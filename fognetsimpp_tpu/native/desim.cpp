@@ -197,9 +197,11 @@ struct World {
     int best = -1;
     double best_score = kInf;
     bool any = false;
+    int first_reg = -1;  // brokers[0] = first REGISTERED fog (ADVICE r2)
     for (int f = 0; f < p.n_fogs; ++f) {
       if (!registered[f]) continue;
-      double div = p.mips0_divisor ? view_mips[0] : view_mips[f];
+      if (first_reg < 0) first_reg = f;
+      double div = p.mips0_divisor ? view_mips[first_reg] : view_mips[f];
       double est = div > 0.0 ? req / div : kInf;
       double score = view_busy[f] + est;
       if (add_rtt) score += 2.0 * p.d_bf[f];

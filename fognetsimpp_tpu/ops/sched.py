@@ -88,8 +88,13 @@ def schedule_batch(
         # available" (BrokerBaseApp3.cc:306-319); caller handles the ack
         return jnp.full((T,), -1, jnp.int32), rr_cursor
     avail = registered  # reference never evicts dead fogs (App. B item 7)
+    # ``brokers[0]`` is the FIRST REGISTERED fog (registration order), not
+    # array slot 0 — they differ only in the window where fog slot 0 has
+    # not yet connected (ADVICE r2: the native DES anchored registration
+    # order while this anchored slot 0)
+    first_reg = jnp.argmax(avail).astype(jnp.int32)  # 0 if none
 
-    divisor = view_mips[0] if mips0_divisor else view_mips  # (|) or (F,)
+    divisor = view_mips[first_reg] if mips0_divisor else view_mips
     est = _safe_div(mips_req[:, None], jnp.broadcast_to(divisor, (F,))[None, :])
 
     if policy in (int(Policy.MAX_MIPS), int(Policy.LOCAL_FIRST)):
@@ -104,9 +109,9 @@ def schedule_batch(
         # 244) — a failing task is never sent anywhere.
         idx = jnp.arange(F, dtype=jnp.int32)
         if v1_max_scan:
-            cand = avail & (idx > 0) & (view_mips > view_mips[0])
+            cand = avail & (idx > first_reg) & (view_mips > view_mips[first_reg])
             last = jnp.max(jnp.where(cand, idx, -1))
-            winner = jnp.where(last >= 0, last, 0).astype(jnp.int32)
+            winner = jnp.where(last >= 0, last, first_reg).astype(jnp.int32)
         else:
             winner = jnp.argmax(jnp.where(avail, view_mips, -jnp.inf)).astype(
                 jnp.int32

@@ -152,6 +152,17 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
     return {"user": users, "fog": fogs, "broker": broker, "ap": aps}
 
 
+def _json_sanitize(obj):
+    """Recursively map non-finite floats to None (JSON null)."""
+    if isinstance(obj, dict):
+        return {k: _json_sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_sanitize(v) for v in obj]
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return None
+    return obj
+
+
 def record_run(
     outdir: str,
     spec: WorldSpec,
@@ -173,8 +184,12 @@ def record_run(
         "scalars": summarize(final),
         "modules": per_module_scalars(spec, final),
     }
+    # RFC-8259-valid output (ADVICE r2): summarize() yields nan means for
+    # empty signal vectors and json.dump would emit literal NaN tokens —
+    # encode non-finite scalars as null instead
+    sca = _json_sanitize(sca)
     with open(sca_path, "w") as f:
-        json.dump(sca, f, indent=1, default=str)
+        json.dump(sca, f, indent=1, default=str, allow_nan=False)
 
     vectors = dict(extract_signals(final))
     if series is not None:

@@ -60,8 +60,12 @@ def summarize(final: WorldState) -> Dict[str, float]:
     """Scalar roll-up: counts plus mean/max of each signal (ms)."""
     sig = extract_signals(final)
     stage = np.asarray(final.tasks.stage)
+    # the per-stage census is namespaced stage_<name> so the Metrics
+    # counters below can never shadow it (ADVICE r2: n_lost/n_dropped used
+    # to overwrite the census keys — equal today because LOST/DROPPED are
+    # terminal stages, but a future divergence would have been masked)
     out: Dict[str, float] = {
-        f"n_{s.name.lower()}": int((stage == int(s)).sum()) for s in Stage
+        f"stage_{s.name.lower()}": int((stage == int(s)).sum()) for s in Stage
     }
     m = final.metrics
     out.update(

@@ -31,7 +31,7 @@ def test_no_fogs_world():
     s = summarize(final)
     # every decided publish hits "no compute resource available"
     assert s["n_no_resource"] > 0 and s["n_scheduled"] == 0
-    assert s["n_no_resource"] + s["n_pub_inflight"] == s["n_published"]
+    assert s["n_no_resource"] + s["stage_pub_inflight"] == s["n_published"]
 
 
 def test_tiny_queue_drops_counted():
@@ -65,9 +65,9 @@ def test_coarse_dt_degrades_gracefully():
     final, _ = run(spec, state, net, bounds)
     s = summarize(final)
     assert s["n_published"] > 0 and s["n_scheduled"] > 0
-    live = (s["n_pub_inflight"] + s["n_task_inflight"] + s["n_queued"]
-            + s["n_running"])
-    term = (s["n_done"] + s["n_no_resource"] + s["n_dropped"]
+    live = (s["stage_pub_inflight"] + s["stage_task_inflight"] + s["stage_queued"]
+            + s["stage_running"])
+    term = (s["stage_done"] + s["n_no_resource"] + s["n_dropped"]
             + s["n_rejected"])
     assert live + term == s["n_published"]
     # exact event times stay causal even under coarse observation

@@ -56,7 +56,7 @@ def test_invariants_hold(seed, mips, interval):
 
     # 1. conservation: every published task is in exactly one stage bucket
     accounted = sum(
-        s[f"n_{st_.name.lower()}"] for st_ in TERMINAL + IN_FLIGHT
+        s[f"stage_{st_.name.lower()}"] for st_ in TERMINAL + IN_FLIGHT
     )
     assert accounted == s["n_published"]
 

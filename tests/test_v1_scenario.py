@@ -63,7 +63,7 @@ def test_policies_end_to_end(policy):
     )
     if policy == Policy.ROUND_ROBIN:
         assert len(fogs_used) == spec.n_fogs  # spread across all fogs
-    assert s["n_completed"] + s["n_queued"] + s["n_running"] > 0
+    assert s["n_completed"] + s["stage_queued"] + s["stage_running"] > 0
 
 
 def test_schema_inventory():
