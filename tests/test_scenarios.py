@@ -23,7 +23,7 @@ IN_FLIGHT = (Stage.PUB_INFLIGHT, Stage.TASK_INFLIGHT, Stage.QUEUED,
 def _conserved(final):
     """Every published task is in exactly one live or terminal stage."""
     s = summarize(final)
-    accounted = sum(s[f"n_{st.name.lower()}"] for st in TERMINAL + IN_FLIGHT)
+    accounted = sum(s[f"stage_{st.name.lower()}"] for st in TERMINAL + IN_FLIGHT)
     assert accounted == s["n_published"], s
     return s
 
