@@ -67,6 +67,7 @@ def _load() -> ctypes.CDLL:
             + [dp, dp]  # fog_energy0, fog_energy_cap (nullable)
             + [ctypes.c_double] * 4  # tx_j, rx_j, idle_w, compute_w
             + [dp]  # rand_u (nullable)
+            + [ctypes.c_int]  # v2_local
             + [dp, ip] + [dp] * 9 + [ip]
             + [dp]  # o_fog_energy (nullable)
         )
@@ -104,6 +105,7 @@ def run_gen(
     idle_power_w: float = 0.0,
     compute_power_w: float = 0.0,
     rand_u: Optional[np.ndarray] = None,  # RANDOM's shared per-task draws
+    v2_local: bool = False,  # spec.v2_local_broker hybrid semantics
 ) -> Dict[str, np.ndarray]:
     """Run the native DES over an explicit publish schedule."""
     lib = _load()
@@ -159,6 +161,7 @@ def run_gen(
         ctypes.c_double(tx_energy_j), ctypes.c_double(rx_energy_j),
         ctypes.c_double(idle_power_w), ctypes.c_double(compute_power_w),
         pd(ru) if ru is not None else null_d,
+        int(v2_local),
         pd(outs_d["t_at_broker"]), pi(fog), pd(outs_d["t_at_fog"]),
         pd(outs_d["t_service_start"]), pd(outs_d["t_complete"]),
         pd(outs_d["t_ack3"]), pd(outs_d["t_ack4_fwd"]), pd(outs_d["t_ack5"]),
@@ -287,6 +290,7 @@ def replay_engine_world(
         broker_mips=spec.broker_mips,
         required_time=spec.required_time,
         adv_interval=spec.adv_interval,
+        v2_local=spec.v2_local_broker,
         **energy_kw,
         **rand_kw,
     ), used

@@ -84,6 +84,8 @@ enum EventKind : int {
   kEvPoolDone = 5,    // pool task's requiredTime expires (a = task id)
   kEvLocalDone = 6,   // broker-local task expires (a = task id)
   kEvAdvTimer = 7,    // v1/v2 periodic re-advertisement (a = fog id)
+  kEvBrokerRelease = 8,  // v2 broker's shared RELEASERESOURCE self-message
+  //                        (a = generation: stale == cancelled)
 };
 
 struct Event {
@@ -156,6 +158,8 @@ struct Params {
   // energy model (spec.tx_energy_j etc.) + RANDOM's shared stream
   double tx_j, rx_j, idle_w, compute_w;
   const double* rand_u;  // (n_tasks) or nullptr
+  // v2 hybrid broker (spec.v2_local_broker): single shared release timer
+  int v2_local;
 };
 
 struct World {
@@ -166,6 +170,11 @@ struct World {
   std::vector<char> registered;
   double local_pool = 0.0;
   int64_t rr_cursor = 0;  // ROUND_ROBIN position among registered fogs
+  // v2 broker requests[] (insertion order) + the shared timer generation
+  // (cancelEvent == bump the generation; stale events are skipped)
+  std::vector<int> broker_reqs;
+  std::vector<char> req_open;  // parallel to tasks
+  int64_t release_gen = 0;
   std::priority_queue<Event, std::vector<Event>, EventLater> heap;
   int64_t seq = 0;
 
@@ -269,15 +278,25 @@ struct World {
 
   void broker_decide(int i, double now) {
     Task& tk = tasks[i];
-    // v1 LOCAL_FIRST: run locally when the broker pool covers it
-    // (strict <, BrokerBaseApp.cc:171-180); status-3 "processing" ack
+    // v1/v2 LOCAL_FIRST: run locally when the broker pool covers it
+    // (strict <, BrokerBaseApp.cc:171-180 / BrokerBaseApp2.cc:181);
+    // status-3 "processing" ack
     if (p.policy == kLocalFirst && tk.mips_req < local_pool) {
       local_pool -= tk.mips_req;
       tk.stage = kLocalRun;
       tk.t_service_start = now;
-      tk.t_complete = now + p.required_time;
       tk.t_ack3 = now + p.d_ub[tk.user];
-      push(tk.t_complete, kEvLocalDone, i);
+      if (p.v2_local) {
+        // v2: store the request; completion comes only from the shared
+        // timer — cancelEvent + scheduleAt (BrokerBaseApp2.cc:221-224)
+        broker_reqs.push_back(i);
+        req_open[i] = 1;
+        ++release_gen;
+        push(now + p.required_time, kEvBrokerRelease, (int)release_gen);
+      } else {
+        tk.t_complete = now + p.required_time;
+        push(tk.t_complete, kEvLocalDone, i);
+      }
       return;
     }
     // every non-local publish gets the "forwarded" status-4 (:146-150)
@@ -305,6 +324,14 @@ struct World {
     if (choice < 0) {  // "no compute resource available" (:306-319)
       tk.stage = kNoResource;
       return;
+    }
+    if (p.v2_local && p.policy == kLocalFirst) {
+      // v2 stores a Request for every offload-branch decision with fogs
+      // present — even when the guard below refuses to send
+      // (BrokerBaseApp2.cc:244-252); its later release refunds MIPS that
+      // was never debited (pool inflation)
+      broker_reqs.push_back(i);
+      req_open[i] = 1;
     }
     if ((p.policy == kLocalFirst || p.policy == kMaxMips) &&
         !(tk.mips_req < view_mips[choice])) {
@@ -407,6 +434,32 @@ struct World {
     tk.t_ack6 = now + p.d_ub[tk.user];  // status-6 straight to the client
   }
 
+  void v2_broker_release(int gen, double now) {
+    // BrokerBaseApp2.cc:284-312: the shared timer fires — unless a later
+    // accept cancelled it (stale generation) — and releases exactly ONE
+    // stored request, the first in insertion order whose requiredTime
+    // passed: pool += its MIPS, status-6 straight to the client, erase.
+    if (gen != (int)release_gen) return;  // cancelEvent()
+    for (size_t j = 0; j < broker_reqs.size(); ++j) {
+      int i = broker_reqs[j];
+      if (!req_open[i]) continue;
+      Task& tk = tasks[i];
+      if (tk.t_at_broker + p.required_time < now) {
+        local_pool += tk.mips_req;
+        req_open[i] = 0;
+        broker_reqs.erase(broker_reqs.begin() + j);
+        double ack = now + p.d_ub[tk.user];
+        if (ack < tk.t_ack6) tk.t_ack6 = ack;  // duplicate-ack min
+        if (tk.stage == kLocalRun) {
+          tk.stage = kDone;
+          tk.t_complete = now;
+        }
+        break;
+      }
+    }
+    // the self-message is spent; only the next accept reschedules it
+  }
+
   long run() {
     long n_events = 0;
     while (!heap.empty()) {
@@ -447,6 +500,9 @@ struct World {
         case kEvLocalDone:
           local_done(ev.a, ev.t);
           break;
+        case kEvBrokerRelease:
+          v2_broker_release(ev.a, ev.t);
+          break;
       }
     }
     return n_events;
@@ -479,6 +535,7 @@ long desim_run_gen(
     const double* fog_energy_cap,  // (n_fogs)
     double tx_j, double rx_j, double idle_w, double compute_w,
     const double* rand_u,  // (n_tasks) RANDOM unit draws or nullptr
+    int v2_local,  // spec.v2_local_broker: v2 hybrid broker semantics
     // outputs (n_tasks):
     double* o_t_at_broker, int* o_fog, double* o_t_at_fog,
     double* o_t_service_start, double* o_t_complete, double* o_t_ack3,
@@ -491,12 +548,14 @@ long desim_run_gen(
                fog_model, app_gen, mips0_divisor, zero_initial_view,
                adv_on_completion, adv_periodic, v1_max_scan,
                local_pool_leak, queue_capacity, broker_mips, required_time,
-               adv_interval, tx_j, rx_j, idle_w, compute_w, rand_u};
+               adv_interval, tx_j, rx_j, idle_w, compute_w, rand_u,
+               v2_local};
   w.fogs.resize(n_fogs);
   w.tasks.resize(n_tasks);
   w.view_mips.assign(n_fogs, 0.0);
   w.view_busy.assign(n_fogs, 0.0);
   w.registered.assign(n_fogs, 0);
+  w.req_open.assign(n_tasks, 0);
   w.local_pool = broker_mips;
 
   for (int f = 0; f < n_fogs; ++f) {
