@@ -38,6 +38,9 @@ def main(argv=None) -> int:
                     help="defaults to config output.run_id, else General-0")
     ap.add_argument("--ticks", action="store_true",
                     help="record per-tick series vectors")
+    ap.add_argument("--trails", metavar="SVG", default=None,
+                    help="render movement/communication trails to this "
+                    "SVG (the Tkenv-animation analog; implies --ticks)")
     ap.add_argument("--progress", type=int, metavar="N", default=None,
                     help="run in N-tick chunks, printing a progress line "
                     "per chunk (the Cmdenv status-line analog; excludes "
@@ -79,16 +82,19 @@ def main(argv=None) -> int:
         if "=" not in o:
             ap.error(f"--set needs KEY=VALUE, got {o!r}")
         pre.append(o.replace("=", " = ", 1))
-    if args.ticks:
+    if args.ticks or args.trails:
         pre.append("spec.record_tick_series = true")
+    if args.trails:
+        pre.append("spec.record_trails = true")
     cfg = Config.from_str("\n".join(pre) + "\n" + text)
 
     spec, state, net, bounds = build_from_config(cfg, seed=args.seed)
     t0 = time.perf_counter()
     if args.progress:
-        if args.ticks:
-            ap.error("--progress and --ticks are mutually exclusive "
-                     "(chunked runs record via snapshots, not series)")
+        if args.ticks or args.trails:
+            ap.error("--progress and --ticks/--trails are mutually "
+                     "exclusive (chunked runs record via snapshots, not "
+                     "series)")
         from .core.engine import run_chunked
         from .runtime.signals import summarize as _sumz
 
@@ -120,6 +126,12 @@ def main(argv=None) -> int:
             attrs={"argv": sys.argv[1:]},
         )
         out.update(paths)
+    if args.trails:
+        from .runtime.trails import render_trails_svg
+
+        out["trails"] = render_trails_svg(
+            spec, final, series, args.trails, net=net
+        )
     s = summarize(final)
     out.update(
         n_published=s["n_published"], n_completed=s["n_completed"],

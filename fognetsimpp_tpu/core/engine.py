@@ -1548,6 +1548,9 @@ def run(
                 # the tick's own association instead of recomputing it
                 "n_assoc": aux["n_assoc"],
             }
+            if spec.record_trails:
+                # Tkenv movement-trail analog (runtime/trails.py)
+                out["pos"] = s.nodes.pos
         else:
             s = step(carry, net, bounds)
             out = None

@@ -290,6 +290,9 @@ class WorldSpec:
     # --- misc ----------------------------------------------------------
     bug_compat: BugCompat = BugCompat()
     record_tick_series: bool = False  # emit per-tick vectors from the scan
+    record_trails: bool = False  # also record per-tick node positions in
+    #   the series (the Tkenv movement-trail analog; O(ticks*N) memory —
+    #   meant for demo-scale worlds).  Requires record_tick_series.
 
     # ------------------------------------------------------------------
     @property

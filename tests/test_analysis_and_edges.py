@@ -4,6 +4,26 @@ import pytest
 
 from fognetsimpp_tpu import Stage, run
 from fognetsimpp_tpu.runtime import analyze, record_run, render_report, summarize
+
+
+def test_trails_svg(tmp_path):
+    """The Tkenv movement/communication-trail analog renders headlessly."""
+    from fognetsimpp_tpu import run
+    from fognetsimpp_tpu.runtime.trails import render_trails_svg
+    from fognetsimpp_tpu.scenarios import wireless
+
+    spec, state, net, bounds = wireless.wireless2(
+        horizon=0.5, record_tick_series=True, record_trails=True
+    )
+    final, series = run(spec, state, net, bounds)
+    out = str(tmp_path / "trails.svg")
+    render_trails_svg(spec, final, series, out, net=net)
+    svg = open(out).read()
+    assert "<svg" in svg and "</svg>" in svg
+    # one trail per user, AP squares + range circles, counters
+    assert svg.count("polyline") == spec.n_users
+    assert svg.count("<rect") == spec.n_aps
+    assert "sent:" in svg and "rcvd:" in svg and "broker" in svg
 from fognetsimpp_tpu.scenarios import smoke
 
 
