@@ -1005,13 +1005,21 @@ def _phase_fog_arrivals(
     )
     d_bu_q = cache.d2b[user_g]
     d_fb_q = d_fb[fog_gc]
+    # no gather needed for the keep-stage case: every valid row was
+    # TASK_INFLIGHT by mask construction, except the freshly assigned
+    # head (already written RUNNING above), which must stay RUNNING
+    assigned_row = arr & (idx == a_task[fog_gc])
     stage_k = jnp.where(
         enq_ok,
         jnp.int8(int(Stage.QUEUED)),
         jnp.where(
             (to_queue & ~enq_ok) | dead_dst,
             jnp.int8(int(Stage.DROPPED)),
-            tasks.stage[idxc],
+            jnp.where(
+                assigned_row,
+                jnp.int8(int(Stage.RUNNING)),
+                jnp.int8(int(Stage.TASK_INFLIGHT)),
+            ),
         ),
     )
     tasks = tasks.replace(
