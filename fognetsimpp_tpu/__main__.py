@@ -49,6 +49,12 @@ def main(argv=None) -> int:
                     help="force a jax platform (cpu/tpu)")
     ap.add_argument("--analyze", metavar="DIR", default=None,
                     help="analyse recorded runs in DIR and exit (.anf analog)")
+    ap.add_argument("--sweep", metavar="GRID", default=None,
+                    help="policy x load sweep over the scenario, e.g. "
+                    "'policies=0,1,2 loads=0.01,0.02,0.05 reps=4 "
+                    "dynamic=1' — one JSON line per (policy, load); "
+                    "dynamic=1 compiles the whole grid ONCE "
+                    "(Policy.DYNAMIC, argmin-family ids 0-4)")
     args = ap.parse_args(argv)
 
     if args.analyze:
@@ -87,6 +93,41 @@ def main(argv=None) -> int:
     if args.trails:
         pre.append("spec.record_trails = true")
     cfg = Config.from_str("\n".join(pre) + "\n" + text)
+
+    if args.sweep:
+        from .config.ini import scenario_builders
+        from .parallel import sweep_policies
+
+        opts = dict(kv.split("=", 1) for kv in args.sweep.split())
+        policies = [int(p) for p in opts.get("policies", "0").split(",")]
+        loads = [float(x) for x in opts.get("loads", "0.05").split(",")]
+        reps = int(opts.get("reps", "1"))
+        dynamic = opts.get("dynamic", "0") not in ("0", "false", "")
+        name = cfg.lookup("scenario", "smoke")
+        build_kwargs = cfg.matching("scenario")
+        build_kwargs.pop("seed", None)
+        t0 = time.perf_counter()
+        grids = sweep_policies(
+            scenario_builders()[name],
+            policies=policies,
+            load_intervals=loads,
+            n_replicas_per_load=reps,
+            dynamic=dynamic,
+            seed=args.seed or 0,
+            **build_kwargs,
+        )
+        for pol, g in grids.items():
+            for li, load in enumerate(loads):
+                print(json.dumps({
+                    "policy": pol, "send_interval": load,
+                    "n_scheduled_mean": float(g["n_scheduled"][li].mean()),
+                    "n_completed_mean": float(g["n_completed"][li].mean()),
+                    "n_dropped_mean": float(g["n_dropped"][li].mean()),
+                    "reps": reps,
+                }))
+        print(json.dumps({"sweep_wall_s": round(time.perf_counter() - t0, 2),
+                          "dynamic": dynamic, "scenario": name}))
+        return 0
 
     spec, state, net, bounds = build_from_config(cfg, seed=args.seed)
     t0 = time.perf_counter()

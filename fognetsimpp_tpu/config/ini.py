@@ -104,6 +104,23 @@ class Config:
         return out
 
 
+def scenario_builders():
+    """Name → builder registry (the network-NED catalogue analog)."""
+    from .. import scenarios
+
+    return {
+        "smoke": scenarios.smoke.build,
+        "wired_v1": scenarios.wired_v1.build,
+        "example": scenarios.example.build,
+        "wireless": scenarios.wireless.wireless,
+        "wireless2": scenarios.wireless.wireless2,
+        "wireless3": scenarios.wireless.wireless3,
+        "wireless4": scenarios.wireless.wireless4,
+        "wireless5": scenarios.wireless.wireless5,
+        "paper": scenarios.wireless.paper,
+    }
+
+
 def build_from_config(cfg: Config, seed: Optional[int] = None):
     """Construct ``(spec, state, net, bounds)`` from a :class:`Config`.
 
@@ -120,17 +137,7 @@ def build_from_config(cfg: Config, seed: Optional[int] = None):
     from ..spec import WorldSpec
 
     name = cfg.lookup("scenario", "smoke")
-    builders = {
-        "smoke": scenarios.smoke.build,
-        "wired_v1": scenarios.wired_v1.build,
-        "example": scenarios.example.build,
-        "wireless": scenarios.wireless.wireless,
-        "wireless2": scenarios.wireless.wireless2,
-        "wireless3": scenarios.wireless.wireless3,
-        "wireless4": scenarios.wireless.wireless4,
-        "wireless5": scenarios.wireless.wireless5,
-        "paper": scenarios.wireless.paper,
-    }
+    builders = scenario_builders()
     if name not in builders:
         raise ValueError(f"unknown scenario {name!r} (have {sorted(builders)})")
     kwargs = cfg.matching("scenario")

@@ -113,6 +113,24 @@ def test_recorder_roundtrip(tiny_run, tmp_path):
     assert "delay" in vec
 
 
+def test_sweep_cli(capsys):
+    """--sweep runs a policy x load grid and prints one line per cell."""
+    import json
+
+    from fognetsimpp_tpu.__main__ import main
+
+    rc = main([
+        "--scenario", "smoke", "--set", "scenario.horizon=0.2",
+        "--sweep", "policies=0,2 loads=0.02,0.05 dynamic=1",
+    ])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+    cells = [ln for ln in lines if "policy" in ln]
+    assert len(cells) == 4  # 2 policies x 2 loads
+    assert all(c["n_scheduled_mean"] > 0 for c in cells)
+    assert lines[-1]["dynamic"] is True
+
+
 def test_recorder_ap_occupancy(tmp_path):
     """Per-AP association occupancy rows (INET per-NIC stats analog)."""
     from fognetsimpp_tpu.scenarios import wireless
