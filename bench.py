@@ -13,7 +13,8 @@ timed measurement enqueues BENCH_PIPELINE back-to-back runs (fresh PRNG
 key each, same executable) and syncs once — sustained throughput, since
 the tunneled runtime charges a flat ~95 ms per blocking fetch regardless
 of queued work.  Measured 2026-07 (round 3) on the tunneled v5e chip:
-~3.1M decisions/s/chip (vs_baseline ~3.1); device time 0.80 ms/tick.
+2.8-3.45M decisions/s/chip across sessions (quiet-host median ~3.1M;
+concurrent host load costs ~10%); device time 0.79 ms/tick.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` is value / 1e6 (the ≥1M decisions/sec/chip target; the
