@@ -131,8 +131,11 @@ def main() -> None:
         d = sum(fetch(m) for m in ms)
         walls.append(time.perf_counter() - t0)
         decs.append(d)
-    wall = float(np.median(walls))
-    decisions = decs[walls.index(wall)]
+    # median by index (an even rep count would make np.median interpolate
+    # a value not present in walls)
+    mid = int(np.argsort(walls)[len(walls) // 2])
+    wall = walls[mid]
+    decisions = decs[mid]
     n_ticks = spec.n_ticks * n_replicas * n_pipeline
     value = decisions / wall
 

@@ -48,7 +48,7 @@ def _timed(go, arg, rekey, n_pipeline=3):
     outs = [go(a) for a in args]
     decisions = sum(_decisions(o) for o in outs)
     wall = time.perf_counter() - t0
-    return outs[-1], wall, decisions
+    return outs[-1], wall, decisions, n_pipeline
 
 
 def _emit(name, wall, decisions, ticks, extra=None):
@@ -78,10 +78,10 @@ def config2():
         max_sends_per_user=104, arrival_window=1024,
     )
     go = jax.jit(lambda s: run(spec, s, net, bounds)[0].metrics)
-    f, wall, dec = _timed(
+    f, wall, dec, n_pipe = _timed(
         go, state, lambda s, i: s.replace(key=jax.random.PRNGKey(i))
     )
-    _emit("2:100-node-grid-rr", wall, dec, spec.n_ticks * 3)
+    _emit("2:100-node-grid-rr", wall, dec, spec.n_ticks * n_pipe)
 
 
 def config3():
@@ -105,11 +105,11 @@ def config3():
     go = jax.jit(
         lambda b: jax.vmap(lambda s: run(spec, s, net, bounds)[0].metrics)(b)
     )
-    f, wall, dec = _timed(
+    f, wall, dec, n_pipe = _timed(
         go, batch,
         lambda b, i: b.replace(key=jax.random.split(jax.random.PRNGKey(i), R)),
     )
-    _emit("3:1k-node-minlat-64rep", wall, dec, spec.n_ticks * R * 3,
+    _emit("3:1k-node-minlat-64rep", wall, dec, spec.n_ticks * R * n_pipe,
           {"replicas": R})
 
 
@@ -140,11 +140,11 @@ def config4():
         return fs.metrics, jnp.sum(fs.nodes.alive.astype(jnp.int32))
 
     go = jax.jit(lambda b: jax.vmap(final)(b))
-    f, wall, dec = _timed(
+    f, wall, dec, n_pipe = _timed(
         go, batch,
         lambda b, i: b.replace(key=jax.random.split(jax.random.PRNGKey(i), R)),
     )
-    _emit("4:10k-mobile-energy-8rep", wall, dec, spec.n_ticks * R * 3,
+    _emit("4:10k-mobile-energy-8rep", wall, dec, spec.n_ticks * R * n_pipe,
           {"replicas": R,
            "alive_min": int(np.asarray(f[1]).min())})
 

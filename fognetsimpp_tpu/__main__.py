@@ -104,6 +104,25 @@ def main(argv=None) -> int:
         reps = int(opts.get("reps", "1"))
         dynamic = opts.get("dynamic", "0") not in ("0", "false", "")
         name = cfg.lookup("scenario", "smoke")
+        builders = scenario_builders()
+        if name not in builders:
+            ap.error(
+                f"unknown scenario {name!r} (have {sorted(builders)})"
+            )
+        # the sweep path passes only scenario.* kwargs to the builder —
+        # fail loudly on override tiers it cannot honour rather than
+        # silently running a different world than the user configured
+        unsupported = sorted(
+            k for k in ("spec", "fog", "user")
+            if cfg.matching(k)
+        )
+        if unsupported:
+            ap.error(
+                "--sweep supports scenario.* overrides only; "
+                f"{', '.join(u + '.*' for u in unsupported)} overrides "
+                "are not applied in sweep mode — move them into the "
+                "scenario builder's kwargs or run without --sweep"
+            )
         build_kwargs = cfg.matching("scenario")
         build_kwargs.pop("seed", None)
         t0 = time.perf_counter()

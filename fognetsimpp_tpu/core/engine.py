@@ -46,11 +46,9 @@ from ..net.mobility import MobilityBounds, step_mobility
 from ..net.energy import step_energy
 from ..net.topology import LinkCache, NetParams, associate
 from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
-from ..ops.sched import schedule_batch, task_uniform
+from ..ops.sched import scalar_winner, schedule_batch, task_uniform
 from ..spec import FogModel, Policy, Stage, WorldSpec
 from ..state import WorldState
-
-_BIG_F32 = jnp.float32(3.4e38)
 
 
 class TickBuf(NamedTuple):
@@ -518,49 +516,17 @@ def _phase_broker_dense(
     # key split kept for PRNG-stream alignment with the compacted path
     key, _ = jax.random.split(state.key)
     any_fog = jnp.any(b.registered)
-    avail = b.registered
 
-    # ---- scalar winner -----------------------------------------------
-    # ``brokers[0]`` anchors = the FIRST REGISTERED fog (see ops/sched.py)
-    first_reg = jnp.argmax(avail).astype(i32) if F > 0 else jnp.zeros((), i32)
-    if F == 0:
-        choice_s = jnp.full((), -1, i32)
-    elif spec.policy == int(Policy.MAX_MIPS):
-        idx = jnp.arange(F, dtype=i32)
-        if spec.bug_compat.v1_max_scan:
-            cand = (
-                avail
-                & (idx > first_reg)
-                & (b.view_mips > b.view_mips[first_reg])
-            )
-            last = jnp.max(jnp.where(cand, idx, -1))
-            choice_s = jnp.where(last >= 0, last, first_reg).astype(i32)
-        else:
-            choice_s = jnp.argmax(
-                jnp.where(avail, b.view_mips, -jnp.inf)
-            ).astype(i32)
-    else:
-        if spec.policy == int(Policy.MIN_BUSY):
-            base, avail_ = b.view_busy, avail
-        elif spec.policy == int(Policy.MIN_LATENCY):
-            rtt_bf = 2.0 * cache.d2b[U : U + F]
-            base, avail_ = rtt_bf + b.view_busy, avail
-        else:  # ENERGY_AWARE
-            fog_alive = state.nodes.alive[U : U + F]
-            fog_efrac = state.nodes.energy[U : U + F] / jnp.maximum(
-                state.nodes.energy_capacity[U : U + F], 1e-12
-            )
-            base = b.view_busy + 10.0 * (1.0 - fog_efrac)
-            avail_ = avail & fog_alive
-        scores = jnp.nan_to_num(
-            jnp.where(avail_, base, _BIG_F32), posinf=_BIG_F32
-        )
-        choice0 = jnp.argmin(scores).astype(i32)
-        # est = mips_req / brokers[0].MIPS is +inf when no advert has
-        # landed (MIPS=0 registration): every candidate scores BIG and the
-        # compacted argmin picks index 0 — replicate that tie.
-        choice0 = jnp.where(b.view_mips[first_reg] > 0, choice0, 0)
-        choice_s = jnp.where(jnp.any(avail_), choice0, -1)
+    # ---- scalar winner (shared formulas: ops/sched.py) ----------------
+    fog_alive = state.nodes.alive[U : U + F]
+    fog_efrac = state.nodes.energy[U : U + F] / jnp.maximum(
+        state.nodes.energy_capacity[U : U + F], 1e-12
+    )
+    choice_s = scalar_winner(
+        spec.policy, b.view_busy, b.view_mips, b.registered, fog_alive,
+        fog_efrac, 2.0 * cache.d2b[U : U + F],
+        spec.bug_compat.v1_max_scan,
+    )
 
     choice_ok = choice_s >= 0
     if spec.policy == int(Policy.MAX_MIPS) and F > 0:

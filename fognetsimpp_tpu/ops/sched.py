@@ -41,6 +41,63 @@ def task_uniform(base_key: jax.Array, task_ids: jax.Array) -> jax.Array:
     )(task_ids)
 
 
+def scalar_winner(
+    policy: int,
+    view_busy: jax.Array,  # (F,)
+    view_mips: jax.Array,  # (F,)
+    registered: jax.Array,  # (F,) bool
+    fog_alive: jax.Array,  # (F,) bool (ENERGY_AWARE)
+    fog_energy_frac: jax.Array,  # (F,)
+    rtt_broker_fog: jax.Array,  # (F,) (MIN_LATENCY)
+    v1_max_scan: bool,
+) -> jax.Array:
+    """The task-independent winner for the engine's dense broker path.
+
+    With the faithful ``mips0_divisor`` quirk the per-task estimate term
+    is constant across fog nodes, so MIN_BUSY / MIN_LATENCY /
+    ENERGY_AWARE argmins (and the v1/v2 MAX_MIPS scan, batch-global by
+    construction) collapse to one scalar — the same formulas as
+    :func:`schedule_batch`'s per-task branches, kept HERE so the
+    reference-bug-faithful scans have a single home (the dense/compacted
+    equivalence is gate-tested via the DYNAMIC-vs-static sweep tests and
+    the DES parity suite).  Returns () i32 fog index, -1 = no resource.
+    """
+    F = view_busy.shape[0]
+    i32 = jnp.int32
+    if F == 0:
+        return jnp.full((), -1, i32)
+    avail = registered
+    # brokers[0] anchors = the FIRST REGISTERED fog (registration order)
+    first_reg = jnp.argmax(avail).astype(i32)
+    if policy in (int(Policy.MAX_MIPS), int(Policy.LOCAL_FIRST)):
+        idx = jnp.arange(F, dtype=i32)
+        if v1_max_scan:
+            cand = (
+                avail
+                & (idx > first_reg)
+                & (view_mips > view_mips[first_reg])
+            )
+            last = jnp.max(jnp.where(cand, idx, -1))
+            return jnp.where(last >= 0, last, first_reg).astype(i32)
+        return jnp.argmax(jnp.where(avail, view_mips, -jnp.inf)).astype(i32)
+    if policy == int(Policy.MIN_BUSY):
+        base, avail_ = view_busy, avail
+    elif policy == int(Policy.MIN_LATENCY):
+        base, avail_ = rtt_broker_fog + view_busy, avail
+    elif policy == int(Policy.ENERGY_AWARE):
+        base = view_busy + 10.0 * (1.0 - fog_energy_frac)
+        avail_ = avail & fog_alive
+    else:
+        raise ValueError(f"no scalar winner for policy {policy}")
+    scores = jnp.nan_to_num(jnp.where(avail_, base, _BIG), posinf=_BIG)
+    choice0 = jnp.argmin(scores).astype(i32)
+    # est = mips_req / brokers[0].MIPS is +inf until the first advert
+    # lands (MIPS=0 registration): every candidate scores BIG and the
+    # per-task argmin picks index 0 — replicate that tie
+    choice0 = jnp.where(view_mips[first_reg] > 0, choice0, 0)
+    return jnp.where(jnp.any(avail_), choice0, -1).astype(i32)
+
+
 def _safe_div(a: jax.Array, b: jax.Array) -> jax.Array:
     """a / b with b==0 -> +inf (matches C++ double division by zero).
 
