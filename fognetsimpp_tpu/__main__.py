@@ -98,6 +98,9 @@ def main(argv=None) -> int:
         from .config.ini import scenario_builders
         from .parallel import sweep_policies
 
+        if args.ticks or args.trails:
+            ap.error("--sweep is incompatible with --ticks/--trails "
+                     "(sweeps return counter grids, not series)")
         opts = dict(kv.split("=", 1) for kv in args.sweep.split())
         policies = [int(p) for p in opts.get("policies", "0").split(",")]
         loads = [float(x) for x in opts.get("loads", "0.05").split(",")]
@@ -110,11 +113,15 @@ def main(argv=None) -> int:
                 f"unknown scenario {name!r} (have {sorted(builders)})"
             )
         # the sweep path passes only scenario.* kwargs to the builder —
-        # fail loudly on override tiers it cannot honour rather than
-        # silently running a different world than the user configured
+        # fail loudly on override tiers it cannot honour (wildcard
+        # patterns included) rather than silently running a different
+        # world than the user configured
         unsupported = sorted(
-            k for k in ("spec", "fog", "user")
-            if cfg.matching(k)
+            {
+                pat.split(".", 1)[0]
+                for pat, _, _ in cfg.entries
+                if pat.split(".", 1)[0] in ("spec", "fog", "user")
+            }
         )
         if unsupported:
             ap.error(
@@ -127,7 +134,7 @@ def main(argv=None) -> int:
         build_kwargs.pop("seed", None)
         t0 = time.perf_counter()
         grids = sweep_policies(
-            scenario_builders()[name],
+            builders[name],
             policies=policies,
             load_intervals=loads,
             n_replicas_per_load=reps,

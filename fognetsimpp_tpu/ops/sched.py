@@ -69,7 +69,10 @@ def scalar_winner(
     avail = registered
     # brokers[0] anchors = the FIRST REGISTERED fog (registration order)
     first_reg = jnp.argmax(avail).astype(i32)
-    if policy in (int(Policy.MAX_MIPS), int(Policy.LOCAL_FIRST)):
+    if policy == int(Policy.MAX_MIPS):
+        # (LOCAL_FIRST deliberately NOT accepted: its local-pool branch is
+        # sequential and has no dense-path equivalent — engine gate
+        # _broker_dense_ok keeps it on the compacted path)
         idx = jnp.arange(F, dtype=i32)
         if v1_max_scan:
             cand = (
