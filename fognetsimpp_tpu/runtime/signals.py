@@ -81,6 +81,7 @@ def summarize(final: WorldState) -> Dict[str, float]:
         n_local=int(m.n_local),
         n_adverts=int(m.n_adverts),
         n_lost=int(m.n_lost),
+        n_link_drops=int(m.n_link_drops),
     )
     for name, v in sig.items():
         out[f"{name}_n"] = int(v.size)

@@ -40,6 +40,10 @@ def test_saturated_link_delays_and_drops():
     final, _ = run(spec, state, net, bounds)
     m = final.metrics
     assert int(m.n_link_drops) > 1000, int(m.n_link_drops)
+    # the counter reaches the .sca scalar roll-up too
+    from fognetsimpp_tpu.runtime import summarize
+
+    assert summarize(final)["n_link_drops"] == int(m.n_link_drops)
     # tail-dropped publishes enter Stage.LOST (offered ~6x capacity, so a
     # large fraction of the 120k publishes dies at the queue; the backlog
     # itself oscillates — drops collapse traffic, the queue drains, load
