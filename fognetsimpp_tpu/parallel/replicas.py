@@ -14,6 +14,8 @@ per-user publish interval (the ``volatile sendInterval`` NED parameter,
 """
 from __future__ import annotations
 
+import dataclasses
+
 from typing import Dict, Optional
 
 import jax
@@ -90,20 +92,13 @@ def run_replicated(
 
 
 def replica_counters(final_batch: WorldState) -> Dict[str, np.ndarray]:
-    """Per-replica metric counters as host numpy arrays, keyed by name."""
+    """Per-replica metric counters as host numpy arrays, keyed by name.
+
+    Enumerates every Metrics field, so counters added to the state never
+    silently vanish from sweep grids.
+    """
     m = final_batch.metrics
     return {
-        name: np.asarray(getattr(m, name))
-        for name in (
-            "n_published",
-            "n_scheduled",
-            "n_completed",
-            "n_dropped",
-            "n_no_resource",
-            "n_connected",
-            "n_rejected",
-            "n_local",
-            "n_lost",
-            "n_adverts",
-        )
+        f.name: np.asarray(getattr(m, f.name))
+        for f in dataclasses.fields(m)
     }
