@@ -68,20 +68,12 @@ def summarize(final: WorldState) -> Dict[str, float]:
         f"stage_{s.name.lower()}": int((stage == int(s)).sum()) for s in Stage
     }
     m = final.metrics
+    # every Metrics counter, by field enumeration (a counter added to the
+    # state can never silently vanish from the .sca roll-up)
+    import dataclasses
+
     out.update(
-        n_published=int(m.n_published),
-        n_scheduled=int(m.n_scheduled),
-        n_completed=int(m.n_completed),
-        n_dropped=int(m.n_dropped),
-        n_no_resource=int(m.n_no_resource),
-        n_connected=int(m.n_connected),
-        n_subscribed=int(m.n_subscribed),
-        n_fanout=int(m.n_fanout),
-        n_rejected=int(m.n_rejected),
-        n_local=int(m.n_local),
-        n_adverts=int(m.n_adverts),
-        n_lost=int(m.n_lost),
-        n_link_drops=int(m.n_link_drops),
+        {f.name: int(getattr(m, f.name)) for f in dataclasses.fields(m)}
     )
     for name, v in sig.items():
         out[f"{name}_n"] = int(v.size)
