@@ -357,6 +357,8 @@ def _phase_spawn(
 def _phase_v2_release(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t1: jax.Array, before_broker: bool,
+    resched_t: Optional[jax.Array] = None,
+    prerefunded: Optional[jax.Array] = None,
 ) -> Tuple[WorldState, TickBuf]:
     """The v2 broker's shared-timer releaseResource (BrokerBaseApp2.cc:
     284-312 via the selfMsg dance at :221-224).
@@ -412,11 +414,17 @@ def _phase_v2_release(
     ack_t = fire_t + cache.d2b[user_sel]
     was_local = tasks.stage[selc] == jnp.int8(int(Stage.LOCAL_RUN))
 
+    # the self-message is spent whether or not a request matched; when the
+    # broker phase deferred a reschedule behind an already-due fire (ADVICE
+    # r3: an accept cannot cancel a timer that fired before it in event
+    # order), the consumed timer is replaced by that reschedule, and the
+    # pool refund is skipped if the broker scan already applied it
+    next_t = jnp.inf if resched_t is None else resched_t
+    pre = jnp.zeros((), bool) if prerefunded is None else prerefunded
     b = b.replace(
         local_pool=b.local_pool
-        + jnp.where(have, tasks.mips_req[selc], 0.0),
-        # the self-message is spent whether or not a request matched
-        release_timer_t=jnp.where(fire, jnp.inf, fire_t),
+        + jnp.where(have & ~pre, tasks.mips_req[selc], 0.0),
+        release_timer_t=jnp.where(fire, next_t, fire_t),
     )
     scat = jnp.where(have, sel, T)
     scat_local = jnp.where(have & was_local, sel, T)
@@ -610,6 +618,7 @@ def _phase_broker(
     tasks, b = state.tasks, state.broker
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
     S = spec.max_sends_per_user
+    v2_resched = None  # deferred release-timer reschedule (v2 broker only)
     mask = (tasks.stage == jnp.int8(int(Stage.PUB_INFLIGHT))) & (
         tasks.t_at_broker <= t1
     )
@@ -650,28 +659,89 @@ def _phase_broker(
         order = jnp.lexsort((idx, jnp.where(valid, t_ab_g, jnp.inf)))
         mips_sorted = mips_g[order]
         valid_sorted = valid[order]
+        if not spec.v2_local_broker:
 
-        def body(pool, xs):
-            m, v = xs
-            take = v & (m < pool)  # strict <, BrokerBaseApp.cc:171
-            return pool - jnp.where(take, m, 0.0), take
+            def body(pool, xs):
+                m, v = xs
+                take = v & (m < pool)  # strict <, BrokerBaseApp.cc:171
+                return pool - jnp.where(take, m, 0.0), take
 
-        pool_after, local_sorted = jax.lax.scan(
-            body, b.local_pool, (mips_sorted, valid_sorted)
-        )
+            pool_after, local_sorted = jax.lax.scan(
+                body, b.local_pool, (mips_sorted, valid_sorted)
+            )
+        else:
+            # v2: the shared RELEASERESOURCE self-message is interleaved
+            # with the accept chain in event order (ADVICE r3 + r4 review):
+            #   * every local accept cancels the pending timer
+            #     (BrokerBaseApp2.cc:221-224) — the FIRST accept before
+            #     the fire time disarms it;
+            #   * a still-armed timer pops before any arrival at or after
+            #     its fire time, and its pool refund is visible to the
+            #     accept checks that follow it in the same tick.
+            # The released request is selected on pre-decision state —
+            # identical to the after-pass selection, because a request
+            # stored this tick can only satisfy ``expiry < fire`` when
+            # required_time < dt (excluded by validate()).
+            fire_t0 = b.release_timer_t
+            expiry0 = tasks.t_at_broker + spec.required_time
+            open0 = (tasks.req_open > 0) & (expiry0 < fire_t0)
+            key0 = jnp.where(open0, tasks.t_at_broker, jnp.inf)
+            cand0 = open0 & (key0 == jnp.min(key0))
+            sel0 = jnp.min(
+                jnp.where(cand0, jnp.arange(T, dtype=jnp.int32), T)
+            )
+            refund0 = jnp.where(
+                sel0 < T, tasks.mips_req[jnp.clip(sel0, 0, T - 1)], 0.0
+            )
+            tm_sorted = jnp.where(valid, t_ab_g, jnp.inf)[order]
+
+            def body(carry, xs):
+                pool, armed, fired = carry
+                m, v, t = xs
+                # the timer (heap-pushed earlier) pops before an arrival
+                # at the same instant: fire at t >= fire time
+                fire_now = armed & v & (t >= fire_t0)
+                pool = pool + jnp.where(fire_now, refund0, 0.0)
+                fired = fired | fire_now
+                armed = armed & ~fire_now
+                take = v & (m < pool)  # strict <, BrokerBaseApp2.cc:181
+                pool = pool - jnp.where(take, m, 0.0)
+                armed = armed & ~take  # cancelEvent at every accept
+                return (pool, armed, fired), take
+
+            (pool_after, _, v2_fired), local_sorted = jax.lax.scan(
+                body,
+                (
+                    b.local_pool,
+                    jnp.isfinite(fire_t0),
+                    jnp.zeros((), bool),
+                ),
+                (mips_sorted, valid_sorted, tm_sorted),
+            )
         local = jnp.zeros((K,), bool).at[order].set(local_sorted)
         b = b.replace(local_pool=pool_after)
         if spec.v2_local_broker:
-            # every local accept cancels + reschedules the shared release
-            # self-message: only the LAST accept's expiry survives
-            # (BrokerBaseApp2.cc:221-224)
+            # Timer disposition (one shared self-message, App. B item 8):
+            #   * in-scan fire  -> leave it armed at the old fire time so
+            #     the after pass does the release bookkeeping (its pool
+            #     refund already landed in the scan), then installs the
+            #     last accept's reschedule via ``v2_resched``;
+            #   * accepts only  -> the first accept cancelled it: install
+            #     the last accept's reschedule directly;
+            #   * neither       -> unchanged (the after pass fires it if
+            #     due, with the full refund).
             any_local = jnp.any(local)
             t_last_acc = jnp.max(jnp.where(local, t_ab_g, -jnp.inf))
+            resched = jnp.where(
+                any_local, t_last_acc + spec.required_time, jnp.inf
+            )
+            v2_resched = (
+                jnp.where(v2_fired, resched, jnp.inf),  # after-pass next
+                v2_fired,  # pool already refunded in-scan
+            )
             b = b.replace(
                 release_timer_t=jnp.where(
-                    any_local,
-                    t_last_acc + spec.required_time,
-                    b.release_timer_t,
+                    any_local & ~v2_fired, resched, b.release_timer_t
                 )
             )
 
@@ -795,6 +865,7 @@ def _phase_broker(
             metrics=metrics, key=key,
         ),
         buf,
+        v2_resched,
     )
 
 
@@ -1339,7 +1410,13 @@ def make_step(
         cache = associate(net, pos, nodes.alive, broker=spec.broker_index)
         if spec.wired_queue_enabled:
             # DropTailQueue backpressure (wireless5.ini:72-73): last
-            # tick's egress backlog serializes ahead of new messages
+            # tick's egress backlog serializes ahead of new messages.
+            # SYMMETRIC simplification (PARITY.md deviation ledger): both
+            # endpoints' egress backlogs delay the shared d2b vector, so
+            # a broker->user ack is also delayed by the user's uplink
+            # backlog — directionally wrong under asymmetric congestion;
+            # exact in aggregate for the symmetric request/ack traffic of
+            # the committed scenarios.
             qdelay = state.nodes.link_backlog * (8.0 / spec.link_rate_bps)
             cache = cache.replace(
                 d2b=cache.d2b + qdelay + qdelay[spec.broker_index]
@@ -1359,13 +1436,18 @@ def make_step(
             state, buf = _phase_v2_release(
                 spec, state, net, cache, buf, t1, before_broker=True
             )
+        v2_resched = None
         if _broker_dense_ok(spec):
             state, buf = _phase_broker_dense(spec, state, net, cache, buf, t1)
         else:
-            state, buf = _phase_broker(spec, state, net, cache, buf, t1)
+            state, buf, v2_resched = _phase_broker(
+                spec, state, net, cache, buf, t1
+            )
         if v2_local:  # fires this tick's decisions did not cancel
+            rs, pre = (None, None) if v2_resched is None else v2_resched
             state, buf = _phase_v2_release(
-                spec, state, net, cache, buf, t1, before_broker=False
+                spec, state, net, cache, buf, t1, before_broker=False,
+                resched_t=rs, prerefunded=pre,
             )
         if spec.n_fogs > 0:  # a fog-less world exercises only the
             # "no compute resource available" branch (BrokerBaseApp3.cc:306)

@@ -95,9 +95,11 @@ def scalar_winner(
     scores = jnp.nan_to_num(jnp.where(avail_, base, _BIG), posinf=_BIG)
     choice0 = jnp.argmin(scores).astype(i32)
     # est = mips_req / brokers[0].MIPS is +inf until the first advert
-    # lands (MIPS=0 registration): every candidate scores BIG and the
-    # per-task argmin picks index 0 — replicate that tie
-    choice0 = jnp.where(view_mips[first_reg] > 0, choice0, 0)
+    # lands (MIPS=0 registration): every candidate scores +inf, the C++
+    # strict-< scan never updates, and the winner stays its initial value
+    # — brokers[0], i.e. the FIRST REGISTERED fog (ADVICE r3: anchoring
+    # array slot 0 here diverged whenever fog slot 0 registered last)
+    choice0 = jnp.where(view_mips[first_reg] > 0, choice0, first_reg)
     return jnp.where(jnp.any(avail_), choice0, -1).astype(i32)
 
 
@@ -181,9 +183,14 @@ def schedule_batch(
     def from_scores(scores, avail_):
         scores = jnp.where(avail_[None, :], scores, _BIG)
         # all-inf rows (early publishes before any advertisement, with the
-        # MIPS=0 registration) must still pick fog 0, like the C++ `<` scan
+        # MIPS=0 registration): the C++ strict-< scan never updates, so the
+        # winner stays its initial value — brokers[0], the FIRST REGISTERED
+        # fog (ADVICE r3: a plain argmin over an all-_BIG row picked array
+        # slot 0 instead, diverging when fog slot 0 registered last)
         scores = jnp.nan_to_num(scores, posinf=_BIG)
         choice = jnp.argmin(scores, axis=1).astype(jnp.int32)
+        all_big = jnp.all(scores >= _BIG, axis=1)
+        choice = jnp.where(all_big, first_reg, choice)
         # no available fog at all -> -1 (caller routes to Stage.NO_RESOURCE)
         choice = jnp.where(jnp.any(avail_), choice, -1)
         return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor

@@ -100,7 +100,9 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
     # every message the simulation moves.)
     tx = np.asarray(final.nodes.tx_count)
     rx = np.asarray(final.nodes.rx_count)
-    link_bytes = (tx + rx) * spec.task_bytes
+    # int64 before the multiply: int32 * python int stays int32 under
+    # NumPy 2 promotion and wraps negative at benchmark scale (ADVICE r3)
+    link_bytes = (tx.astype(np.int64) + rx) * int(spec.task_bytes)
     n_ticks = max(int(np.asarray(final.tick)), 1)
     assoc_sum = np.asarray(final.nodes.assoc_sum)
     broker_i = spec.broker_index

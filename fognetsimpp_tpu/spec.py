@@ -357,4 +357,10 @@ class WorldSpec:
                 "v2_local_broker models BrokerBaseApp2's hybrid broker: "
                 "set policy=Policy.LOCAL_FIRST (+ broker_mips)"
             )
+            assert self.required_time >= self.dt, (
+                "v2_local_broker needs required_time >= dt: the broker "
+                "scan's in-tick release pre-selection assumes a request "
+                "stored this tick cannot expire before a same-tick fire "
+                "(core/engine.py LOCAL_FIRST v2 scan)"
+            )
         return self

@@ -388,3 +388,47 @@ def test_queue_times_match(worlds):
     if both.any():
         np.testing.assert_allclose(eng_q[both], des_q[both], rtol=1e-2,
                                    atol=1e-5)
+
+
+def test_parity_fog0_registers_last():
+    """ADVICE r3: the zero-view tie anchors the FIRST REGISTERED fog.
+
+    Fog slot 0's access link is slowed so it registers AFTER the first
+    publishes are decided: in that window brokers[0] is fog 1, and with
+    the MIPS=0 registration view every estimate is +inf — the strict-<
+    scan keeps brokers[0].  Both simulators must route those early
+    publishes to fog 1, never to the not-yet-registered slot 0.
+    """
+    import jax.numpy as jnp
+
+    from fognetsimpp_tpu.core.engine import prime_initial_advertisements
+
+    # Slow fogs keep completion adverts spaced far beyond fog 0's 6 ms
+    # transit, and the 0.3 s horizon keeps f32-vs-f64 view_busy drift from
+    # producing near-tie argmin flips: both are modelling-envelope effects
+    # of the pathological 60x-slower link, not the registration-order
+    # semantics under test.
+    spec, state, net, bounds = smoke.build(
+        horizon=0.3,
+        send_interval=0.02,
+        dt=1e-4,
+        n_users=2,
+        n_fogs=3,
+        fog_mips=(2000.0, 3000.0, 2500.0),
+        start_time_max=0.001,
+    )
+    acc = np.asarray(net.node_acc).copy()
+    acc[spec.n_users + 0] = 6e-3  # fog 0 registers at ~6 ms
+    net = net.replace(node_acc=jnp.asarray(acc))
+    state = prime_initial_advertisements(spec, state, net)
+
+    final, _ = run(spec, state, net, bounds)
+    des, used = bridge.replay_engine_world(spec, final, net)
+
+    reg0 = float(np.asarray(state.broker.register_t)[0])
+    t_dec = np.asarray(final.tasks.t_at_broker)[used]
+    eng_fog = np.asarray(final.tasks.fog)[used]
+    early = t_dec < reg0
+    assert early.any()  # the divergence-prone window was exercised
+    assert (eng_fog[early] != 0).all()  # never the unregistered slot 0
+    np.testing.assert_array_equal(eng_fog, des["fog"])

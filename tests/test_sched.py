@@ -101,3 +101,32 @@ def test_random_only_picks_alive():
                       key=jax.random.PRNGKey(3))
     got = set(np.asarray(choice).tolist())
     assert got <= {1, 3} and len(got) == 2
+
+
+def test_zero_view_anchors_first_registered_not_slot0():
+    """ADVICE r3: with fog slot 0 unregistered and every estimate +inf
+    (pre-first-advert MIPS=0 view), the C++ strict-< scan keeps its
+    initial value brokers[0] = the FIRST REGISTERED fog — not array
+    slot 0, which in this window is not even in brokers[]."""
+    from fognetsimpp_tpu.ops.sched import scalar_winner
+
+    F = 3
+    busy = jnp.zeros((F,), jnp.float32)
+    vmips = jnp.zeros((F,), jnp.float32)
+    registered = jnp.array([False, True, True])
+    mask = jnp.array([True], bool)
+    req = jnp.array([500.0], jnp.float32)
+    choice, _ = schedule_batch(
+        int(Policy.MIN_BUSY), mask, req, busy, vmips, registered,
+        jnp.ones((F,), bool), jnp.ones((F,), jnp.float32),
+        jnp.zeros((F,), jnp.float32), jnp.asarray(0, jnp.int32),
+        jax.random.PRNGKey(0), True,
+    )
+    assert int(choice[0]) == 1
+
+    win = scalar_winner(
+        int(Policy.MIN_BUSY), busy, vmips, registered,
+        jnp.ones((F,), bool), jnp.ones((F,), jnp.float32),
+        jnp.zeros((F,), jnp.float32), True,
+    )
+    assert int(win) == 1

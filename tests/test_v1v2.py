@@ -145,3 +145,170 @@ def test_local_pool_leak_bug_compat():
     # 1000 -> two local runs (400+400), then pool=200 < 400 forever
     assert int(final.metrics.n_local) == 2
     assert float(final.broker.local_pool) <= 200.0 + 1e-6
+
+
+def test_v2_release_fire_between_same_tick_arrivals():
+    """ADVICE r3: a pending release whose fire time sits BETWEEN two
+    same-tick arrivals fires in event order — the later local accept
+    cannot cancel an already-fired timer (BrokerBaseApp2.cc:221-224
+    cancelEvent only removes a scheduled message).  The engine must both
+    consume that firing (one stored request released) and install the
+    accept's reschedule."""
+    import jax.numpy as jnp
+
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.net.mobility import default_bounds
+    from fognetsimpp_tpu.net.topology import wired_star
+    from fognetsimpp_tpu.spec import WorldSpec
+    from fognetsimpp_tpu.state import init_state
+
+    spec = WorldSpec(
+        n_users=2,
+        n_fogs=1,
+        dt=0.01,
+        horizon=0.02,
+        policy=int(Policy.LOCAL_FIRST),
+        v2_local_broker=True,
+        broker_mips=500.0,
+        connect_gating=False,
+        max_sends_per_user=2,
+    ).validate()
+    state = init_state(spec)
+    S = spec.max_sends_per_user
+
+    # suppress spawning: the workload is hand-placed below
+    state = state.replace(
+        users=state.users.replace(publisher=jnp.zeros((2,), bool))
+    )
+    tasks = state.tasks
+    inflight = jnp.int8(int(Stage.PUB_INFLIGHT))
+
+    def put(col, i, v):
+        return col.at[i].set(v)
+
+    # slot u0s0: arrival at 0.002, 600 MIPS (> pool 500 -> not local)
+    # slot u1s0: arrival at 0.008, 400 MIPS (< pool -> local accept)
+    # slot u0s1: stored open request from "before": expiry 0.000
+    a, b, r = 0 * S + 0, 1 * S + 0, 0 * S + 1
+    tasks = tasks.replace(
+        stage=put(put(put(tasks.stage, a, inflight), b, inflight),
+                  r, jnp.int8(int(Stage.LOCAL_RUN))),
+        t_at_broker=put(put(put(tasks.t_at_broker, a, 0.002), b, 0.008),
+                        r, -0.01),
+        t_create=put(put(put(tasks.t_create, a, 0.002), b, 0.008), r, -0.01),
+        mips_req=put(put(put(tasks.mips_req, a, 600.0), b, 400.0), r, 100.0),
+        req_open=put(tasks.req_open, r, jnp.int8(1)),
+    )
+    # pending shared timer fires at 0.005 — between the two arrivals
+    state = state.replace(
+        tasks=tasks,
+        broker=state.broker.replace(release_timer_t=jnp.asarray(0.005)),
+    )
+
+    net = wired_star(spec.n_nodes, packet_bytes=spec.task_bytes)
+    step = make_step(spec)
+    out = step(state, net, default_bounds(1000.0))
+
+    # the 0.005 firing happened: the stored request completed at 0.005
+    # and refunded its 100 MIPS; the accept then debited 400
+    assert int(np.asarray(out.tasks.stage)[r]) == int(Stage.DONE)
+    np.testing.assert_allclose(float(np.asarray(out.tasks.t_complete)[r]),
+                               0.005, atol=1e-6)
+    np.testing.assert_allclose(
+        float(out.broker.local_pool), 500.0 + 100.0 - 400.0, rtol=1e-6
+    )
+    # and the accept's reschedule was installed, not lost
+    np.testing.assert_allclose(
+        float(out.broker.release_timer_t), 0.008 + spec.required_time,
+        rtol=1e-6,
+    )
+
+
+def _v2_timer_world(pool, a_mips, b_mips, timer=0.005):
+    """Two hand-placed same-tick arrivals (0.002 / 0.008) straddling a
+    pending shared-timer fire, plus one stored expired request (100 MIPS)."""
+    import jax.numpy as jnp
+
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.net.mobility import default_bounds
+    from fognetsimpp_tpu.net.topology import wired_star
+    from fognetsimpp_tpu.spec import WorldSpec
+    from fognetsimpp_tpu.state import init_state
+
+    spec = WorldSpec(
+        n_users=2,
+        n_fogs=1,
+        dt=0.01,
+        horizon=0.02,
+        policy=int(Policy.LOCAL_FIRST),
+        v2_local_broker=True,
+        broker_mips=pool,
+        connect_gating=False,
+        max_sends_per_user=2,
+    ).validate()
+    state = init_state(spec)
+    S = spec.max_sends_per_user
+    state = state.replace(
+        users=state.users.replace(publisher=jnp.zeros((2,), bool))
+    )
+    tasks = state.tasks
+    inflight = jnp.int8(int(Stage.PUB_INFLIGHT))
+
+    def put(col, i, v):
+        return col.at[i].set(v)
+
+    a, b, r = 0 * S + 0, 1 * S + 0, 0 * S + 1
+    tasks = tasks.replace(
+        stage=put(put(put(tasks.stage, a, inflight), b, inflight),
+                  r, jnp.int8(int(Stage.LOCAL_RUN))),
+        t_at_broker=put(put(put(tasks.t_at_broker, a, 0.002), b, 0.008),
+                        r, -0.01),
+        t_create=put(put(put(tasks.t_create, a, 0.002), b, 0.008), r, -0.01),
+        mips_req=put(put(put(tasks.mips_req, a, a_mips), b, b_mips),
+                     r, 100.0),
+        req_open=put(tasks.req_open, r, jnp.int8(1)),
+    )
+    state = state.replace(
+        tasks=tasks,
+        broker=state.broker.replace(release_timer_t=jnp.asarray(timer)),
+    )
+    net = wired_star(spec.n_nodes, packet_bytes=spec.task_bytes)
+    out = make_step(spec)(state, net, default_bounds(1000.0))
+    return spec, out, r
+
+
+def test_v2_first_accept_cancels_pending_timer():
+    """r4 review finding 1: cancelEvent fires at EVERY local accept — the
+    FIRST accept preceding the fire time cancels the pending timer, even
+    when a later same-tick accept follows (BrokerBaseApp2.cc:221-224;
+    desim.cpp bumps release_gen per accept)."""
+    spec, out, r = _v2_timer_world(pool=500.0, a_mips=400.0, b_mips=50.0)
+    # accept at 0.002 (400 < 500) cancelled the 0.005 fire: the stored
+    # request must NOT have been released
+    assert int(np.asarray(out.tasks.stage)[r]) == int(Stage.LOCAL_RUN)
+    # both accepts debited; only the last accept's reschedule survives
+    np.testing.assert_allclose(float(out.broker.local_pool), 500 - 400 - 50)
+    np.testing.assert_allclose(
+        float(out.broker.release_timer_t), 0.008 + spec.required_time,
+        rtol=1e-6,
+    )
+
+
+def test_v2_fire_refund_visible_to_later_accept():
+    """r4 review finding 2: a still-armed timer pops before later arrivals
+    and its pool refund is visible to their accept checks — an arrival
+    whose MIPS fits only pool+refund runs locally, as in the DES's strict
+    event order."""
+    spec, out, r = _v2_timer_world(pool=500.0, a_mips=600.0, b_mips=550.0)
+    # 0.002 arrival (600 !< 500) does not accept or cancel; fire at 0.005
+    # refunds 100 -> pool 600; 0.008 arrival accepts (550 < 600)
+    assert int(np.asarray(out.tasks.stage)[r]) == int(Stage.DONE)
+    np.testing.assert_allclose(float(np.asarray(out.tasks.t_complete)[r]),
+                               0.005, atol=1e-6)
+    np.testing.assert_allclose(
+        float(out.broker.local_pool), 500 + 100 - 550, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(out.broker.release_timer_t), 0.008 + spec.required_time,
+        rtol=1e-6,
+    )

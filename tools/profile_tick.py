@@ -69,6 +69,8 @@ def main():
     print(f"full step:            {base_ms:8.3f} ms/tick   (compile {base_c:.1f}s)")
 
     ident2 = lambda spec, state, net, cache, buf, *a, **k: (state, buf)
+    # _phase_broker additionally returns the v2 release reschedule
+    ident3 = lambda spec, state, net, cache, buf, *a, **k: (state, buf, None)
 
     def patched(name, attr, repl):
         orig = getattr(E, attr)
@@ -82,7 +84,7 @@ def main():
     patched("connect", "_phase_connect", ident2)
     patched("adverts", "_phase_adverts", lambda state, t1: state)
     patched("spawn", "_phase_spawn", ident2)
-    patched("broker", "_phase_broker", ident2)
+    patched("broker", "_phase_broker", ident3)
     patched("completions", "_phase_completions", ident2)
     patched("fog_arrivals", "_phase_fog_arrivals", ident2)
 
@@ -109,7 +111,7 @@ def main():
     saved = {}
     for attr, repl in [
         ("_phase_connect", ident2), ("_phase_spawn", ident2),
-        ("_phase_broker", ident2), ("_phase_completions", ident2),
+        ("_phase_broker", ident3), ("_phase_completions", ident2),
         ("_phase_fog_arrivals", ident2),
         ("_phase_adverts", lambda state, t1: state),
     ]:
