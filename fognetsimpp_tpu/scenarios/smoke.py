@@ -39,6 +39,12 @@ def build(
     """Returns (spec, state, net, bounds) for the wired smoke world."""
     if max_sends_per_user is None:
         max_sends_per_user = int(horizon / send_interval) + 4
+    # all nodes are stationary on a wired star: the association/delay
+    # cache is constant, so the engine may hoist it out of the scan
+    # (spec.assume_static) unless the energy lifecycle is on
+    spec_overrides.setdefault(
+        "assume_static", not spec_overrides.get("energy_enabled", False)
+    )
     spec = WorldSpec(
         n_users=n_users,
         n_fogs=n_fogs,

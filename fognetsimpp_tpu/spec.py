@@ -197,6 +197,15 @@ class WorldSpec:
     #   beyond every committed horizon, so inf is the faithful default)
     mips_required_min: int = 200  # mqttApp2.cc:370: 200 + rand() % 701
     mips_required_max: int = 900
+    # Static bound on publishes per user per tick.  1 (default) keeps the
+    # original one-send-per-tick spawn phase (and its PRNG stream, which
+    # the committed-trace anchors pin).  >1 switches to the closed-form
+    # multi-send spawn (engine._phase_spawn_multi) so a coarse tick
+    # (dt > send_interval) still carries the full publish workload with
+    # exact per-send event times; requires send_interval_jitter == 0
+    # (the closed form needs deterministic send spacing).  Size it
+    # >= ceil(dt / min send_interval) + 1 or late sends defer a tick.
+    max_sends_per_tick: int = 1
     required_time: float = 0.01  # mqttApp2.cc:372
     task_bytes: int = 128  # mqttApp2.cc:379
     fixed_mips_required: Optional[int] = None  # v1: 100 (mqttApp.cc:330)
@@ -287,6 +296,17 @@ class WorldSpec:
     shutdown_frac: float = 0.10  # nodeShutdownCapacity = 10% (ini:160)
     start_frac: float = 0.50  # nodeStartCapacity = 50% (ini:161)
 
+    # --- static-world fast path ----------------------------------------
+    # Builder promise that node positions and liveness never change over
+    # the run (every node STATIONARY, no energy lifecycle): the engine
+    # then computes the association/delay cache ONCE before the scan and
+    # skips the per-tick mobility + association kernels entirely.
+    # Results are bit-identical to the unhoisted path (the cache is a
+    # pure function of (pos, alive), both constant); validate() rejects
+    # the combination with the energy model, and run() re-derives the
+    # cache whenever the promise cannot be checked.
+    assume_static: bool = False
+
     # --- misc ----------------------------------------------------------
     bug_compat: BugCompat = BugCompat()
     record_tick_series: bool = False  # emit per-tick vectors from the scan
@@ -338,6 +358,23 @@ class WorldSpec:
             return self.task_capacity
         return min(self.arrival_window, self.task_capacity)
 
+    @property
+    def auto_arrival_window(self) -> int:
+        """Window sized from the spec's own arrival rate (VERDICT r3 #4).
+
+        Steady-state publishes per tick = ``n_users * dt / send_interval``;
+        30% slack plus a start-up pad absorbs jitter and the connect
+        transient, so window overflow (``Metrics.n_deferred``) stays at
+        zero in steady state without hand tuning.  Pass as
+        ``arrival_window=spec_args -> build(..., arrival_window=None)``
+        replacement for large worlds: e.g. the 100k/1M-user benchmark
+        rows (``benchmarks.py``).
+        """
+        rate = self.n_users * self.dt / max(self.send_interval, 1e-12)
+        return int(
+            min(self.task_capacity, max(1024, int(1.3 * rate) + 256))
+        )
+
     def validate(self) -> "WorldSpec":
         assert self.n_users >= 0 and self.n_fogs >= 0
         assert self.max_sends_per_user > 0 and self.queue_capacity > 0
@@ -348,6 +385,17 @@ class WorldSpec:
         )
         if self.arrival_window is not None:
             assert self.arrival_window > 0
+        if self.assume_static:
+            assert not self.energy_enabled, (
+                "assume_static promises constant (pos, alive); the energy "
+                "model's lifecycle shutdown/restart mutates alive"
+            )
+        assert self.max_sends_per_tick >= 1
+        if self.max_sends_per_tick > 1:
+            assert self.send_interval_jitter == 0.0, (
+                "the closed-form multi-send spawn needs deterministic "
+                "send spacing (send_interval_jitter == 0)"
+            )
         if self.policy == int(Policy.LOCAL_FIRST):
             assert self.broker_mips > 0, (
                 "LOCAL_FIRST needs a broker-side MIPS pool (broker_mips)"

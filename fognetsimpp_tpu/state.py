@@ -200,6 +200,12 @@ class Metrics:
     #                      to a DropTail wired-queue overflow
     n_link_drops: jax.Array  # () i32 frames dropped by full wired queues
     #                           (spec.wired_queue_enabled)
+    n_deferred: jax.Array  # () i32 — matured-but-undecided tasks left
+    #   behind by this tick's arrival-window compactions (gauge, reset
+    #   each tick; conservation holds — they are decided in later ticks)
+    n_deferred_max: jax.Array  # () i32 — running max of that backlog
+    #   over the run: 0 means the window never overflowed (the engine
+    #   was "current" every tick)
 
 
 @struct.dataclass
@@ -350,6 +356,8 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         n_adverts=jnp.zeros((), jnp.int32),
         n_lost=jnp.zeros((), jnp.int32),
         n_link_drops=jnp.zeros((), jnp.int32),
+        n_deferred=jnp.zeros((), jnp.int32),
+        n_deferred_max=jnp.zeros((), jnp.int32),
     )
 
     return WorldState(

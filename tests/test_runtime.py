@@ -213,3 +213,30 @@ def test_cli(tmp_path, capsys):
     assert out["n_published"] > 0
     assert (tmp_path / "cli-0.sca.json").exists()
     assert (tmp_path / "cli-0.vec.npz").exists()
+
+
+def test_assume_static_bit_identical():
+    """The static-world fast path (cache hoisted out of the scan, zero
+    mobility kernels) is bit-identical to the per-tick path on the same
+    world."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu import run
+    from fognetsimpp_tpu.scenarios import smoke
+
+    spec_s, state, net, bounds = smoke.build(
+        horizon=0.4, send_interval=0.02, dt=1e-3, n_users=3, n_fogs=2,
+        start_time_max=0.01,
+    )
+    assert spec_s.assume_static  # builder default for the wired star
+    spec_d = dataclasses.replace(spec_s, assume_static=False)
+
+    fin_s, _ = run(spec_s, state, net, bounds)
+    fin_d, _ = run(spec_d, state, net, bounds)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(fin_s), jax.tree_util.tree_leaves(fin_d)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
