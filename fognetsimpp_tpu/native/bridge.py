@@ -68,6 +68,9 @@ def _load() -> ctypes.CDLL:
             + [ctypes.c_double] * 4  # tx_j, rx_j, idle_w, compute_w
             + [dp]  # rand_u (nullable)
             + [ctypes.c_int]  # v2_local
+            + [dp]  # d2b_tab (nullable)
+            + [ctypes.c_int] * 2 + [ctypes.c_double]  # tab shape + dt
+            + [ctypes.POINTER(ctypes.c_ubyte)]  # task_lost (nullable)
             + [dp, ip] + [dp] * 9 + [ip]
             + [dp]  # o_fog_energy (nullable)
         )
@@ -106,6 +109,10 @@ def run_gen(
     compute_power_w: float = 0.0,
     rand_u: Optional[np.ndarray] = None,  # RANDOM's shared per-task draws
     v2_local: bool = False,  # spec.v2_local_broker hybrid semantics
+    d2b_table: Optional[np.ndarray] = None,  # (n_ticks, n_nodes) per-tick
+    #   node<->broker delays (wireless/mobility); None = static d_ub/d_bf
+    table_dt: float = 0.0,
+    task_lost: Optional[np.ndarray] = None,  # (n_tasks) uint8 loss replay
 ) -> Dict[str, np.ndarray]:
     """Run the native DES over an explicit publish schedule."""
     lib = _load()
@@ -144,6 +151,16 @@ def run_gen(
     fog_energy_out = (
         np.empty((len(d_bf),), np.float64) if e0 is not None else None
     )
+    tab = (
+        np.ascontiguousarray(np.asarray(d2b_table, np.float64))
+        if d2b_table is not None
+        else None
+    )
+    lost_arr = (
+        np.ascontiguousarray(np.asarray(task_lost, np.uint8))
+        if task_lost is not None
+        else None
+    )
 
     n_events = lib.desim_run_gen(
         len(d_ub), len(d_bf), n_tasks,
@@ -162,6 +179,13 @@ def run_gen(
         ctypes.c_double(idle_power_w), ctypes.c_double(compute_power_w),
         pd(ru) if ru is not None else null_d,
         int(v2_local),
+        pd(tab) if tab is not None else null_d,
+        int(tab.shape[0]) if tab is not None else 0,
+        int(tab.shape[1]) if tab is not None else 0,
+        ctypes.c_double(table_dt),
+        (lost_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))
+         if lost_arr is not None
+         else ctypes.cast(None, ctypes.POINTER(ctypes.c_ubyte))),
         pd(outs_d["t_at_broker"]), pi(fog), pd(outs_d["t_at_fog"]),
         pd(outs_d["t_service_start"]), pd(outs_d["t_complete"]),
         pd(outs_d["t_ack3"]), pd(outs_d["t_ack4_fwd"]), pd(outs_d["t_ack5"]),
@@ -178,34 +202,88 @@ def run_gen(
     return out
 
 
+def delay_table(spec, state0, net, bounds=None, n_ticks=None) -> np.ndarray:
+    """Per-tick node→broker delay table for the DES (wireless/mobility).
+
+    Runs the SAME mobility + association chain the engine's tick runs
+    (``step_mobility`` to end-of-tick positions, then ``associate`` — so
+    row ``s`` is exactly the ``cache.d2b`` the engine's tick ``s`` decides
+    with), without any protocol phases: the network model is deterministic
+    data, so the sequential baseline can consume it while still executing
+    every EVENT independently.  Returns float64 ``(n_ticks, n_nodes)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..net.mobility import default_bounds, step_mobility
+    from ..net.topology import associate
+
+    if bounds is None:
+        bounds = default_bounds()
+    n = spec.n_ticks if n_ticks is None else n_ticks
+
+    def body(carry, tick):
+        nodes = carry
+        t1 = (tick + 1).astype(jnp.float32) * spec.dt
+        pos, vel = step_mobility(nodes, bounds, t1, spec.dt)
+        nodes = nodes.replace(pos=pos, vel=vel)
+        cache = associate(
+            net, nodes.pos, nodes.alive, broker=spec.broker_index
+        )
+        return nodes, cache.d2b
+
+    _, d2b = jax.jit(
+        lambda s: jax.lax.scan(
+            body, s, jnp.arange(n, dtype=jnp.int32)
+        )
+    )(state0.nodes)
+    return np.asarray(d2b, np.float64)
+
+
 def replay_engine_world(
-    spec, final_state, net, horizon: Optional[float] = None
+    spec, final_state, net, horizon: Optional[float] = None,
+    state0=None, bounds=None,
 ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
     """Replay a finished engine run's publish workload through the DES.
 
     Extracts the client-side inputs the engine decided (per-task user,
-    creation time, MIPSRequired — all independent of scheduling), the static
-    delay vectors, the fog boot schedule from the primed initial state, and
-    the generation parameters from the spec, then runs the native core over
-    the same horizon.
+    creation time, MIPSRequired, uplink-loss draws — all independent of
+    scheduling), the delay model, the fog boot schedule from the primed
+    initial state, and the generation parameters from the spec, then runs
+    the native core over the same horizon.
 
-    Only defined for static wired worlds (the smoke shape): with wireless
-    nodes or mobility the per-task delays are time-varying and a single
-    delay vector would silently corrupt the parity baseline.
+    Static wired worlds use one delay vector; wireless/mobility worlds
+    (r4) pass ``state0`` (the scenario's initial state — positions and
+    mobility programs are not recoverable from the final state) and the
+    DES consumes a per-tick :func:`delay_table` from the same
+    association/mobility model, so handover, contention and range loss
+    reach the sequential baseline as time-varying data while every event
+    is still executed independently.  Energy-driven lifecycle plus
+    wireless is the one remaining exclusion (``alive`` would feed back
+    into the table through the engine's own protocol traffic).
     """
     import jax.numpy as jnp  # deferred; host-side use only
 
     from ..net.topology import associate
     from ..state import init_state
     from ..core.engine import prime_initial_advertisements
+    from ..spec import Stage
 
-    if bool(np.asarray(net.is_wireless).any()):
+    wireless_world = bool(np.asarray(net.is_wireless).any()) or bool(
+        (np.asarray(final_state.nodes.mobility) != 0).any()
+    )
+    if wireless_world and state0 is None:
         raise NotImplementedError(
-            "replay_engine_world is defined for static wired worlds only"
+            "wireless/mobility replay needs the scenario's initial state: "
+            "replay_engine_world(spec, final, net, state0=state, "
+            "bounds=bounds)"
         )
-    if bool((np.asarray(final_state.nodes.mobility) != 0).any()):
+    if wireless_world and spec.energy_enabled:
         raise NotImplementedError(
-            "replay_engine_world requires stationary nodes"
+            "wireless + energy lifecycle has no independent baseline: the "
+            "alive trajectory would feed back into the delay table through "
+            "the engine's own traffic (energy parity is gated separately "
+            "on wired worlds, tests/test_parity.py::test_parity_energy_aware)"
         )
     # all 7 policies have a sequential baseline (r3): ENERGY_AWARE runs on
     # the DES's per-fog energy model (fed the spec's joule parameters) and
@@ -218,17 +296,34 @@ def replay_engine_world(
     tasks = final_state.tasks
     t_create = np.asarray(tasks.t_create, np.float64)
     used = np.isfinite(t_create)
-    cache = associate(
-        net, final_state.nodes.pos, jnp.ones_like(final_state.nodes.alive),
-        broker=spec.broker_index,
-    )
-    d2b = np.asarray(cache.d2b, np.float64)
+    table_kw = {}
+    if wireless_world:
+        tab = delay_table(spec, state0, net, bounds)
+        d2b = tab[0]  # static fallback columns (unused when tab is given)
+        table_kw = dict(d2b_table=tab, table_dt=spec.dt)
+        # the engine's uplink-loss Bernoulli outcomes, replayed as data
+        lost = (
+            np.asarray(tasks.stage) == int(Stage.LOST)
+        ).astype(np.uint8)
+        table_kw["task_lost"] = lost[used]
+    else:
+        cache = associate(
+            net, final_state.nodes.pos,
+            jnp.ones_like(final_state.nodes.alive),
+            broker=spec.broker_index,
+        )
+        d2b = np.asarray(cache.d2b, np.float64)
     fog_nodes = np.arange(spec.n_fogs) + spec.n_users
 
     # fog boot schedule exactly as prime_initial_advertisements stamped it
-    state0 = prime_initial_advertisements(spec, init_state(spec), net)
-    register_t = np.asarray(state0.broker.register_t, np.float64)
-    adv0_t = np.asarray(state0.broker.adv_arrive_t, np.float64)
+    # (a provided state0 is the builder's already-primed initial state)
+    state0p = (
+        state0
+        if state0 is not None
+        else prime_initial_advertisements(spec, init_state(spec), net)
+    )
+    register_t = np.asarray(state0p.broker.register_t, np.float64)
+    adv0_t = np.asarray(state0p.broker.adv_arrive_t, np.float64)
 
     energy_kw = {}
     if spec.policy == 3 or spec.energy_enabled:
@@ -293,4 +388,5 @@ def replay_engine_world(
         v2_local=spec.v2_local_broker,
         **energy_kw,
         **rand_kw,
+        **table_kw,
     ), used

@@ -35,6 +35,7 @@
 // Build: g++ -O2 -shared -fPIC desim.cpp -o libdesim.so   (see bridge.py)
 
 #include <cmath>
+#include <cstddef>
 #include <cstdint>
 #include <limits>
 #include <queue>
@@ -56,6 +57,7 @@ enum Stage : int {
   kDropped = 7,
   kLocalRun = 8,
   kRejected = 9,
+  kLost = 10,
 };
 
 // Policy codes matching fognetsimpp_tpu.spec.Policy.  r3: ENERGY_AWARE
@@ -160,6 +162,21 @@ struct Params {
   const double* rand_u;  // (n_tasks) or nullptr
   // v2 hybrid broker (spec.v2_local_broker): single shared release timer
   int v2_local;
+  // time-varying node<->broker delays (wireless/mobility worlds):
+  // row s covers simulated time (s*tab_dt, (s+1)*tab_dt] — the batched
+  // engine evaluates every event-decision phase against the link cache
+  // of the tick CONTAINING the event under its `<= t1` masks, so the
+  // lookup is ceil(t/tab_dt)-1.  nullptr = static d_ub/d_bf vectors.
+  // +inf entries mean "unreachable now" (out of AP range): the message
+  // is never delivered, like a packet that never associates in INET.
+  const double* d2b_tab;  // (tab_steps, tab_stride) node-major rows
+  int tab_steps;
+  int tab_stride;  // n_users + n_fogs + ... (node-axis length)
+  double tab_dt;
+  // per-task wireless uplink loss (engine Stage.LOST replayed as data:
+  // the Bernoulli draw is the engine's, so both simulators lose the
+  // SAME publishes); nullptr = no loss
+  const unsigned char* task_lost;
 };
 
 struct World {
@@ -179,6 +196,7 @@ struct World {
   int64_t seq = 0;
 
   void push(double t, int kind, int a, double x = 0.0, double y = 0.0) {
+    if (!std::isfinite(t)) return;  // unreachable endpoint: never delivered
     heap.push(Event{t, seq++, kind, a, x, y});
   }
 
@@ -194,6 +212,23 @@ struct World {
     fg.energy -= drain + msg_j;
     if (fg.energy < 0.0) fg.energy = 0.0;
     if (fg.energy > fg.cap) fg.energy = fg.cap;
+  }
+
+  // --- delay model -----------------------------------------------------
+  // Static vectors (wired worlds) or the caller-precomputed per-tick
+  // table (wireless/mobility): the same association/mobility model the
+  // batched engine runs, evaluated at the tick containing the event.
+  double tab(int node, double t) const {
+    int s = static_cast<int>(std::ceil(t / p.tab_dt)) - 1;
+    if (s < 0) s = 0;
+    if (s >= p.tab_steps) s = p.tab_steps - 1;
+    return p.d2b_tab[static_cast<size_t>(s) * p.tab_stride + node];
+  }
+  double d_user(int u, double t) const {
+    return p.d2b_tab ? tab(u, t) : p.d_ub[u];
+  }
+  double d_fog(int f, double t) const {
+    return p.d2b_tab ? tab(p.n_users + f, t) : p.d_bf[f];
   }
 
   // v3 `<` scan over brokers[] (BrokerBaseApp3.cc:267-281): first-wins
@@ -213,7 +248,7 @@ struct World {
       double div = p.mips0_divisor ? view_mips[first_reg] : view_mips[f];
       double est = div > 0.0 ? req / div : kInf;
       double score = view_busy[f] + est;
-      if (add_rtt) score += 2.0 * p.d_bf[f];
+      if (add_rtt) score += 2.0 * d_fog(f, now);
       if (add_energy) {
         touch_energy(f, now);
         double cap = fogs[f].cap > 1e-12 ? fogs[f].cap : 1e-12;
@@ -285,7 +320,7 @@ struct World {
       local_pool -= tk.mips_req;
       tk.stage = kLocalRun;
       tk.t_service_start = now;
-      tk.t_ack3 = now + p.d_ub[tk.user];
+      tk.t_ack3 = now + d_user(tk.user, now);
       if (p.v2_local) {
         // v2: store the request; completion comes only from the shared
         // timer — cancelEvent + scheduleAt (BrokerBaseApp2.cc:221-224)
@@ -300,7 +335,7 @@ struct World {
       return;
     }
     // every non-local publish gets the "forwarded" status-4 (:146-150)
-    tk.t_ack4_fwd = now + p.d_ub[tk.user];
+    tk.t_ack4_fwd = now + d_user(tk.user, now);
     int choice;
     switch (p.policy) {
       case kMinBusy:
@@ -341,7 +376,7 @@ struct World {
     }
     tk.stage = kTaskInflight;
     tk.fog = choice;
-    tk.t_at_fog = now + p.d_bf[choice];
+    tk.t_at_fog = now + d_fog(choice, now);
     push(tk.t_at_fog, kEvTaskArrive, i);
   }
 
@@ -358,7 +393,7 @@ struct World {
       tk.stage = kRunning;
       tk.t_service_start = now;
       fg.busy_until = now + tk.svc;
-      tk.t_ack5 = now + p.d_bf[tk.fog] + p.d_ub[tk.user];  // "assigned"
+      tk.t_ack5 = now + d_fog(tk.fog, now) + d_user(tk.user, now);  // "assigned"
       push(fg.busy_until, kEvRelease, tk.fog);
     } else {                              // busy: FIFO (:304-314)
       int backlog = static_cast<int>(fg.fifo.size() - fg.head);
@@ -369,7 +404,7 @@ struct World {
       fg.fifo.push_back(i);
       tk.stage = kQueued;
       tk.t_q_enter = now;
-      tk.t_ack4_queued = now + p.d_bf[tk.fog] + p.d_ub[tk.user];  // "queued"
+      tk.t_ack4_queued = now + d_fog(tk.fog, now) + d_user(tk.user, now);  // "queued"
     }
   }
 
@@ -384,7 +419,7 @@ struct World {
     touch_energy(f, t_done, p.tx_j * (p.adv_on_completion ? 2.0 : 1.0));
     done.stage = kDone;
     done.t_complete = t_done;
-    done.t_ack6 = t_done + p.d_bf[f] + p.d_ub[done.user];  // "performed"
+    done.t_ack6 = t_done + d_fog(f, t_done) + d_user(done.user, t_done);  // "performed"
     fg.busy_time -= done.svc;  // busyTime -= requiredTime (:232)
     fg.current = -1;
     fg.busy_until = kInf;
@@ -399,7 +434,8 @@ struct World {
       push(fg.busy_until, kEvRelease, f);
     }
     if (p.adv_on_completion)  // advertiseMIPS() at :254
-      push(t_done + p.d_bf[f], kEvAdvArrive, f, fg.mips, fg.busy_time);
+      push(t_done + d_fog(f, t_done), kEvAdvArrive, f, fg.mips,
+           fg.busy_time);
   }
 
   void pool_arrive(int i, double now) {  // ComputeBrokerApp2.cc:258-310
@@ -424,14 +460,14 @@ struct World {
     tk.stage = kDone;
     if (p.app_gen >= 2)  // v1 acks via FognetMsgTaskAck, which the broker
       //                    logs and drops: the client never learns
-      tk.t_ack6 = now + p.d_bf[tk.fog] + p.d_ub[tk.user];
+      tk.t_ack6 = now + d_fog(tk.fog, now) + d_user(tk.user, now);
   }
 
   void local_done(int i, double now) {  // BrokerBaseApp.cc:369-394
     Task& tk = tasks[i];
     if (!p.local_pool_leak) local_pool += tk.mips_req;
     tk.stage = kDone;
-    tk.t_ack6 = now + p.d_ub[tk.user];  // status-6 straight to the client
+    tk.t_ack6 = now + d_user(tk.user, now);  // status-6 straight to the client
   }
 
   void v2_broker_release(int gen, double now) {
@@ -448,7 +484,7 @@ struct World {
         local_pool += tk.mips_req;
         req_open[i] = 0;
         broker_reqs.erase(broker_reqs.begin() + j);
-        double ack = now + p.d_ub[tk.user];
+        double ack = now + d_user(tk.user, now);
         if (ack < tk.t_ack6) tk.t_ack6 = ack;  // duplicate-ack min
         if (tk.stage == kLocalRun) {
           tk.stage = kDone;
@@ -478,7 +514,8 @@ struct World {
         case kEvAdvTimer: {  // v1/v2: re-advertise every 0.01 s; the POOL
           Fog& fg = fogs[ev.a];  // model advertises the remaining pool
           double val = p.fog_model == kPool ? fg.pool : fg.mips;
-          push(ev.t + p.d_bf[ev.a], kEvAdvArrive, ev.a, val, fg.busy_time);
+          push(ev.t + d_fog(ev.a, ev.t), kEvAdvArrive, ev.a, val,
+               fg.busy_time);
           push(ev.t + p.adv_interval, kEvAdvTimer, ev.a);
           break;
         }
@@ -536,6 +573,10 @@ long desim_run_gen(
     double tx_j, double rx_j, double idle_w, double compute_w,
     const double* rand_u,  // (n_tasks) RANDOM unit draws or nullptr
     int v2_local,  // spec.v2_local_broker: v2 hybrid broker semantics
+    // wireless/mobility (r4): per-tick delay table + engine loss replay
+    const double* d2b_tab,  // (tab_steps, tab_stride) or nullptr (static)
+    int tab_steps, int tab_stride, double tab_dt,
+    const unsigned char* task_lost,  // (n_tasks) or nullptr
     // outputs (n_tasks):
     double* o_t_at_broker, int* o_fog, double* o_t_at_fog,
     double* o_t_service_start, double* o_t_complete, double* o_t_ack3,
@@ -549,7 +590,7 @@ long desim_run_gen(
                adv_on_completion, adv_periodic, v1_max_scan,
                local_pool_leak, queue_capacity, broker_mips, required_time,
                adv_interval, tx_j, rx_j, idle_w, compute_w, rand_u,
-               v2_local};
+               v2_local, d2b_tab, tab_steps, tab_stride, tab_dt, task_lost};
   w.fogs.resize(n_fogs);
   w.tasks.resize(n_tasks);
   w.view_mips.assign(n_fogs, 0.0);
@@ -578,9 +619,16 @@ long desim_run_gen(
     w.tasks[i].t_create = task_t_create[i];
     w.tasks[i].mips_req = task_mips_req[i];
     if (std::isfinite(task_t_create[i])) {
-      w.tasks[i].stage = kPubInflight;
-      w.tasks[i].t_at_broker = task_t_create[i] + d_ub[task_user[i]];
-      w.push(w.tasks[i].t_at_broker, kEvPubArrive, i);
+      if (task_lost != nullptr && task_lost[i]) {
+        // wireless uplink loss, replayed from the engine's draw: the
+        // publish was sent (tx energy) but never reaches the broker
+        w.tasks[i].stage = kLost;
+      } else {
+        w.tasks[i].stage = kPubInflight;
+        w.tasks[i].t_at_broker =
+            task_t_create[i] + w.d_user(task_user[i], task_t_create[i]);
+        w.push(w.tasks[i].t_at_broker, kEvPubArrive, i);
+      }
     }
   }
 
