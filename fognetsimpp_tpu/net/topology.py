@@ -51,7 +51,18 @@ class NetParams:
     ap_range: jax.Array  # (A,) f32 metres
     w_base: jax.Array  # () f32 wireless per-hop base delay (s)
     w_prop: jax.Array  # () f32 propagation s/m
-    w_contention: jax.Array  # () f32 extra delay per associated station (s)
+    w_contention: jax.Array  # () f32 single-station MAC airtime anchor (s):
+    #   occupancy-n access delay = w_contention * mac_delay_tab[n] /
+    #   mac_delay_tab[1] (Bianchi shape, calibrated scale) — or the legacy
+    #   linear w_contention * n when mac_delay_tab is empty
+    # --- load-dependent 802.11 DCF model (r4, VERDICT item 3) ----------
+    # Bianchi saturation tables indexed by per-AP station count, built
+    # host-side from the reference's MAC configuration (wireless5.ini:
+    # 56-68: DCF, cwMinData 31, retryLimit 7, 54/6 Mbps): delay rises
+    # superlinearly and loss = p_collision^(retryLimit+1) rises from ~0
+    # as the cell saturates.  Empty (0,) tables = legacy linear model.
+    mac_delay_tab: jax.Array  # (n_max+1,) f32 expected MAC access delay
+    mac_loss_tab: jax.Array  # (n_max+1,) f32 retry-exhaustion loss prob
 
 
 @struct.dataclass
@@ -66,6 +77,9 @@ class LinkCache:
     d2b: jax.Array  # (N,) f32 — delay(node, broker) this tick (+inf when
     #   unreachable).  Every message in the protocol has the base broker at
     #   one end (SURVEY.md §3.2-3.3), so this one vector serves all phases.
+    mac_loss_p: jax.Array  # (N,) f32 — this tick's per-node 802.11 retry-
+    #   exhaustion loss probability from the sender's cell occupancy
+    #   (0 for wired nodes / the legacy linear model)
 
 
 def _delay_between(
@@ -124,6 +138,7 @@ def associate(
             acc_delay=net.node_acc,
             reachable=attach_now >= 0,
             d2b=_delay_to(net, attach_now, net.node_acc, broker),
+            mac_loss_p=jnp.zeros((N,), jnp.float32),
         )
     ap_pos = pos[net.ap_nodes]  # (A, 2)
     ap_ok = alive[net.ap_nodes]  # (A,)
@@ -143,11 +158,27 @@ def associate(
         jnp.where(assoc >= 0, net.ap_attach[jnp.clip(assoc, 0, A - 1)], -1),
         net.node_attach,
     )
+    n_here = n_assoc[jnp.clip(assoc, 0, A - 1)]  # (N,) own-cell occupancy
+    if net.mac_delay_tab.shape[0] > 0:
+        # Bianchi DCF: access delay follows the saturation curve, scale
+        # anchored at n=1 to the calibrated w_contention (the committed
+        # single-station demo trace is numerically unchanged); loss is
+        # the retry-exhaustion probability of the same fixed point
+        tab_n = net.mac_delay_tab.shape[0]
+        n_c = jnp.clip(n_here, 0, tab_n - 1)
+        mac_d = (
+            net.w_contention
+            * net.mac_delay_tab[n_c]
+            / net.mac_delay_tab[1]
+        )
+        mac_loss = net.mac_loss_tab[n_c]
+    else:
+        mac_d = net.w_contention * n_here.astype(jnp.float32)
+        mac_loss = jnp.zeros((N,), jnp.float32)
+    on_air = net.is_wireless & (assoc >= 0)
     acc = jnp.where(
-        net.is_wireless & (assoc >= 0),
-        net.w_base
-        + net.w_prop * ndist
-        + net.w_contention * n_assoc[jnp.clip(assoc, 0, A - 1)].astype(jnp.float32),
+        on_air,
+        net.w_base + net.w_prop * ndist + mac_d,
         net.node_acc,
     )
     acc = acc.astype(jnp.float32)
@@ -158,6 +189,7 @@ def associate(
         acc_delay=acc,
         reachable=attach_now >= 0,
         d2b=_delay_to(net, attach_now, acc, broker),
+        mac_loss_p=jnp.where(on_air, mac_loss, 0.0).astype(jnp.float32),
     )
 
 
@@ -181,6 +213,81 @@ def pair_delay(
 # ----------------------------------------------------------------------
 # Host-side builders (numpy; run once per scenario)
 # ----------------------------------------------------------------------
+
+def bianchi_tables(
+    n_max: int,
+    cw_min: int = 31,  # wireless5.ini:67 cwMinData
+    n_stages: int = 5,  # CWmax 1023 = 31 doubled 5 times (802.11g DCF)
+    retry_limit: int = 7,  # wireless5.ini:66
+    slot_s: float = 9e-6,  # 802.11g ERP slot
+    sifs_s: float = 10e-6,
+    difs_s: float = 28e-6,
+    rate_bps: float = 54e6,  # wireless5.ini:64 mac.bitrate
+    basic_bps: float = 6e6,  # :65 basicBitrate (ACKs)
+    payload_bytes: int = 128,
+    mac_header_bytes: int = 34,
+    phy_preamble_s: float = 20e-6,
+    ack_bytes: int = 14,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Bianchi DCF saturation tables for 0..n_max contending stations.
+
+    Solves the standard two-equation fixed point (tau = transmission
+    probability per slot, p = conditional collision probability) for each
+    station count, then derives
+      * expected per-packet MAC access delay  D(n) = E[backoff slots over
+        the retry ladder] * E[slot length] + T_success, and
+      * retry-exhaustion loss  L(n) = p^(retryLimit+1)
+    — the emergent quantities of INET's Ieee80211Mac that the reference
+    configures at ``wireless5.ini:56-68`` (DCF: EDCA false, cwMinData 31,
+    retryLimit 7, 54 Mbps data / 6 Mbps basic).  Both are monotone in n
+    and saturate the way a real cell does; the engine anchors the SCALE
+    at n=1 to the calibrated ``w_contention`` so single-station worlds
+    (the committed demo trace) are numerically unchanged.
+    """
+    W = cw_min + 1
+    t_s = (
+        phy_preamble_s
+        + (mac_header_bytes + payload_bytes) * 8.0 / rate_bps
+        + sifs_s
+        + phy_preamble_s
+        + ack_bytes * 8.0 / basic_bps
+        + difs_s
+    )
+    t_c = (
+        phy_preamble_s
+        + (mac_header_bytes + payload_bytes) * 8.0 / rate_bps
+        + difs_s
+    )
+    delays = np.zeros((n_max + 1,), np.float64)
+    losses = np.zeros((n_max + 1,), np.float64)
+    for n in range(1, n_max + 1):
+        tau = 2.0 / (W + 1)
+        for _ in range(200):
+            p = 1.0 - (1.0 - tau) ** (n - 1)
+            denom = (1 - 2 * p) * (W + 1) + p * W * (1 - (2 * p) ** n_stages)
+            tau_new = 2 * (1 - 2 * p) / denom if abs(denom) > 1e-12 else 1e-6
+            tau_new = min(max(tau_new, 1e-7), 1.0)
+            prev = tau
+            tau = 0.5 * tau + 0.5 * tau_new  # damped: stable for large n
+            if abs(tau - prev) < 1e-12:
+                break
+        p = 1.0 - (1.0 - tau) ** (n - 1)
+        p_tr = 1.0 - (1.0 - tau) ** n
+        p_s = n * tau * (1.0 - tau) ** (n - 1) / max(p_tr, 1e-12)
+        e_slot = (
+            (1 - p_tr) * slot_s + p_tr * p_s * t_s + p_tr * (1 - p_s) * t_c
+        )
+        # expected backoff slots summed over the retry ladder (stage j's
+        # window doubles up to CWmax), weighted by reaching stage j
+        ex, reach = 0.0, 1.0
+        for j in range(retry_limit + 1):
+            w_j = min(W * 2 ** min(j, n_stages), 1024)
+            ex += reach * (w_j - 1) / 2.0
+            reach *= p
+        delays[n] = ex * e_slot + t_s
+        losses[n] = p ** (retry_limit + 1)
+    delays[0] = delays[1] if n_max >= 1 else 0.0
+    return delays.astype(np.float32), losses.astype(np.float32)
 
 def build_core_delay(
     n_infra: int,
@@ -218,8 +325,15 @@ def make_net_params(
     w_prop: float = 3.336e-9,
     w_contention: float = 1.5e-3,
     node_acc: np.ndarray | None = None,
+    mac_model: str = "bianchi",
 ) -> NetParams:
-    """Assemble a :class:`NetParams` pytree from host-side arrays."""
+    """Assemble a :class:`NetParams` pytree from host-side arrays.
+
+    ``mac_model="bianchi"`` (default, wireless worlds) attaches the DCF
+    saturation tables so access delay AND uplink loss respond to per-AP
+    occupancy; ``"linear"`` keeps the legacy constant-per-station model
+    (e.g. benchmark worlds whose AP density is a deliberate abstraction).
+    """
     A = len(ap_nodes)
     ap_range_arr = (
         np.full((A,), ap_range, np.float32)
@@ -228,6 +342,13 @@ def make_net_params(
     )
     if node_acc is None:
         node_acc = np.zeros((n_nodes,), np.float32)
+    if A > 0 and mac_model == "bianchi":
+        mac_delay, mac_loss = bianchi_tables(n_nodes)
+    elif mac_model in ("bianchi", "linear"):
+        mac_delay = np.zeros((0,), np.float32)
+        mac_loss = np.zeros((0,), np.float32)
+    else:
+        raise ValueError(f"unknown mac_model {mac_model!r}")
     return NetParams(
         core_delay=jnp.asarray(core_delay, jnp.float32),
         node_attach=jnp.asarray(node_attach, jnp.int32),
@@ -239,6 +360,8 @@ def make_net_params(
         w_base=jnp.asarray(w_base, jnp.float32),
         w_prop=jnp.asarray(w_prop, jnp.float32),
         w_contention=jnp.asarray(w_contention, jnp.float32),
+        mac_delay_tab=jnp.asarray(mac_delay),
+        mac_loss_tab=jnp.asarray(mac_loss),
     )
 
 

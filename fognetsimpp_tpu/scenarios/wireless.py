@@ -92,6 +92,7 @@ def assemble(
     w_base: float = 2e-3,
     w_prop: float = 3.336e-9,
     w_contention: float = 1.5e-3,
+    mac_model: str = "bianchi",
     energy_users: bool = False,
     initial_energy_frac: Optional[Tuple[float, float]] = None,
 ):
@@ -137,6 +138,7 @@ def assemble(
         w_prop=w_prop,
         w_contention=w_contention,
         node_acc=node_acc,
+        mac_model=mac_model,
     )
 
     state = init_state(spec, jax.random.PRNGKey(seed))
@@ -371,7 +373,8 @@ def wireless4(numb_users: int = 2, horizon: float = 30.0, dt: float = 1e-3,
 
 def wireless5(numb_users: int = 10, horizon: float = 60.0, dt: float = 0.01,
               seed: int = 0, ap_range: float = 400.0,
-              w_contention: float = 1.5e-3, **overrides):
+              w_contention: float = 1.5e-3, mac_model: str = "bianchi",
+              **overrides):
     """``testing/wireless5.ini`` → WirelessNetwork5: the full-feature world.
 
     Heterogeneous fogs MIPS 1000/2000/3000/4000 (``wireless5.ini:116-119``),
@@ -425,6 +428,7 @@ def wireless5(numb_users: int = 10, horizon: float = 60.0, dt: float = 0.01,
         # thousands of stations)
         ap_range=ap_range,
         w_contention=w_contention,
+        mac_model=mac_model,
         user_pos=user_pos, linear=linear, circle=circle,
         area=(1000.0, 1000.0),
         energy_users=True, initial_energy_frac=(0.10, 1.0),
