@@ -190,7 +190,10 @@ def main(argv=None) -> int:
         run_id = args.run_id or cfg.lookup("output.run_id", "General-0")
         paths = record_run(
             outdir, spec, final, series=series, run_id=run_id,
-            attrs={"argv": sys.argv[1:]},
+            attrs={
+                "argv": sys.argv[1:],
+                "scenario": cfg.lookup("scenario", "smoke"),
+            },
         )
         out.update(paths)
     if args.trails:

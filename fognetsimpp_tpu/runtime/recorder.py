@@ -172,8 +172,14 @@ def record_run(
     series: Optional[Dict] = None,
     run_id: str = "General-0",
     attrs: Optional[Dict] = None,
+    scave: bool = True,
 ) -> Dict[str, str]:
-    """Persist one finished run. Returns {'sca': path, 'vec': path}."""
+    """Persist one finished run. Returns {'sca': path, 'vec': path}.
+
+    ``scave=True`` additionally emits OMNeT++ text-format twins
+    (``<run_id>.sca`` / ``.vec`` + a ``General.anf`` descriptor) readable
+    by the reference's Scave tooling (:mod:`fognetsimpp_tpu.runtime.scave`).
+    """
     os.makedirs(outdir, exist_ok=True)
     sca_path = os.path.join(outdir, f"{run_id}.sca.json")
     vec_path = os.path.join(outdir, f"{run_id}.vec.npz")
@@ -198,7 +204,22 @@ def record_run(
         for k, v in series.items():
             vectors[f"tick.{k}"] = np.asarray(v)
     np.savez_compressed(vec_path, **vectors)
-    return {"sca": sca_path, "vec": vec_path}
+    paths = {"sca": sca_path, "vec": vec_path}
+    if scave:
+        from .scave import NETWORK_NAMES, export_scave
+
+        network = (attrs or {}).get(
+            "network",
+            NETWORK_NAMES.get((attrs or {}).get("scenario", ""), "Network"),
+        )
+        sc = export_scave(
+            outdir, spec, final, series=series, run_id=run_id,
+            attrs=attrs, network=network,
+        )
+        paths.update(
+            {"sca_txt": sc["sca"], "vec_txt": sc["vec"], "anf": sc["anf"]}
+        )
+    return paths
 
 
 def load_scalars(path: str) -> Dict:
