@@ -26,3 +26,24 @@ jax.config.update("jax_platforms", "cpu")
 from fognetsimpp_tpu.compile_cache import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+import pytest  # noqa: E402
+
+# Fast developer-loop tier (VERDICT r3 weak item 7: the full suite is a
+# ~10-minute CI run; `pytest -m quick` is the <60 s edit loop).  Files
+# here compile only small/short worlds; everything else is marked slow.
+_QUICK_FILES = {
+    "test_sched.py",
+    "test_queues.py",
+    "test_engine_smoke.py",
+    "test_compaction.py",
+    "test_pallas.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = item.fspath.basename
+        item.add_marker(
+            pytest.mark.quick if name in _QUICK_FILES else pytest.mark.slow
+        )
