@@ -151,11 +151,12 @@ def test_example_per_fog_traffic_split():
     the pool and the overflow offloads via the last-wins MAX_MIPS scan —
     with every fog advertising equal MIPS the winner is the FIRST
     registered fog.  Same calibration constants as the delay test (no
-    per-test refit).  Residual deviation (documented in PARITY.md): our
-    leak dynamics free more overflow than the committed run's 4 tasks,
-    and late overflow diverts to the LAST fog once CB1's reduced pool
-    advert lands — the same scan mechanism, so the middle fogs stay at
-    exactly zero either way.
+    per-test refit).  The committed run's exact count (4) is one draw of
+    the reference's wall-clock-seeded MIPS stream — see
+    test_example_offload_count_within_reference_mechanism for the
+    distributional gate; late overflow diverts to the LAST fog once
+    CB1's reduced pool advert lands — the same scan mechanism, so the
+    middle fogs stay at exactly zero either way.
     """
     spec, state, net, bounds = example.build()
     final, _ = run(spec, state, net, bounds)
@@ -174,3 +175,67 @@ def test_example_per_fog_traffic_split():
     received = 1 + per_fog_tasks
     assert received[0] > received[1]
     assert (received[1:4] == 1).all()
+
+
+# The committed demo run's 52 broker-arrival times (delay:vector 1093 of
+# simulations/example/results/General-0.vec): the 7-packet warm-up burst
+# (gaps 4-10 ms, two interleaved creation streams), a 50 ms backlog
+# trickle, then steady 50 ms arrivals.
+_COMMITTED_ARRIVALS = [
+    1.0414, 1.0455, 1.0519, 1.0555, 1.0616, 1.0655, 1.0755, 1.1115,
+    1.1617, 1.2116, 1.2617, 1.3114, 1.3614, 1.4116, 1.4616, 1.5115,
+    1.5616, 1.6117, 1.6615, 1.7117, 1.7617, 1.8117, 1.8617, 1.9117,
+    1.9614, 2.0114, 2.0615, 2.1115, 2.1615, 2.2116, 2.2615, 2.3116,
+    2.3616, 2.4114, 2.4618, 2.5116, 2.5616, 2.6115, 2.6615, 2.7117,
+    2.7617, 2.8114, 2.8617, 2.9118, 2.9615, 3.0115, 3.0617, 3.1115,
+    3.1615, 3.2115, 3.2614, 3.3114,
+]
+
+
+def _reference_v2_offload_distribution(n_seeds=200, rt=0.01, pool0=1000.0):
+    """The reference v2 broker mechanism replayed on the COMMITTED arrival
+    times with random MIPSRequired draws (the reference used wall-clock
+    ``srand``, so its exact stream is unobservable): shared release timer,
+    cancel-on-accept, one insertion-order release per firing, offload
+    stores without debit (BrokerBaseApp2.cc:181-312)."""
+    import numpy as np
+
+    offs = []
+    for seed in range(n_seeds):
+        rng = np.random.default_rng(seed)
+        m = rng.integers(200, 901, len(_COMMITTED_ARRIVALS)).astype(float)
+        pool, timer, reqs, n_off = pool0, None, [], 0
+        for i, t in enumerate(_COMMITTED_ARRIVALS):
+            if timer is not None and timer <= t:
+                ft, timer = timer, None
+                for r in reqs:
+                    if r[2] and r[0] + rt < ft:
+                        pool += r[1]
+                        r[2] = False
+                        break
+            reqs.append([t, m[i], True])  # stored on BOTH branches
+            if m[i] < pool:
+                pool -= m[i]
+                timer = t + rt  # cancelEvent + scheduleAt
+            else:
+                n_off += 1
+        offs.append(n_off)
+    return np.asarray(offs)
+
+
+def test_example_offload_count_within_reference_mechanism():
+    """The committed run's "ComputeBroker1 received 4 tasks" is ONE draw
+    of the reference's wall-clock-seeded MIPS stream.  Replaying the v2
+    mechanism on the committed arrival times across 200 seeds gives the
+    distribution that rand() could have produced (min 4 — the committed
+    run sits at its lucky edge — median ~12, p95 ~45); the engine's own
+    offload count must fall inside it, or the leak dynamics are wrong.
+    """
+    dist = _reference_v2_offload_distribution()
+    assert dist.min() == 4  # the committed run is the distribution's edge
+    spec, state, net, bounds = example.build()
+    final, _ = run(spec, state, net, bounds)
+    fog = np.asarray(final.tasks.fog)
+    n_off = int((fog >= 0).sum())
+    lo, hi = int(dist.min()), int(np.percentile(dist, 95))
+    assert lo <= n_off <= hi, (n_off, lo, hi, np.median(dist))
