@@ -5,18 +5,42 @@ the persistent cache brings warm-process compiles down to tracing cost
 (measured 49.5 s -> 18.3 s across processes on the v5e for a 2k-user
 world).  Enabled by the CLI, bench entry points, and the test harness;
 set ``FNS_JIT_CACHE`` to relocate or ``FNS_JIT_CACHE=off`` to disable.
+
+The cache directory is keyed by the host CPU model: XLA:CPU stores AOT
+results compiled for the build host's exact feature set, and loading
+them on a host without those features is a documented SIGILL risk (it
+intermittently segfaulted the test suite when the cache travelled
+between heterogeneous machines, r4).
 """
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 from typing import Optional
+
+
+def _host_tag() -> str:
+    """Short stable tag for this host's CPU capability set."""
+    bits = [platform.machine(), platform.processor()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.startswith("flags") or ln.startswith("Features"):
+                    bits.append(ln.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
 
 
 def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
     env = os.environ.get("FNS_JIT_CACHE")
     if env is not None and env.strip().lower() in ("off", "0", "false", ""):
         return None
-    path = path or env or os.path.expanduser("~/.cache/fognetsimpp_tpu/jit")
+    path = path or env or os.path.expanduser(
+        f"~/.cache/fognetsimpp_tpu/jit-{_host_tag()}"
+    )
     try:
         os.makedirs(path, exist_ok=True)
         import jax
