@@ -42,9 +42,16 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
         f"~/.cache/fognetsimpp_tpu/jit-{_host_tag()}"
     )
     try:
-        os.makedirs(path, exist_ok=True)
         import jax
 
+        if jax.default_backend() == "cpu":
+            # Serializing certain XLA:CPU executables segfaults inside
+            # jaxlib's compilation-cache write path (reproduced r4 with
+            # faulthandler on the policy-grid program); accelerator
+            # executables are unaffected.  The cache's payoff is on the
+            # accelerator anyway — skip it on CPU.
+            return None
+        os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except OSError:

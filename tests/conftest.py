@@ -23,9 +23,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-from fognetsimpp_tpu.compile_cache import enable_compile_cache  # noqa: E402
-
-enable_compile_cache()
+# NO persistent JIT cache in the suite: serializing one of the CPU
+# executables segfaults inside jaxlib's
+# compilation_cache.put_executable_and_time (reproduced r4 with
+# faulthandler: the crash is in the cache-WRITE path, before the
+# min-compile-time gate, so only leaving the cache disabled is safe —
+# the simulation itself is unaffected).  The env kill-switch reaches
+# every in-process enable_compile_cache call too (the sweep-CLI test
+# invokes __main__ in-process, which would otherwise re-enable it).
+os.environ["FNS_JIT_CACHE"] = "off"
 
 import pytest  # noqa: E402
 
@@ -47,3 +53,15 @@ def pytest_collection_modifyitems(config, items):
         item.add_marker(
             pytest.mark.quick if name in _QUICK_FILES else pytest.mark.slow
         )
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    # XLA:CPU intermittently segfaults in backend_compile after ~100
+    # compiled programs accumulate in one process (reproduced r4 with
+    # faulthandler; the same program compiles cleanly solo).  Dropping
+    # compiled executables between modules keeps the live-program count
+    # bounded; module-internal caching (fixtures reusing worlds) is
+    # unaffected.
+    yield
+    jax.clear_caches()
