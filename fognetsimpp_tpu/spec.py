@@ -231,9 +231,13 @@ class WorldSpec:
     # so their release refunds pool MIPS that was never debited and sends
     # a duplicate status-6.  Requires policy == LOCAL_FIRST.
     v2_local_broker: bool = False
-    # POOL fog model: how many arrival ranks are pool-checked per tick (the
-    # sequential accept/reject chain is exact up to this depth; deeper
-    # arrivals wait a tick).  See _phase_pool_arrivals.
+    # POOL fog model: how many arrival ranks are pool-checked per pass
+    # (the sequential accept/reject chain is exact up to this depth;
+    # deeper arrivals re-rank next pass, keeping their exact arrival
+    # times — tests/test_v1v2.py::test_pool_same_tick_depth_beyond_
+    # phases_is_benign).  With adv_periodic the advert-boundary
+    # sub-phasing runs TWO passes per tick, so the per-tick depth is
+    # effectively 2x this.  See _phase_pool_arrivals.
     pool_phases: int = 4
 
     # --- wireless uplink loss ------------------------------------------

@@ -312,3 +312,76 @@ def test_v2_fire_refund_visible_to_later_accept():
         float(out.broker.release_timer_t), 0.008 + spec.required_time,
         rtol=1e-6,
     )
+
+
+def test_pool_same_tick_depth_beyond_phases_is_benign():
+    """VERDICT r3 weak item 6: `pool_phases=4` bounds how many same-tick
+    arrival ranks a POOL fog checks per tick; deeper arrivals defer one
+    tick.  Benign means: they keep their exact arrival times (service
+    start = t_at_fog, not the deferring tick's boundary), nothing is
+    lost, and with sufficient pool every arrival is accepted."""
+    import jax.numpy as jnp
+
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.net.mobility import default_bounds
+    from fognetsimpp_tpu.net.topology import wired_star
+    from fognetsimpp_tpu.spec import FogModel, WorldSpec
+    from fognetsimpp_tpu.state import init_state
+
+    n = 7  # > pool_phases: ranks 4..6 defer a tick
+    spec = WorldSpec(
+        n_users=n,
+        n_fogs=1,
+        dt=1e-3,
+        horizon=0.01,
+        app_gen=2,
+        fog_model=int(FogModel.POOL),
+        # periodic adverts add a second advert-boundary pool pass per
+        # tick (effective depth 2 x pool_phases); disable them so this
+        # test pins the single-pass deferral mechanics
+        adv_periodic=False,
+        adv_on_completion=False,
+        connect_gating=False,
+        max_sends_per_user=1,
+        pool_phases=4,
+    ).validate()
+    state = init_state(spec)
+    state = state.replace(
+        users=state.users.replace(publisher=jnp.zeros((n,), bool)),
+        fogs=state.fogs.replace(
+            mips=jnp.full((1,), 1e5, jnp.float32),
+            pool_avail=jnp.full((1,), 1e5, jnp.float32),
+        ),
+    )
+    tasks = state.tasks
+    t_arr = 1e-4 + jnp.arange(n, dtype=jnp.float32) * 1e-6  # one tick
+    tasks = tasks.replace(
+        stage=jnp.full((n,), jnp.int8(int(Stage.TASK_INFLIGHT))),
+        fog=jnp.zeros((n,), jnp.int32),
+        mips_req=jnp.full((n,), 500.0, jnp.float32),
+        t_create=t_arr,
+        t_at_broker=t_arr,
+        t_at_fog=t_arr,
+    )
+    state = state.replace(tasks=tasks)
+    net = wired_star(spec.n_nodes, packet_bytes=spec.task_bytes)
+    step = make_step(spec)
+
+    s1 = step(state, net, default_bounds(1000.0))
+    st1 = np.asarray(s1.tasks.stage)
+    # exactly pool_phases ranks decided in the arrival tick
+    assert (st1 == int(Stage.RUNNING)).sum() == spec.pool_phases
+    assert (st1 == int(Stage.TASK_INFLIGHT)).sum() == n - spec.pool_phases
+
+    s2 = step(s1, net, default_bounds(1000.0))
+    st2 = np.asarray(s2.tasks.stage)
+    assert (st2 == int(Stage.RUNNING)).sum() == n  # depth drained next tick
+    # deferred arrivals kept their EXACT event times: service start is the
+    # original t_at_fog, so the deferral costs no simulated time at all
+    np.testing.assert_allclose(
+        np.asarray(s2.tasks.t_service_start), np.asarray(t_arr), atol=1e-7
+    )
+    # pool accounting saw every arrival exactly once
+    np.testing.assert_allclose(
+        float(s2.fogs.pool_avail[0]), 1e5 - n * 500.0, rtol=1e-6
+    )
