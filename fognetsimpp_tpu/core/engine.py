@@ -99,6 +99,11 @@ def _svc_time(spec: WorldSpec, mips_req: jax.Array, fog_mips: jax.Array) -> jax.
     return mips_req / jnp.maximum(fog_mips, 1e-9)
 
 
+def _compact_lane_width(T: int) -> int:
+    """Power-of-two in-block lane width minimising B + C (B = T/C)."""
+    return min((128, 256, 512, 1024), key=lambda c: -(-T // c) + c)
+
+
 def _compact(
     mask: jax.Array, K: int, T: int, rot: Optional[jax.Array] = None
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -127,8 +132,14 @@ def _compact(
     binary searches lower to sequential while-loops whose per-iteration
     overhead (~30 us) dominates; the (K,B) / (K,C) one-shot comparisons
     here are single fused kernels instead.
+
+    Block size: the dominant intermediates are (K, B) and (K, C) with
+    B = T/C, minimised at C ~ sqrt(T) (r5; the fixed C=1024 of r1-r4
+    streamed a 19 MB (K, C) gather at the bench shape where sqrt(T)=663
+    would stream 12 MB).  Rounded to a power of two in [128, 1024] so
+    the lane dimension stays tiled.
     """
-    C = 1024
+    C = _compact_lane_width(T)
     B = -(-T // C)
     m2 = jnp.zeros((B * C,), jnp.int32).at[:T].set(mask.astype(jnp.int32))
     wcs = jnp.cumsum(m2.reshape(B, C), axis=1)  # (B, C) within-block prefix
@@ -366,7 +377,22 @@ def _phase_spawn(
         else:
             pos = k * jnp.float32(spec.link_drain_s)
         drained = spec.link_up_s + pos
-        t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
+        if spec.link_buffer_frames > 0:
+            # mechanistic pre-link-up buffer (see spec.link_buffer_frames):
+            # creations while the link is down either sit in the bounded
+            # pending queue (send index < capacity -> drain schedule) or
+            # overflow deterministically; post-link-up sends go direct
+            pre = t_create < spec.link_up_s
+            buffered = pre & (users.send_count < spec.link_buffer_frames)
+            t_arrive = jnp.where(buffered, drained, t_arrive)
+            warm_lost = pre & ~buffered
+        else:
+            t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
+            buffered = None
+            warm_lost = None
+    else:
+        buffered = None
+        warm_lost = None
     # wireless uplink loss (MAC retry exhaustion): the publish is sent and
     # costs tx energy, but never reaches the broker.  Two components,
     # independently combined: the calibrated residual probability
@@ -386,7 +412,13 @@ def _phase_spawn(
             (jax.random.uniform(k_loss, (U,)) < p_eff)
             & net.is_wireless[:U]
         )
-        if spec.link_up_s > 0:
+        if buffered is not None:
+            # mechanistic buffer: frames the pending queue kept deliver
+            # reliably at their drain slot (code-review r5 fix: the
+            # legacy arrival-time gate left late-created buffered frames
+            # in the random-loss draw)
+            lost = lost & ~buffered
+        elif spec.link_up_s > 0:
             lost = lost & (t_create + d_ub >= spec.link_up_s)
     if spec.wired_queue_enabled:
         # DropTail: a publish entering a full egress queue (its own link
@@ -397,6 +429,8 @@ def _phase_spawn(
         p_b = state.nodes.link_drop_p[spec.broker_index]
         p_eff = 1.0 - (1.0 - p_u) * (1.0 - p_b)
         lost = lost | (jax.random.uniform(k_dtail, (U,)) < p_eff)
+    if warm_lost is not None:
+        lost = lost | (warm_lost & net.is_wireless[:U])
     stage_new = jnp.where(
         lost, jnp.int8(int(Stage.LOST)), jnp.int8(int(Stage.PUB_INFLIGHT))
     )
@@ -520,7 +554,19 @@ def _phase_spawn_multi(
         else:
             pos = kf * jnp.float32(spec.link_drain_s)
         drained = spec.link_up_s + pos
-        t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
+        if spec.link_buffer_frames > 0:
+            # mechanistic pre-link-up buffer (see _phase_spawn)
+            pre2 = fire < spec.link_up_s
+            buffered2 = pre2 & (k < spec.link_buffer_frames)
+            t_arrive = jnp.where(buffered2, drained, t_arrive)
+            warm_lost2 = pre2 & ~buffered2
+        else:
+            t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
+            buffered2 = None
+            warm_lost2 = None
+    else:
+        buffered2 = None
+        warm_lost2 = None
     lost2 = jnp.zeros((U, S), bool)
     has_mac = net.mac_loss_tab.shape[0] > 0
     if spec.uplink_loss_prob > 0 or has_mac:
@@ -531,7 +577,9 @@ def _phase_spawn_multi(
             p_eff = 1.0 - (1.0 - p_eff) * (1.0 - cache.mac_loss_p[:U])
         draws_l = jax.random.uniform(k_loss, (U, R)) < p_eff[:, None]
         lost2 = lane_select(draws_l, False) & net.is_wireless[:U, None]
-        if spec.link_up_s > 0:
+        if buffered2 is not None:
+            lost2 = lost2 & ~buffered2  # buffered frames deliver reliably
+        elif spec.link_up_s > 0:
             lost2 = lost2 & (fire + d_ub[:, None] >= spec.link_up_s)
     if spec.wired_queue_enabled:
         p_u = state.nodes.link_drop_p[:U]
@@ -539,6 +587,8 @@ def _phase_spawn_multi(
         p_eff = 1.0 - (1.0 - p_u) * (1.0 - p_b)
         draws_d = jax.random.uniform(k_dtail, (U, R))
         lost2 = lost2 | (lane_select(draws_d, 1.0) < p_eff[:, None])
+    if warm_lost2 is not None:
+        lost2 = lost2 | (warm_lost2 & net.is_wireless[:U, None])
 
     st2 = tasks.stage.reshape(U, S)
     stage_new = jnp.where(
@@ -1210,7 +1260,24 @@ def _phase_fog_arrivals(
     an idle fog takes the earliest arrival (status-5 "assigned" ack → the
     client's latency signal); the rest enter the FIFO in arrival order
     (status-4 "queued" ack → a second latencyH1 sample at the client).
+
+    Two front-ends produce the compacted arrival window (r5 perf):
+    ``spec.two_stage_arrivals`` selects the per-user candidate reduction
+    over the (U, S) task-table view (:func:`_fog_arrivals_front_two_stage`)
+    instead of the classic full-table compaction — same decisions, ~20x
+    fewer bytes at bench shapes; the shared tail does the assignment,
+    queueing and ack bookkeeping either way.
     """
+    if spec.two_stage_arrivals:
+        return _fog_arrivals_front_two_stage(spec, state, net, cache, buf, t1)
+    return _fog_arrivals_front_full(spec, state, net, cache, buf, t1)
+
+
+def _fog_arrivals_front_full(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Classic front-end: full-table mask, dense fast drop, T-compaction."""
     tasks, fogs = state.tasks, state.fogs
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
     U = spec.n_users
@@ -1284,10 +1351,157 @@ def _phase_fog_arrivals(
     rot, state = _rot_and_defer(spec, state, arr_full, K)
     idx, idxc, valid = _compact(arr_full, K, T, rot)
     fog_g = tasks.fog[idxc]  # (K,)
-    fog_gc = jnp.clip(fog_g, 0, F - 1)
     t_af_g = tasks.t_at_fog[idxc]
     mips_g = tasks.mips_req[idxc]
     user_g = idxc // spec.max_sends_per_user
+    return _fog_arrivals_tail(
+        spec, state, cache, buf, tasks, fogs,
+        idx, idxc, valid, fog_g, t_af_g, mips_g, user_g, n_fast, n_fast_f,
+    )
+
+
+def _fog_arrivals_front_two_stage(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Per-user candidate front-end (r5).
+
+    At ``dt <= send_interval`` at most one task per user matures at its
+    fog per tick (more under coarse windows — bounded by
+    ``spec.max_sends_per_tick`` — or after deferral transients), so the
+    full-table compaction is overkill: reduce the ``(U, S)`` view to the
+    ``R`` earliest matured slots per user (masked argmin passes — pure
+    elementwise/reduce work, no T-sized gathers), then compact the
+    ``(U*R,)`` candidate list into the K-window.  Matured tasks beyond
+    the per-user cap defer exactly like window overflow (counted in
+    ``Metrics.n_deferred``, decided next tick; same benign-deferral
+    contract as the K-window, tests/test_compaction.py A/Bs the paths).
+
+    The saturated-fog fast drop happens on the candidate list: per-fog
+    tail-drop sums become one (F, U*R) membership GEMM instead of the
+    classic front-end's (F, T) matmuls — the r4 bandwidth hot spot
+    (857 MB/tick -> the (F,T) passes alone were ~200 MB of it).
+    """
+    tasks, fogs = state.tasks, state.fogs
+    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    U, S = spec.n_users, spec.max_sends_per_user
+    R = min(spec.arrival_cands, S)
+    i32 = jnp.int32
+    f32 = jnp.float32
+    fog_alive = state.nodes.alive[U : U + F]
+
+    st2 = tasks.stage.reshape(U, S)
+    taf2 = tasks.t_at_fog.reshape(U, S)
+    fog2 = tasks.fog.reshape(U, S)
+    mip2 = tasks.mips_req.reshape(U, S)
+    kk = jnp.arange(S, dtype=i32)[None, :]
+
+    m = (st2 == jnp.int8(int(Stage.TASK_INFLIGHT))) & (taf2 <= t1)
+    # R earliest matured slots per user; argmin returns the FIRST min, so
+    # time ties break by slot id exactly like the classic selection
+    cks, cts, cfs, cms, cvs = [], [], [], [], []
+    for _ in range(R):
+        key = jnp.where(m, taf2, jnp.inf)
+        ck = jnp.argmin(key, axis=1).astype(i32)  # (U,)
+        ct = jnp.min(key, axis=1)
+        cv = jnp.isfinite(ct)
+        sel = m & (kk == ck[:, None])
+        cf = jnp.sum(jnp.where(sel, fog2, 0), axis=1)  # one-hot: exact
+        cm = jnp.sum(jnp.where(sel, mip2, 0.0), axis=1)
+        cks.append(ck); cts.append(ct); cfs.append(cf)
+        cms.append(cm); cvs.append(cv)
+        m = m & ~sel
+    n_left = jnp.sum(m, dtype=i32)  # matured beyond the per-user cap
+
+    UR = U * R
+    cand_k = jnp.stack(cks, axis=1).reshape(UR)  # (UR,) slot index in [0,S)
+    cand_t = jnp.stack(cts, axis=1).reshape(UR)
+    cand_f = jnp.stack(cfs, axis=1).reshape(UR)
+    cand_m = jnp.stack(cms, axis=1).reshape(UR)
+    cand_v = jnp.stack(cvs, axis=1).reshape(UR)
+    cand_u = jnp.repeat(jnp.arange(U, dtype=i32), R)
+    cand_slot = cand_u * S + cand_k  # global task id per candidate
+
+    # ---- saturated-fog fast drop on the candidate list ----------------
+    n_fast = jnp.zeros((), i32)
+    n_fast_f = jnp.zeros((F,), i32)
+    if F > 0:
+        droppy = (  # (F,) fog can only tail-drop a live arrival
+            (fogs.q_len >= spec.queue_capacity)
+            & (fogs.current_task != NO_TASK)
+            & fog_alive  # so droppy already implies a live destination
+        )
+        # (F, UR) membership GEMV: droppy per candidate without a
+        # serialized (UR,) gather (vmap-collapse-safe, r4)
+        memb = (
+            cand_f[None, :] == jnp.arange(F, dtype=i32)[:, None]
+        ) & cand_v[None, :]  # (F, UR)
+        memb_f = memb.astype(f32)
+        droppy_c = droppy.astype(f32) @ memb_f > 0.5  # (UR,)
+        fast_drop = cand_v & droppy_c
+        # per-fog tail-drop count + busyTime add: one (F, UR) @ (UR, 2)
+        rhs = jnp.stack(
+            [
+                fast_drop.astype(f32),
+                jnp.where(fast_drop, cand_m, 0.0),
+            ],
+            axis=1,
+        )  # (UR, 2)
+        sums = memb_f @ rhs  # (F, 2) f32 exact (counts < 2^24)
+        n_fast_f = sums[:, 0].astype(i32)
+        svc_fast_f = sums[:, 1] / jnp.maximum(fogs.mips, 1e-9)
+        fogs = fogs.replace(
+            busy_time=fogs.busy_time + svc_fast_f,
+            q_drops=fogs.q_drops + n_fast_f,
+        )
+        n_fast = jnp.sum(n_fast_f)
+        # stage -> DROPPED densely over the (U, S) view (no T-scatter)
+        fast2 = fast_drop.reshape(U, R)
+        sel_fast = jnp.zeros((U, S), bool)
+        for r in range(R):
+            sel_fast = sel_fast | (
+                (kk == cks[r][:, None]) & fast2[:, r : r + 1]
+            )
+        tasks = tasks.replace(
+            stage=jnp.where(
+                sel_fast, jnp.int8(int(Stage.DROPPED)), st2
+            ).reshape(T)
+        )
+        cand_v = cand_v & ~fast_drop
+
+    # ---- K-window compaction over the candidate list ------------------
+    state = state.replace(
+        metrics=state.metrics.replace(
+            n_deferred=state.metrics.n_deferred + n_left
+        )
+    )
+    rot, state = _rot_and_defer(spec, state, cand_v, K)
+    idx_c, idxc_c, valid = _compact(cand_v, K, UR, rot)
+    fog_g = cand_f[idxc_c]
+    t_af_g = cand_t[idxc_c]
+    mips_g = cand_m[idxc_c]
+    user_g = cand_u[idxc_c]
+    slot_g = cand_slot[idxc_c]
+    idx = jnp.where(valid, slot_g, T)  # T-space scatter targets
+    idxc = jnp.minimum(idx, T - 1)
+    return _fog_arrivals_tail(
+        spec, state, cache, buf, tasks, fogs,
+        idx, idxc, valid, fog_g, t_af_g, mips_g, user_g, n_fast, n_fast_f,
+    )
+
+
+def _fog_arrivals_tail(
+    spec: WorldSpec, state: WorldState, cache: LinkCache, buf: TickBuf,
+    tasks, fogs, idx: jax.Array, idxc: jax.Array, valid: jax.Array,
+    fog_g: jax.Array, t_af_g: jax.Array, mips_g: jax.Array,
+    user_g: jax.Array, n_fast: jax.Array, n_fast_f: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Shared assignment/queueing tail over the compacted K-window."""
+    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    U = spec.n_users
+    i32 = jnp.int32
+    fog_alive = state.nodes.alive[U : U + F]
+    fog_gc = jnp.clip(fog_g, 0, F - 1)
 
     dead_dst = valid & ~fog_alive[fog_gc]  # packets to a dead node are lost
     arr = valid & ~dead_dst
@@ -1727,9 +1941,36 @@ def make_step(
             pos, vel = step_mobility(state.nodes, bounds, t1, spec.dt)
             nodes = state.nodes.replace(pos=pos, vel=vel)
             state = state.replace(nodes=nodes)
+            # Bianchi worlds key MAC contention on each cell's OFFERED
+            # LOAD (DCF contends among stations with queued frames, not
+            # associated-but-idle ones — VERDICT r4 item 2): per-node
+            # frame rate while the user is actively publishing, solved
+            # to an effective contender count inside associate()
+            offered = None
+            if net.mac_loss_tab.shape[0] > 0:
+                u = state.users
+                publishing = (
+                    state.nodes.alive[: spec.n_users]
+                    & u.connected
+                    & u.publisher
+                    & (u.send_count < spec.max_sends_per_user)
+                    & jnp.isfinite(u.next_send)
+                )
+                if spec.send_stop_time != float("inf"):
+                    publishing = publishing & (t0 < spec.send_stop_time)
+                offered = jnp.concatenate(
+                    [
+                        jnp.where(
+                            publishing, 1.0 / u.send_interval, 0.0
+                        ).astype(jnp.float32),
+                        jnp.zeros(
+                            (spec.n_nodes - spec.n_users,), jnp.float32
+                        ),
+                    ]
+                )
             cache = associate(
                 net, state.nodes.pos, state.nodes.alive,
-                broker=spec.broker_index,
+                broker=spec.broker_index, offered_rate=offered,
             )
         if spec.wired_queue_enabled:
             # DropTailQueue backpressure (wireless5.ini:72-73): last
@@ -1927,6 +2168,13 @@ def run(
     step = make_step(spec, with_aux=record)
     static_cache = None
     if spec.assume_static:
+        if net.mac_loss_tab.shape[0] > 0:
+            raise ValueError(
+                "assume_static cannot hoist a Bianchi-keyed association: "
+                "MAC contention is keyed on per-tick offered load (r5). "
+                "Disable assume_static for this world, or build the net "
+                "with mac_model='linear'."
+            )
         # one association for the whole run (spec promise: constant
         # positions + liveness); the scan then runs zero mobility kernels
         static_cache = associate(

@@ -211,6 +211,15 @@ def delay_table(spec, state0, net, bounds=None, n_ticks=None) -> np.ndarray:
     with), without any protocol phases: the network model is deterministic
     data, so the sequential baseline can consume it while still executing
     every EVENT independently.  Returns float64 ``(n_ticks, n_nodes)``.
+
+    Bianchi worlds (r5): MAC contention is keyed on each cell's offered
+    load (``associate(..., offered_rate=)``), so the table scan threads
+    the self-timed send chain — connect handshake then ``next_send +=
+    interval`` — exactly as the engine's connect/spawn phases advance
+    it.  The chain depends only on the table rows already computed
+    (connack = 2x that tick's own d2b), never on scheduling decisions,
+    so the network stays pure data.  Requires jitter == 0 for such
+    worlds (the engine's jitter stream is PRNG-keyed per tick).
     """
     import jax
     import jax.numpy as jnp
@@ -221,22 +230,99 @@ def delay_table(spec, state0, net, bounds=None, n_ticks=None) -> np.ndarray:
     if bounds is None:
         bounds = default_bounds()
     n = spec.n_ticks if n_ticks is None else n_ticks
+    U, S = spec.n_users, spec.max_sends_per_user
+    keyed = int(np.asarray(net.mac_loss_tab).shape[0]) > 0
+    if keyed and spec.send_interval_jitter > 0:
+        raise NotImplementedError(
+            "activity-keyed MAC + send_interval_jitter has no "
+            "independent delay table (the jitter stream is engine-PRNG)"
+        )
+    rest = spec.n_nodes - U
 
     def body(carry, tick):
-        nodes = carry
+        nodes, users = carry
+        t0 = tick.astype(jnp.float32) * spec.dt
         t1 = (tick + 1).astype(jnp.float32) * spec.dt
         pos, vel = step_mobility(nodes, bounds, t1, spec.dt)
         nodes = nodes.replace(pos=pos, vel=vel)
+        offered = None
+        if keyed:
+            # mirror the engine's offered-rate vector exactly
+            publishing = (
+                nodes.alive[:U]
+                & users.connected
+                & users.publisher
+                & (users.send_count < S)
+                & jnp.isfinite(users.next_send)
+            )
+            if spec.send_stop_time != float("inf"):
+                publishing = publishing & (t0 < spec.send_stop_time)
+            offered = jnp.concatenate(
+                [
+                    jnp.where(
+                        publishing, 1.0 / users.send_interval, 0.0
+                    ).astype(jnp.float32),
+                    jnp.zeros((rest,), jnp.float32),
+                ]
+            )
         cache = associate(
-            net, nodes.pos, nodes.alive, broker=spec.broker_index
+            net, nodes.pos, nodes.alive, broker=spec.broker_index,
+            offered_rate=offered,
         )
-        return nodes, cache.d2b
+        # mirror _phase_connect's stamps (engine.py) on the users carry
+        alive_u = nodes.alive[:U]
+        if spec.connect_gating:
+            pending = (
+                alive_u
+                & ~users.connected
+                & jnp.isinf(users.connack_at)
+                & (users.start_t < t1)
+            )
+            connack_at = jnp.where(
+                pending,
+                jnp.maximum(users.start_t, t0) + 2.0 * cache.d2b[:U],
+                users.connack_at,
+            )
+            acked = ~users.connected & (connack_at <= t1)
+            users = users.replace(
+                connected=users.connected | acked,
+                connack_at=connack_at,
+                next_send=jnp.where(acked, connack_at, users.next_send),
+            )
+        # mirror the spawn phase's self-timed send chain (fire times only)
+        base = jnp.maximum(users.next_send, t0)
+        can = alive_u & users.connected & users.publisher
+        n_fire = jnp.clip(
+            jnp.ceil((t1 - base) / users.send_interval).astype(jnp.int32),
+            0,
+            spec.max_sends_per_tick,
+        )
+        if spec.send_stop_time != float("inf"):
+            # fires at/past stopTime never happen (mqttApp2.cc:191-210)
+            room = jnp.ceil(
+                (spec.send_stop_time - base) / users.send_interval
+            ).astype(jnp.int32)
+            n_fire = jnp.minimum(n_fire, jnp.maximum(room, 0))
+        n_fire = jnp.where(
+            can & (base < t1),
+            jnp.minimum(n_fire, S - users.send_count),
+            0,
+        )
+        users = users.replace(
+            next_send=jnp.where(
+                n_fire > 0,
+                base + n_fire.astype(jnp.float32) * users.send_interval,
+                users.next_send,
+            ),
+            send_count=users.send_count + n_fire,
+        )
+        return (nodes, users), cache.d2b
 
     _, d2b = jax.jit(
-        lambda s: jax.lax.scan(
-            body, s, jnp.arange(n, dtype=jnp.int32)
+        lambda s, u: jax.lax.scan(
+            body, (s, u), jnp.arange(n, dtype=jnp.int32)
         )
-    )(state0.nodes)
+    )(state0.nodes, state0.users)
     return np.asarray(d2b, np.float64)
 
 

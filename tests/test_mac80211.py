@@ -1,18 +1,24 @@
-"""Load-dependent 802.11 DCF model (r4, VERDICT item 3).
+"""Load-dependent 802.11 DCF model (r4 VERDICT item 3; reworked r5 per
+VERDICT r4 item 2).
 
-The r3 model was a constant per-station delay coefficient and a FIXED
-Bernoulli uplink loss — delay did not saturate and loss did not respond
-to load.  Now `net.topology.bianchi_tables` solves the DCF fixed point
-for the reference's MAC configuration (``wireless5.ini:56-68``: EDCA off,
-cwMinData 31, retryLimit 7, 54/6 Mbps) and `associate` maps per-AP
-occupancy through it: delay follows the saturation curve (anchored at
-n=1 to the calibrated scale) and loss is the retry-exhaustion
-probability of the same fixed point.
+`net.topology.bianchi_tables` solves the DCF fixed point for the
+reference's MAC configuration (``wireless5.ini:56-68``: EDCA off,
+cwMinData 31, retryLimit 7, 54/6 Mbps).  r4 keyed the table on
+*associated* stations — 60 idle stations got full saturation delay; INET
+contends only among stations with queued frames.  r5 keys it on each
+cell's OFFERED LOAD via the Little's-law fixed point
+``n_eff = clip(lambda * D(n_eff), 1, occupancy)`` (associate's
+``offered_rate``): idle cells sit at the n=1 baseline, overloaded cells
+climb to the saturation ceiling.
 """
 import numpy as np
 
 from fognetsimpp_tpu import Stage, run
-from fognetsimpp_tpu.net.topology import associate, bianchi_tables
+from fognetsimpp_tpu.net.topology import (
+    associate,
+    bianchi_fixed_point,
+    bianchi_tables,
+)
 from fognetsimpp_tpu.scenarios import wireless
 
 
@@ -26,31 +32,103 @@ def test_tables_monotone_and_anchored():
     assert (d[100] - d[99]) > (d[3] - d[2])
 
 
-def _mean_delay_and_loss(n_users):
-    """Two-AP chain world at two occupancies via the real engine."""
+def test_fixed_point_satisfies_bianchi_equations():
+    """Quantitative anchor (VERDICT r4 item 2): the solved (tau, p)
+    satisfies Bianchi's defining equations to 1e-6 — a check independent
+    of the damped iteration that found the point — and matches the
+    closed-form collision-free slot probability at n=1."""
+    W, m = 32, 5
+    for n in (2, 5, 10, 50, 200):
+        tau, p = bianchi_fixed_point(n)
+        assert abs(p - (1.0 - (1.0 - tau) ** (n - 1))) < 1e-9
+        rhs = 2 * (1 - 2 * p) / (
+            (1 - 2 * p) * (W + 1) + p * W * (1 - (2 * p) ** m)
+        )
+        assert abs(tau - rhs) < 1e-6, (n, tau, rhs)
+    tau1, p1 = bianchi_fixed_point(1)
+    assert p1 == 0.0 and abs(tau1 - 2.0 / (W + 1)) < 1e-9
+
+
+def test_single_station_delay_from_first_principles():
+    """The n=1 table entry, recomputed by hand with the reference MAC
+    parameters: mean backoff (W-1)/2 = 15.5 empty slots of 9 us plus one
+    idle-slot-weighted successful exchange, plus the data+SIFS+ACK+DIFS
+    exchange itself.  Pins the table's absolute scale, not just shape."""
+    d, _ = bianchi_tables(2)
+    t_s = (  # DATA(preamble + 162 B @ 54 Mbps) + SIFS + ACK(preamble +
+        #      14 B @ 6 Mbps) + DIFS   (bianchi_tables defaults)
+        20e-6 + (34 + 128) * 8.0 / 54e6 + 10e-6 + 20e-6
+        + 14 * 8.0 / 6e6 + 28e-6
+    )
+    tau = 2.0 / 33.0
+    e_slot = (1 - tau) * 9e-6 + tau * t_s  # n=1: every tx succeeds
+    want = 15.5 * e_slot + t_s
+    np.testing.assert_allclose(d[1], want, rtol=1e-6)
+
+
+def _world(n_users, interval):
     spec, state, net, bounds = wireless.wireless3(
         numb=2, numb_users=n_users, horizon=3.0, dt=1e-3,
-        send_interval=0.05,
+        send_interval=interval,
     )
+    return spec, state, net, bounds
+
+
+def _mean_delay_and_loss(n_users, interval):
+    """Two-AP chain world via the real engine."""
+    spec, state, net, bounds = _world(n_users, interval)
     final, _ = run(spec, state, net, bounds)
     t0 = np.asarray(final.tasks.t_create)
     tb = np.asarray(final.tasks.t_at_broker)
-    m = np.isfinite(t0) & np.isfinite(tb)
+    m = np.isfinite(t0) & np.isfinite(tb) & (tb <= float(final.t))
     stage = np.asarray(final.tasks.stage)
     sent = np.isfinite(t0)
     lost = (stage == int(Stage.LOST)).sum()
     return (tb[m] - t0[m]).mean(), lost / max(sent.sum(), 1), int(sent.sum())
 
 
-def test_delay_and_loss_rise_with_occupancy():
-    """End-to-end through associate(): the same scenario at 2 vs 60
-    stations shows higher uplink transit AND a nonzero loss rate —
-    qualitatively what INET's contention produces as a cell fills."""
-    d_lo, p_lo, n_lo = _mean_delay_and_loss(2)
-    d_hi, p_hi, n_hi = _mean_delay_and_loss(60)
-    assert n_lo > 20 and n_hi > 600
+def test_delay_rises_with_offered_load_not_occupancy():
+    """End-to-end through associate(): the SAME 60 stations at light
+    load (20 fps each, ~20% cell utilisation) transit near the baseline,
+    and at heavy load (200 fps each, cells oversubscribed) the transit
+    and loss climb — contention responds to traffic, not to how many
+    stations merely sit associated."""
+    d_lo, p_lo, n_lo = _mean_delay_and_loss(60, 0.05)
+    d_hi, p_hi, n_hi = _mean_delay_and_loss(60, 0.005)
+    assert n_lo > 600 and n_hi > 6000
     assert d_hi > d_lo * 1.5, (d_lo, d_hi)
     assert p_hi >= p_lo  # loss cannot fall as the cell saturates
+
+
+def test_idle_cell_keys_at_single_station_baseline():
+    """VERDICT r4 item 2's litmus: 60 associated stations of which ONE
+    publishes — the active sender's access delay equals the genuinely
+    single-station cell's, not the 60-station saturation value."""
+    import jax.numpy as jnp
+
+    spec, state, net, bounds = _world(60, 0.05)
+    N = spec.n_nodes
+    one_active = jnp.zeros((N,), jnp.float32).at[0].set(20.0)
+    cache_idle = associate(
+        net, state.nodes.pos, state.nodes.alive,
+        broker=spec.broker_index, offered_rate=one_active,
+    )
+    spec1, state1, net1, _ = _world(1, 0.05)
+    cache_single = associate(
+        net1, state1.nodes.pos, state1.nodes.alive,
+        broker=spec1.broker_index,
+        offered_rate=jnp.zeros((spec1.n_nodes,), jnp.float32).at[0].set(20.0),
+    )
+    # same AP layout; user 0's access delay identical in both worlds
+    np.testing.assert_allclose(
+        float(cache_idle.acc_delay[0]), float(cache_single.acc_delay[0]),
+        rtol=1e-6,
+    )
+    # and equal to the n=1 table anchor through the calibrated scale
+    occup = associate(  # legacy keying for contrast: would pay n~30
+        net, state.nodes.pos, state.nodes.alive, broker=spec.broker_index
+    )
+    assert float(cache_idle.acc_delay[0]) < float(occup.acc_delay[0])
 
 
 def test_single_station_matches_legacy_anchor():

@@ -39,17 +39,28 @@ def test_overflow_is_counted():
     # the gauge saw real backlog, and its max is at least the final value
     assert int(final.metrics.n_deferred_max) > 0
     assert int(final.metrics.n_deferred_max) >= int(final.metrics.n_deferred)
-    # conservation: published = decided + still-in-flight (nothing vanishes)
+    # conservation as the exact stage-partition identity (VERDICT r4 item
+    # 9): every published task occupies exactly one non-UNUSED stage, and
+    # the broker's decision counters partition the publishes that left
+    # PUB_INFLIGHT — equalities, not the near-tautological inequality r3-r4
+    # asserted here
     stage = np.asarray(final.tasks.stage)
     n_pub = int(final.metrics.n_published)
-    in_flight = int(
-        ((stage == int(Stage.PUB_INFLIGHT))
-         | (stage == int(Stage.TASK_INFLIGHT))).sum()
+    cnt = {s: int((stage == int(s)).sum()) for s in Stage}
+    assert sum(c for s, c in cnt.items() if s != Stage.UNUSED) == n_pub
+    m = final.metrics
+    decided = (
+        int(m.n_scheduled) + int(m.n_no_resource)
+        + int(m.n_rejected) + int(m.n_local)
     )
-    decided = int(final.metrics.n_scheduled) + int(final.metrics.n_no_resource)
-    assert decided + in_flight >= n_pub - in_flight  # every task accounted
-    used = (stage != int(Stage.UNUSED)).sum()
-    assert used == n_pub
+    assert decided == n_pub - cnt[Stage.PUB_INFLIGHT] - cnt[Stage.LOST]
+    # scheduled tasks are exactly the ones on (or past) the fog leg (this
+    # world runs no local/v1 branch, so DONE rows are all fog completions)
+    assert int(m.n_local) == 0 and cnt[Stage.LOCAL_RUN] == 0
+    assert int(m.n_scheduled) == (
+        cnt[Stage.TASK_INFLIGHT] + cnt[Stage.QUEUED] + cnt[Stage.RUNNING]
+        + cnt[Stage.DONE] + cnt[Stage.DROPPED]
+    )
 
 
 def test_overflow_does_not_starve_high_id_users():

@@ -1,0 +1,67 @@
+"""Decompose _phase_fog_arrivals cost on the TPU (r5).
+
+Same difference-quotient methodology as profile_tick.py, but patching
+the arrival phase's INTERNALS: candidate reduction, plan_arrivals
+(rank), batched_enqueue, and the T-column scatter-writes.
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from fognetsimpp_tpu.compile_cache import enable_compile_cache
+import fognetsimpp_tpu.core.engine as E
+import fognetsimpp_tpu.ops.queues as Q
+from tools.profile_tick import build, time_scan
+
+def main():
+    enable_compile_cache()
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    win = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    spec, state, net, bounds = build(n_users, 1e-3)
+    import dataclasses
+    spec = dataclasses.replace(spec, arrival_window=win)
+    print(f"users={n_users} K={spec.window} T={spec.task_capacity} "
+          f"R={spec.arrival_cands}")
+    base, c = time_scan(spec, state, net, bounds)
+    print(f"full step:            {base:8.3f} ms/tick (compile {c:.0f}s)")
+
+    def patched(name, mod, attr, repl):
+        orig = getattr(mod, attr)
+        setattr(mod, attr, repl)
+        try:
+            ms, _ = time_scan(spec, state, net, bounds)
+        finally:
+            setattr(mod, attr, orig)
+        print(f"- {name:22s} {ms:8.3f} ms/tick   marginal {base - ms:+.3f}")
+
+    # 1. rank/plan: constant plan (wrong but shape-correct)
+    def fake_plan(mask, fog, t, F, idle, per_fog=None):
+        K = mask.shape[0]
+        return Q.ArrivalPlan(
+            assign_task=jnp.full((F,), Q.NO_TASK, jnp.int32),
+            rank=jnp.where(mask, 0, -1).astype(jnp.int32),
+            counts=jnp.zeros((F,), jnp.int32),
+        )
+    patched("plan_arrivals", E, "plan_arrivals", fake_plan)
+
+    # 2. enqueue: no-op
+    def fake_enq(queue, qh, ql, mask, fog, rank, ids=None):
+        return queue, ql, jnp.zeros_like(mask), jnp.zeros_like(ql)
+    patched("batched_enqueue", E, "batched_enqueue", fake_enq)
+
+    # 3. whole tail
+    def fake_tail(spec_, state_, cache, buf, tasks, fogs, *a):
+        return state_.replace(tasks=tasks, fogs=fogs), buf
+    patched("tail(all)", E, "_fog_arrivals_tail", fake_tail)
+
+    # 4. whole phase
+    ident2 = lambda spec_, s, net_, cache, buf, *a, **k: (s, buf)
+    patched("phase(all)", E, "_phase_fog_arrivals", ident2)
+
+    # 5. compact
+    def fake_compact(mask, K, T, rot=None):
+        idx = jnp.arange(K, dtype=jnp.int32)
+        return idx, idx, mask[:K]
+    patched("compact", E, "_compact", fake_compact)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Bisect _fog_arrivals_tail cost on the TPU (r5)."""
+import os, sys, dataclasses
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from fognetsimpp_tpu.compile_cache import enable_compile_cache
+import fognetsimpp_tpu.core.engine as E
+from fognetsimpp_tpu.ops.queues import NO_TASK, batched_enqueue, plan_arrivals
+from fognetsimpp_tpu.spec import Stage
+from tools.profile_tick import build, time_scan
+
+def make_tail(do_assign, do_queue, do_busy, do_bufm):
+    def tail(spec, state, cache, buf, tasks, fogs, idx, idxc, valid,
+             fog_g, t_af_g, mips_g, user_g, n_fast, n_fast_f):
+        T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+        U = spec.n_users
+        i32 = jnp.int32
+        fog_alive = state.nodes.alive[U:U+F]
+        fog_gc = jnp.clip(fog_g, 0, F - 1)
+        dead_dst = valid & ~fog_alive[fog_gc]
+        arr = valid & ~dead_dst
+        svc_g = E._svc_time(spec, mips_g, fogs.mips[fog_gc])
+        per_fog_arr = E._per_fog(arr, fog_g, F)
+        if do_busy:
+            add_busy = jnp.sum(jnp.where(per_fog_arr, svc_g[None,:], 0.0), axis=1)
+            fogs = fogs.replace(busy_time=fogs.busy_time + add_busy)
+        idle = fogs.current_task == NO_TASK
+        plan = plan_arrivals(arr, fog_g, t_af_g, F, idle, per_fog=per_fog_arr)
+        a_pos = plan.assign_task
+        assigned = a_pos != NO_TASK
+        a_posc = jnp.clip(a_pos, 0, K - 1)
+        a_task = jnp.where(assigned, idx[a_posc], NO_TASK)
+        a_taskc = jnp.clip(a_task, 0, T - 1)
+        if do_assign:
+            t_start = jnp.maximum(tasks.t_at_fog[a_taskc], fogs.free_since)
+            svc_a = E._svc_time(spec, tasks.mips_req[a_taskc], fogs.mips)
+            d_fb = cache.d2b[U:U+F]
+            d_bu_a = cache.d2b[a_taskc // spec.max_sends_per_user]
+            t_ack5 = t_start + d_fb + d_bu_a
+            scat_a = jnp.where(assigned, a_task, T)
+            tasks = tasks.replace(
+                stage=tasks.stage.at[scat_a].set(jnp.int8(int(Stage.RUNNING)), mode="drop"),
+                t_service_start=tasks.t_service_start.at[scat_a].set(
+                    jnp.where(assigned, t_start, 0), mode="drop"),
+                t_ack5=tasks.t_ack5.at[scat_a].set(jnp.where(assigned, t_ack5, 0), mode="drop"),
+            )
+            fogs = fogs.replace(
+                current_task=jnp.where(assigned, a_task, fogs.current_task),
+                busy_until=jnp.where(assigned, t_start + svc_a, fogs.busy_until),
+            )
+        if do_queue:
+            d_fb = cache.d2b[U:U+F]
+            got_head = assigned[fog_gc] & idle[fog_gc]
+            eff_rank = jnp.where(arr, plan.rank - got_head.astype(i32), -1)
+            to_queue = arr & (eff_rank >= 0) & (idx != a_task[fog_gc])
+            queue, q_len, enq_ok, dropped = batched_enqueue(
+                fogs.queue, fogs.q_head, fogs.q_len, to_queue, fog_g, eff_rank, idx)
+            d_bu_q = cache.d2b[user_g]
+            d_fb_q = d_fb[fog_gc]
+            assigned_row = arr & (idx == a_task[fog_gc])
+            stage_k = jnp.where(enq_ok, jnp.int8(int(Stage.QUEUED)),
+                jnp.where((to_queue & ~enq_ok) | dead_dst, jnp.int8(int(Stage.DROPPED)),
+                jnp.where(assigned_row, jnp.int8(int(Stage.RUNNING)),
+                          jnp.int8(int(Stage.TASK_INFLIGHT)))))
+            tasks = tasks.replace(
+                stage=tasks.stage.at[idx].set(stage_k, mode="drop"),
+                t_q_enter=tasks.t_q_enter.at[idx].set(
+                    jnp.where(enq_ok, t_af_g, jnp.inf), mode="drop"),
+                t_ack4_queued=tasks.t_ack4_queued.at[idx].set(
+                    jnp.where(enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf), mode="drop"),
+            )
+            fogs = fogs.replace(queue=queue, q_len=q_len, q_drops=fogs.q_drops + dropped)
+        if do_bufm:
+            acked = (assigned[fog_gc] & (idx == a_task[fog_gc])) & arr
+            sums = jnp.sum(jnp.stack([dead_dst, dead_dst, acked]).astype(i32), axis=1)
+            metrics = state.metrics.replace(
+                n_dropped=state.metrics.n_dropped + sums[0] + n_fast)
+            arr_per_fog = jnp.sum(per_fog_arr, axis=1, dtype=i32) + n_fast_f
+            buf = buf._replace(
+                tx_f=buf.tx_f + arr_per_fog, rx_f=buf.rx_f + arr_per_fog,
+                tx_b=buf.tx_b + sums[2], rx_b=buf.rx_b + sums[2],
+                rx_u=buf.rx_u.at[user_g].add(acked.astype(i32), mode="drop"),
+            )
+            state = state.replace(metrics=metrics)
+        return state.replace(tasks=tasks, fogs=fogs), buf
+    return tail
+
+def main():
+    enable_compile_cache()
+    n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    spec, state, net, bounds = build(n_users, 1e-3)
+    spec = dataclasses.replace(spec, arrival_window=4096)
+    base, c = time_scan(spec, state, net, bounds)
+    print(f"full: {base:7.3f} ms/tick (compile {c:.0f}s)")
+    orig = E._fog_arrivals_tail
+    for name, args in [
+        ("assign+queue+busy+buf", (1,1,1,1)),
+        ("no buf/metrics", (1,1,1,0)),
+        ("no queue-branch", (1,0,1,1)),
+        ("no assign-branch", (0,1,1,1)),
+        ("no busy-add", (1,1,0,1)),
+        ("busy only", (0,0,1,0)),
+    ]:
+        E._fog_arrivals_tail = make_tail(*args)
+        try:
+            ms, _ = time_scan(spec, state, net, bounds)
+        finally:
+            E._fog_arrivals_tail = orig
+        print(f"- {name:22s} {ms:7.3f} ms/tick  marginal {base-ms:+.3f}")
+
+if __name__ == "__main__":
+    main()

@@ -169,7 +169,7 @@ def main():
     cache0 = E.associate(net, state.nodes.pos, state.nodes.alive,
                          broker=spec.broker_index)
     patched("associate", "associate",
-            lambda net_, pos, alive, broker: cache0)
+            lambda net_, pos, alive, broker=None, **kw: cache0)
     patched("mobility", "step_mobility",
             lambda nodes, bounds_, t1, dt: (nodes.pos, nodes.vel))
 
