@@ -23,9 +23,16 @@ from ..spec import Stage
 from ..state import WorldState
 
 
-def _finite_ms(t_end: np.ndarray, t_start: np.ndarray) -> np.ndarray:
-    """(t_end - t_start) * 1e3 over rows where both ends are finite."""
-    m = np.isfinite(t_end) & np.isfinite(t_start)
+def _finite_ms(
+    t_end: np.ndarray, t_start: np.ndarray, t_now: float = float("inf")
+) -> np.ndarray:
+    """(t_end - t_start) * 1e3 where both ends are finite and the end
+    event has actually HAPPENED by ``t_now`` (the run's end time) — a
+    packet whose pre-computed arrival lies past the horizon is still in
+    flight, and the reference would not have recorded its sample (r5: the
+    deterministic demo calibration exposed this — creations k >= 58 have
+    stamped arrivals past the 3.35 s horizon)."""
+    m = np.isfinite(t_end) & np.isfinite(t_start) & (t_end <= t_now)
     return ((t_end[m] - t_start[m]) * 1e3).astype(np.float64)
 
 
@@ -38,21 +45,22 @@ def extract_signals(final: WorldState) -> Dict[str, np.ndarray]:
     """
     t = final.tasks
     t_create = np.asarray(t.t_create)
+    t_now = float(final.t)
     return {
-        "latency": _finite_ms(np.asarray(t.t_ack5), t_create),
+        "latency": _finite_ms(np.asarray(t.t_ack5), t_create, t_now),
         "latency_h1": np.concatenate(
             [
-                _finite_ms(np.asarray(t.t_ack4_fwd), t_create),
-                _finite_ms(np.asarray(t.t_ack4_queued), t_create),
+                _finite_ms(np.asarray(t.t_ack4_fwd), t_create, t_now),
+                _finite_ms(np.asarray(t.t_ack4_queued), t_create, t_now),
             ]
         ),
-        "task_time": _finite_ms(np.asarray(t.t_ack6), t_create),
-        "ack3": _finite_ms(np.asarray(t.t_ack3), t_create),
+        "task_time": _finite_ms(np.asarray(t.t_ack6), t_create, t_now),
+        "ack3": _finite_ms(np.asarray(t.t_ack3), t_create, t_now),
         "queue_time": np.asarray(t.queue_time_ms)[
             np.isfinite(np.asarray(t.queue_time_ms))
             & ~np.isnan(np.asarray(t.queue_time_ms))
         ].astype(np.float64),
-        "delay": _finite_ms(np.asarray(t.t_at_broker), t_create),
+        "delay": _finite_ms(np.asarray(t.t_at_broker), t_create, t_now),
     }
 
 

