@@ -147,13 +147,16 @@ def config4(R: int = None, horizon: float = None):
         numb_users=10_000, horizon=horizon, dt=5e-3,
         policy=int(Policy.ENERGY_AWARE),
         send_interval=0.05, queue_capacity=64,
-        # 2000 stations/AP is a deliberate abstraction (5 APs stand in
-        # for a real deployment's hundreds): keep the LINEAR contention
-        # model with the per-station coefficient rescaled — the physical
-        # Bianchi curve at n=2000 would (correctly) lose ~88% of uplink
-        # traffic and gut the benchmark workload
-        w_contention=1.5e-3 * 10 / 10_000,
-        mac_model="linear",
+        # r5 (VERDICT r4 item 2): the linear-model escape hatch is
+        # retired.  64 APs (5 reference + 59 grid) give ~156 stations
+        # per cell at 20 fps each — ~3.1k offered frames/s/cell, just
+        # above the single-frame 802.11g service rate, so the REAL
+        # Bianchi model runs with a physical effective-contender count
+        # (n_eff ~ 2, mild extra delay, near-zero retry loss) instead
+        # of r4's choice between tab[2000] saturation and a rescaled
+        # linear coefficient
+        extra_aps=59,
+        mac_model="bianchi",
     )
     spec0, *_ = wireless.wireless5(**kw)
     spec, state, net, bounds = wireless.wireless5(

@@ -71,8 +71,14 @@ def _load() -> ctypes.CDLL:
             + [dp]  # d2b_tab (nullable)
             + [ctypes.c_int] * 2 + [ctypes.c_double]  # tab shape + dt
             + [ctypes.POINTER(ctypes.c_ubyte)]  # task_lost (nullable)
+            # user energy + lifecycle mode (r5, nullable bundle)
+            + [dp] * 4  # user_energy0/cap, user_start, user_interval
+            + [ctypes.c_int] * 2  # connect_gating, max_sends_per_user
+            + [ctypes.c_double] * 6  # e_dt, harvest w/period/duty, thresholds
             + [dp, ip] + [dp] * 9 + [ip]
             + [dp]  # o_fog_energy (nullable)
+            + [dp, dp]  # o_t_create, o_user_energy (nullable)
+            + [ctypes.POINTER(ctypes.c_ubyte)]  # o_user_alive (nullable)
         )
         _lib = lib
     return _lib
@@ -113,6 +119,12 @@ def run_gen(
     #   node<->broker delays (wireless/mobility); None = static d_ub/d_bf
     table_dt: float = 0.0,
     task_lost: Optional[np.ndarray] = None,  # (n_tasks) uint8 loss replay
+    user_energy: Optional[Dict] = None,  # r5 user-battery mode: dict with
+    #   energy0, cap, start, interval (per-user arrays), connect_gating,
+    #   max_sends_per_user, dt, harvest_w, harvest_period, harvest_duty,
+    #   shutdown_frac, start_frac.  The DES then runs the send chain
+    #   itself, alive-gated on its own tick-quantised battery state, and
+    #   the result gains t_create / user_energy / user_alive arrays.
 ) -> Dict[str, np.ndarray]:
     """Run the native DES over an explicit publish schedule."""
     lib = _load()
@@ -161,6 +173,17 @@ def run_gen(
         if task_lost is not None
         else None
     )
+    ue = user_energy
+    if ue is not None:
+        ue_arrs = [d(ue["energy0"]), d(ue["cap"]), d(ue["start"]),
+                   d(ue["interval"])]
+        o_t_create = np.empty((n_tasks,), np.float64)
+        o_user_energy = np.empty((len(d_ub),), np.float64)
+        o_user_alive = np.empty((len(d_ub),), np.uint8)
+    else:
+        ue_arrs = None
+        o_t_create = o_user_energy = o_user_alive = None
+    ubp = ctypes.POINTER(ctypes.c_ubyte)
 
     n_events = lib.desim_run_gen(
         len(d_ub), len(d_bf), n_tasks,
@@ -186,17 +209,36 @@ def run_gen(
         (lost_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))
          if lost_arr is not None
          else ctypes.cast(None, ctypes.POINTER(ctypes.c_ubyte))),
+        pd(ue_arrs[0]) if ue else null_d,
+        pd(ue_arrs[1]) if ue else null_d,
+        pd(ue_arrs[2]) if ue else null_d,
+        pd(ue_arrs[3]) if ue else null_d,
+        int(ue["connect_gating"]) if ue else 0,
+        int(ue["max_sends_per_user"]) if ue else 0,
+        ctypes.c_double(ue["dt"] if ue else 0.0),
+        ctypes.c_double(ue["harvest_w"] if ue else 0.0),
+        ctypes.c_double(ue["harvest_period"] if ue else 1.0),
+        ctypes.c_double(ue["harvest_duty"] if ue else 0.0),
+        ctypes.c_double(ue["shutdown_frac"] if ue else 0.0),
+        ctypes.c_double(ue["start_frac"] if ue else 0.0),
         pd(outs_d["t_at_broker"]), pi(fog), pd(outs_d["t_at_fog"]),
         pd(outs_d["t_service_start"]), pd(outs_d["t_complete"]),
         pd(outs_d["t_ack3"]), pd(outs_d["t_ack4_fwd"]), pd(outs_d["t_ack5"]),
         pd(outs_d["t_ack4_queued"]), pd(outs_d["t_ack6"]),
         pd(outs_d["queue_time"]), pi(stage),
         pd(fog_energy_out) if fog_energy_out is not None else null_d,
+        pd(o_t_create) if ue else null_d,
+        pd(o_user_energy) if ue else null_d,
+        (o_user_alive.ctypes.data_as(ubp) if ue else ctypes.cast(None, ubp)),
     )
     out = dict(outs_d)
     out["fog"] = fog
     out["stage"] = stage
     out["n_events"] = np.asarray(n_events)
+    if ue is not None:
+        out["t_create"] = o_t_create
+        out["user_energy"] = o_user_energy
+        out["user_alive"] = o_user_alive
     if fog_energy_out is not None:
         out["fog_energy"] = fog_energy_out
     return out
@@ -364,13 +406,63 @@ def replay_engine_world(
             "replay_engine_world(spec, final, net, state0=state, "
             "bounds=bounds)"
         )
+    user_mode = False
     if wireless_world and spec.energy_enabled:
-        raise NotImplementedError(
-            "wireless + energy lifecycle has no independent baseline: the "
-            "alive trajectory would feed back into the delay table through "
-            "the engine's own traffic (energy parity is gated separately "
-            "on wired worlds, tests/test_parity.py::test_parity_energy_aware)"
+        has_e = np.asarray(
+            (state0 if state0 is not None else final_state).nodes.has_energy
         )
+        if has_e[: spec.n_users].all() and not has_e[spec.n_users :].any():
+            # r5 (VERDICT r4 item 5): USER batteries only — the flagship
+            # wireless5 combination.  The DES derives its own alive
+            # trajectory from its own tick-quantised tx/rx bookings and
+            # runs the send chain itself; the delay table stays pure
+            # data because APs/fogs never die and the table's only
+            # alive-dependence (dead-user unreachability) is overlaid by
+            # the DES from its own lifecycle state.  Contention must not
+            # depend on user liveness, so Bianchi/linear-contention
+            # worlds are excluded below.
+            user_mode = True
+            if np.asarray(net.mac_loss_tab).shape[0] > 0 or float(
+                np.asarray(net.w_contention)
+            ) > 0.0:
+                raise NotImplementedError(
+                    "user-battery wireless parity needs alive-independent "
+                    "delays: build the world with mac_model='linear' and "
+                    "w_contention=0 (contention-under-churn stays an "
+                    "engine-only behaviour, PARITY.md deviation ledger)"
+                )
+            if (
+                spec.send_interval_jitter > 0
+                or spec.max_sends_per_tick > 1
+                or spec.send_stop_time != float("inf")
+            ):
+                raise NotImplementedError(
+                    "user-battery replay runs the send chain itself: it "
+                    "needs send_interval_jitter == 0, max_sends_per_tick "
+                    "== 1 and no send_stop_time (the C chain fires one "
+                    "publish per user per tick)"
+                )
+            s0u = (state0 if state0 is not None else final_state).users
+            if (
+                not np.asarray(s0u.publisher).all()
+                or np.asarray(s0u.sub_mask).any()
+            ):
+                raise NotImplementedError(
+                    "user-battery replay books Connect/Connack energy "
+                    "only: publisher-role splits and subscriptions are "
+                    "not mirrored in the C send chain"
+                )
+        else:
+            raise NotImplementedError(
+                "wireless battery lifecycle needs batteries on ALL users "
+                "and NONE on fogs/APs for an independent baseline: "
+                "partial user batteries would drain battery-less users "
+                "in the DES, and infrastructure deaths feed back into "
+                "the delay table through the engine's own traffic "
+                "(all-user-battery worlds ARE supported, r5; fog energy "
+                "parity is gated separately on wired worlds, "
+                "tests/test_parity.py::test_parity_energy_aware)"
+            )
     # all 7 policies have a sequential baseline (r3): ENERGY_AWARE runs on
     # the DES's per-fog energy model (fed the spec's joule parameters) and
     # RANDOM consumes the same task-id-keyed stream as the engine
@@ -391,7 +483,7 @@ def replay_engine_world(
         lost = (
             np.asarray(tasks.stage) == int(Stage.LOST)
         ).astype(np.uint8)
-        table_kw["task_lost"] = lost[used]
+        table_kw["task_lost"] = lost if user_mode else lost[used]
     else:
         cache = associate(
             net, final_state.nodes.pos,
@@ -438,7 +530,11 @@ def replay_engine_world(
         from ..ops.sched import task_uniform
         import jax
 
-        ids = np.nonzero(used)[0].astype(np.int32)
+        ids = (
+            np.arange(spec.task_capacity, dtype=np.int32)
+            if user_mode
+            else np.nonzero(used)[0].astype(np.int32)
+        )
         rand_kw = dict(
             rand_u=np.asarray(
                 task_uniform(
@@ -446,6 +542,34 @@ def replay_engine_world(
                 ),
                 np.float64,
             )
+        )
+
+    if user_mode:
+        U = spec.n_users
+        used = np.ones((spec.task_capacity,), bool)
+        energy_kw = dict(
+            tx_energy_j=spec.tx_energy_j,
+            rx_energy_j=spec.rx_energy_j,
+            idle_power_w=spec.idle_power_w,
+            compute_power_w=spec.compute_power_w,
+            user_energy=dict(
+                energy0=np.asarray(state0p.nodes.energy, np.float64)[:U],
+                cap=np.asarray(
+                    state0p.nodes.energy_capacity, np.float64
+                )[:U],
+                start=np.asarray(state0p.users.start_t, np.float64),
+                interval=np.asarray(
+                    state0p.users.send_interval, np.float64
+                ),
+                connect_gating=spec.connect_gating,
+                max_sends_per_user=spec.max_sends_per_user,
+                dt=spec.dt,
+                harvest_w=spec.harvest_power_w,
+                harvest_period=spec.harvest_period_s,
+                harvest_duty=spec.harvest_duty,
+                shutdown_frac=spec.shutdown_frac,
+                start_frac=spec.start_frac,
+            ),
         )
 
     return run_gen(

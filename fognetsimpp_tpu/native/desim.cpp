@@ -127,6 +127,33 @@ struct Fog {
   double t_energy = 0.0;  // last integration time
 };
 
+// Per-user battery + self-timed publish chain (r5, VERDICT r4 item 5):
+// the flagship wireless5 combination — 802.11 users whose batteries
+// drain, die and restart (wireless5.ini:150-166, mqttApp2.cc:471-492) —
+// gets an INDEPENDENT sequential baseline by letting the DES derive its
+// own alive trajectory from its own tx/rx events.  Energy/lifecycle is
+// tick-quantised exactly like the engine's step_energy (net/energy.py):
+// per-tick message counts, float32 arithmetic in the same op order,
+// square-wave harvest, hysteresis thresholds.  Active only in
+// user-energy mode (user_energy0 != nullptr); requires battery-less
+// fogs/APs so the delay table stays pure data (the engine-side table
+// assumes always-alive rows; the DES overlays dead-user unreachability
+// itself via d_user).
+struct UserNode {
+  bool alive = true;
+  float energy = 0.f, cap = 1.f;
+  int tx_tick = 0, rx_tick = 0;  // current-tick message accumulators
+  // mqttApp2 send chain (mirrors engine _phase_connect/_phase_spawn).
+  // FLOAT on purpose: the engine's chain is float32 (next_send/connack
+  // accumulate in f32), and tick-boundary comparisons must land on the
+  // same side in both simulators.
+  float start_t = std::numeric_limits<float>::infinity();
+  float connack_at = std::numeric_limits<float>::infinity();
+  float next_send = std::numeric_limits<float>::infinity();
+  bool connected = false;
+  int send_count = 0;
+};
+
 struct Task {
   int user = 0;
   double t_create = 0.0;
@@ -177,11 +204,22 @@ struct Params {
   // the Bernoulli draw is the engine's, so both simulators lose the
   // SAME publishes); nullptr = no loss
   const unsigned char* task_lost;
+  // --- user energy + lifecycle mode (r5; nullptr = off) --------------
+  const double* user_energy0;    // (n_users) initial joules
+  const double* user_energy_cap; // (n_users)
+  const double* user_start;      // (n_users) app start times
+  const double* user_interval;   // (n_users) publish intervals
+  int connect_gating;
+  int max_sends_per_user;        // S: task slot = u * S + k
+  double e_dt;                   // engine tick (energy quantum)
+  double harvest_w, harvest_period, harvest_duty;
+  double shutdown_frac, start_frac;
 };
 
 struct World {
   Params p;
   std::vector<Fog> fogs;
+  std::vector<UserNode> users;  // populated only in user-energy mode
   std::vector<Task> tasks;
   std::vector<double> view_mips, view_busy;  // brokers[] stale view
   std::vector<char> registered;
@@ -224,9 +262,26 @@ struct World {
     if (s >= p.tab_steps) s = p.tab_steps - 1;
     return p.d2b_tab[static_cast<size_t>(s) * p.tab_stride + node];
   }
+  // row k of the delay table (the engine's tick-k cache) by INDEX —
+  // time-keyed lookups at f32 tick boundaries can land one row off
+  // when float(dt) > dt (code-review r5)
+  double tab_row(int node, int k) const {
+    int s = k < 0 ? 0 : (k >= p.tab_steps ? p.tab_steps - 1 : k);
+    return p.d2b_tab[static_cast<size_t>(s) * p.tab_stride + node];
+  }
+
   double d_user(int u, double t) const {
+    // user-energy mode: a dead user is unassociated — exactly the
+    // engine's cache (assoc requires alive), which the table rows
+    // cannot carry because they are built alive-agnostic
+    if (!users.empty() && !users[u].alive) return kInf;
     return p.d2b_tab ? tab(u, t) : p.d_ub[u];
   }
+  // TickBuf bookings (user side): the engine charges message energy in
+  // the tick where the send/receive is DECIDED; every DES handler runs
+  // inside that same tick's drain window, so plain accumulators match.
+  void user_tx(int u) { if (!users.empty()) users[u].tx_tick += 1; }
+  void user_rx(int u) { if (!users.empty()) users[u].rx_tick += 1; }
   double d_fog(int f, double t) const {
     return p.d2b_tab ? tab(p.n_users + f, t) : p.d_bf[f];
   }
@@ -313,6 +368,7 @@ struct World {
 
   void broker_decide(int i, double now) {
     Task& tk = tasks[i];
+    user_rx(tk.user);  // engine: rx_u += 1 per decided publish (ack relay)
     // v1/v2 LOCAL_FIRST: run locally when the broker pool covers it
     // (strict <, BrokerBaseApp.cc:171-180 / BrokerBaseApp2.cc:181);
     // status-3 "processing" ack
@@ -394,6 +450,7 @@ struct World {
       tk.t_service_start = now;
       fg.busy_until = now + tk.svc;
       tk.t_ack5 = now + d_fog(tk.fog, now) + d_user(tk.user, now);  // "assigned"
+      user_rx(tk.user);  // engine: acked arrivals book a user rx
       push(fg.busy_until, kEvRelease, tk.fog);
     } else {                              // busy: FIFO (:304-314)
       int backlog = static_cast<int>(fg.fifo.size() - fg.head);
@@ -405,6 +462,7 @@ struct World {
       tk.stage = kQueued;
       tk.t_q_enter = now;
       tk.t_ack4_queued = now + d_fog(tk.fog, now) + d_user(tk.user, now);  // "queued"
+      user_rx(tk.user);
     }
   }
 
@@ -420,6 +478,7 @@ struct World {
     done.stage = kDone;
     done.t_complete = t_done;
     done.t_ack6 = t_done + d_fog(f, t_done) + d_user(done.user, t_done);  // "performed"
+    user_rx(done.user);
     fg.busy_time -= done.svc;  // busyTime -= requiredTime (:232)
     fg.current = -1;
     fg.busy_until = kInf;
@@ -458,9 +517,11 @@ struct World {
     touch_energy(tk.fog, now, p.tx_j);  // status-6 Puback tx
     fogs[tk.fog].pool += tk.mips_req;
     tk.stage = kDone;
-    if (p.app_gen >= 2)  // v1 acks via FognetMsgTaskAck, which the broker
-      //                    logs and drops: the client never learns
+    if (p.app_gen >= 2) {  // v1 acks via FognetMsgTaskAck, which the broker
+      //                      logs and drops: the client never learns
       tk.t_ack6 = now + d_fog(tk.fog, now) + d_user(tk.user, now);
+      user_rx(tk.user);
+    }
   }
 
   void local_done(int i, double now) {  // BrokerBaseApp.cc:369-394
@@ -468,6 +529,7 @@ struct World {
     if (!p.local_pool_leak) local_pool += tk.mips_req;
     tk.stage = kDone;
     tk.t_ack6 = now + d_user(tk.user, now);  // status-6 straight to the client
+    user_rx(tk.user);
   }
 
   void v2_broker_release(int gen, double now) {
@@ -485,6 +547,7 @@ struct World {
         req_open[i] = 0;
         broker_reqs.erase(broker_reqs.begin() + j);
         double ack = now + d_user(tk.user, now);
+        user_rx(tk.user);
         if (ack < tk.t_ack6) tk.t_ack6 = ack;  // duplicate-ack min
         if (tk.stage == kLocalRun) {
           tk.stage = kDone;
@@ -496,6 +559,157 @@ struct World {
     // the self-message is spent; only the next accept reschedules it
   }
 
+  // ---- user-energy mode (r5): tick-quantised lifecycle ---------------
+  // The engine gates connect/spawn on `alive` per tick and runs
+  // step_energy at each tick end; this loop replicates that ordering:
+  // per tick — connect stamps, spawn fires, then every heap event with
+  // t <= t1 (the engine's `<= t1` masks), then the energy step.
+
+  void connect_phase(float t0, float t1, int k) {  // _phase_connect mirror
+    if (!p.connect_gating) return;
+    for (int u = 0; u < p.n_users; ++u) {
+      UserNode& un = users[u];
+      if (un.alive && !un.connected && !std::isfinite(un.connack_at) &&
+          un.start_t < t1) {
+        un.tx_tick += 1;  // Connect
+        float t_send = std::max(un.start_t, t0);
+        // cache row of THIS tick, fetched by index
+        float d = static_cast<float>(
+            p.d2b_tab ? tab_row(u, k) : p.d_ub[u]);
+        un.connack_at = t_send + 2.0f * d;  // f32 like the engine
+      }
+      if (!un.connected && un.connack_at <= t1) {
+        un.connected = true;
+        un.rx_tick += 1;  // Connack (no subscriptions in these worlds)
+        un.next_send = un.connack_at;
+      }
+    }
+  }
+
+  void spawn_phase(float t0, float t1, int k) {  // _phase_spawn mirror
+    for (int u = 0; u < p.n_users; ++u) {
+      UserNode& un = users[u];
+      if (!(un.alive && un.connected && un.next_send < t1 &&
+            un.send_count < p.max_sends_per_user))
+        continue;
+      float t_create = std::max(un.next_send, t0);
+      int slot = u * p.max_sends_per_user + un.send_count;
+      un.tx_tick += 1;  // the publish is sent either way
+      Task& tk = tasks[slot];
+      tk.user = u;
+      tk.t_create = t_create;
+      // mips_req replayed per slot (the engine's PRNG draw for this
+      // fire tick — valid as data iff the alive trajectories agree,
+      // which the gate asserts via the t_create columns)
+      if (p.task_lost != nullptr && p.task_lost[slot]) {
+        tk.stage = kLost;
+      } else {
+        tk.stage = kPubInflight;
+        float d = static_cast<float>(
+            p.d2b_tab ? tab_row(u, k) : p.d_ub[u]);
+        tk.t_at_broker = t_create + d;  // f32 stamp like the engine
+        push(tk.t_at_broker, kEvPubArrive, slot);
+      }
+      un.next_send = t_create + static_cast<float>(p.user_interval[u]);
+      un.send_count += 1;
+    }
+  }
+
+  void energy_tick(float, int k) {  // step_energy mirror (f32)
+    float dt = static_cast<float>(p.e_dt);
+    float t1f = static_cast<float>(k + 1) * dt;  // engine's f32 t1
+    float phase = std::fmod(t1f, static_cast<float>(p.harvest_period)) /
+                  static_cast<float>(p.harvest_period);
+    // idle*dt and harvest*dt are PYTHON (f64) products in the engine,
+    // rounded to f32 once as constants — round the f64 product, never
+    // the factors (one-ulp drift here shifted revival ticks, r5)
+    float gain = phase < static_cast<float>(p.harvest_duty)
+                     ? static_cast<float>(p.harvest_w * p.e_dt)
+                     : 0.f;
+    float idle_dt = static_cast<float>(p.idle_w * p.e_dt);
+    for (int u = 0; u < p.n_users; ++u) {
+      UserNode& un = users[u];
+      float drain = idle_dt +
+                    static_cast<float>(p.tx_j) * un.tx_tick +
+                    static_cast<float>(p.rx_j) * un.rx_tick;
+      float e = un.energy - (un.alive ? drain : 0.f) + gain;
+      if (e < 0.f) e = 0.f;
+      if (e > un.cap) e = un.cap;
+      un.energy = e;
+      float frac = e / std::max(un.cap, 1e-12f);
+      if (un.alive && frac <= static_cast<float>(p.shutdown_frac))
+        un.alive = false;
+      else if (!un.alive && frac >= static_cast<float>(p.start_frac))
+        un.alive = true;
+      un.tx_tick = un.rx_tick = 0;
+    }
+  }
+
+  long run_user_energy() {
+    long n_events = 0;
+    // the engine runs spec.n_ticks = round(horizon / dt) ticks
+    int n_ticks = static_cast<int>(std::lround(p.horizon / p.e_dt));
+    float dtf = static_cast<float>(p.e_dt);
+    for (int k = 0; k < n_ticks; ++k) {
+      // f32 tick boundaries, exactly the engine's
+      //   t0 = tick.astype(f32) * dt;  t1 = (tick+1).astype(f32) * dt
+      float t0 = static_cast<float>(k) * dtf;
+      float t1 = static_cast<float>(k + 1) * dtf;
+      connect_phase(t0, t1, k);
+      spawn_phase(t0, t1, k);
+      while (!heap.empty() &&
+             heap.top().t <= static_cast<double>(t1)) {
+        Event ev = heap.top();
+        heap.pop();
+        ++n_events;
+        dispatch(ev);
+      }
+      energy_tick(t1, k);
+    }
+    return n_events;
+  }
+
+  void dispatch(const Event& ev) {
+    switch (ev.kind) {
+      case kEvRegister:
+        registered[ev.a] = 1;
+        break;
+      case kEvAdvArrive:
+        view_mips[ev.a] = ev.x;
+        view_busy[ev.a] = ev.y;
+        break;
+      case kEvAdvTimer: {
+        Fog& fg = fogs[ev.a];
+        double payload = p.fog_model == kPool ? fg.pool : fg.mips;
+        push(ev.t + d_fog(ev.a, ev.t), kEvAdvArrive, ev.a, payload,
+             fg.busy_time);
+        push(ev.t + p.adv_interval, kEvAdvTimer, ev.a);
+        break;
+      }
+      case kEvPubArrive:
+        broker_decide(ev.a, ev.t);
+        break;
+      case kEvTaskArrive:
+        if (p.fog_model == kPool)
+          pool_arrive(ev.a, ev.t);
+        else
+          fifo_arrive(ev.a, ev.t);
+        break;
+      case kEvRelease:
+        fifo_release(ev.a, ev.t);
+        break;
+      case kEvPoolDone:
+        pool_done(ev.a, ev.t);
+        break;
+      case kEvLocalDone:
+        local_done(ev.a, ev.t);
+        break;
+      case kEvBrokerRelease:
+        v2_broker_release(ev.a, ev.t);
+        break;
+    }
+  }
+
   long run() {
     long n_events = 0;
     while (!heap.empty()) {
@@ -503,44 +717,7 @@ struct World {
       heap.pop();
       if (ev.t > p.horizon) break;
       ++n_events;
-      switch (ev.kind) {
-        case kEvRegister:
-          registered[ev.a] = 1;  // brokers.push_back (:102-107)
-          break;
-        case kEvAdvArrive:  // latest-wins view refresh (:123-136)
-          view_mips[ev.a] = ev.x;
-          view_busy[ev.a] = ev.y;
-          break;
-        case kEvAdvTimer: {  // v1/v2: re-advertise every 0.01 s; the POOL
-          Fog& fg = fogs[ev.a];  // model advertises the remaining pool
-          double val = p.fog_model == kPool ? fg.pool : fg.mips;
-          push(ev.t + d_fog(ev.a, ev.t), kEvAdvArrive, ev.a, val,
-               fg.busy_time);
-          push(ev.t + p.adv_interval, kEvAdvTimer, ev.a);
-          break;
-        }
-        case kEvPubArrive:
-          broker_decide(ev.a, ev.t);
-          break;
-        case kEvTaskArrive:
-          if (p.fog_model == kPool)
-            pool_arrive(ev.a, ev.t);
-          else
-            fifo_arrive(ev.a, ev.t);
-          break;
-        case kEvRelease:
-          fifo_release(ev.a, ev.t);
-          break;
-        case kEvPoolDone:
-          pool_done(ev.a, ev.t);
-          break;
-        case kEvLocalDone:
-          local_done(ev.a, ev.t);
-          break;
-        case kEvBrokerRelease:
-          v2_broker_release(ev.a, ev.t);
-          break;
-      }
+      dispatch(ev);
     }
     return n_events;
   }
@@ -577,12 +754,25 @@ long desim_run_gen(
     const double* d2b_tab,  // (tab_steps, tab_stride) or nullptr (static)
     int tab_steps, int tab_stride, double tab_dt,
     const unsigned char* task_lost,  // (n_tasks) or nullptr
+    // user energy + lifecycle mode (r5; nullptr user_energy0 = off).
+    // In this mode the publish schedule is NOT replayed: the DES runs
+    // the mqttApp2 send chain itself, gated on its OWN tick-quantised
+    // battery/lifecycle state, and n_tasks must be n_users * S slots.
+    const double* user_energy0, const double* user_energy_cap,
+    const double* user_start, const double* user_interval,
+    int connect_gating, int max_sends_per_user, double e_dt,
+    double harvest_w, double harvest_period, double harvest_duty,
+    double shutdown_frac, double start_frac,
     // outputs (n_tasks):
     double* o_t_at_broker, int* o_fog, double* o_t_at_fog,
     double* o_t_service_start, double* o_t_complete, double* o_t_ack3,
     double* o_t_ack4_fwd, double* o_t_ack5, double* o_t_ack4_queued,
     double* o_t_ack6, double* o_queue_time, int* o_stage,
-    double* o_fog_energy  // (n_fogs) final joules (energy model on)
+    double* o_fog_energy,  // (n_fogs) final joules (energy model on)
+    // user-energy-mode outputs (nullptr unless the mode is on):
+    double* o_t_create,        // (n_tasks) DES-derived creation times
+    double* o_user_energy,     // (n_users) final joules
+    unsigned char* o_user_alive  // (n_users) final lifecycle state
     ) {
   World w;
   w.p = Params{n_users, n_fogs, n_tasks, d_ub, d_bf, horizon, policy,
@@ -590,7 +780,10 @@ long desim_run_gen(
                adv_on_completion, adv_periodic, v1_max_scan,
                local_pool_leak, queue_capacity, broker_mips, required_time,
                adv_interval, tx_j, rx_j, idle_w, compute_w, rand_u,
-               v2_local, d2b_tab, tab_steps, tab_stride, tab_dt, task_lost};
+               v2_local, d2b_tab, tab_steps, tab_stride, tab_dt, task_lost,
+               user_energy0, user_energy_cap, user_start, user_interval,
+               connect_gating, max_sends_per_user, e_dt, harvest_w,
+               harvest_period, harvest_duty, shutdown_frac, start_frac};
   w.fogs.resize(n_fogs);
   w.tasks.resize(n_tasks);
   w.view_mips.assign(n_fogs, 0.0);
@@ -614,25 +807,57 @@ long desim_run_gen(
     if (adv_periodic)  // first timer at one interval (ComputeBrokerApp2.cc:219)
       w.push(adv_interval, kEvAdvTimer, f);
   }
-  for (int i = 0; i < n_tasks; ++i) {
-    w.tasks[i].user = task_user[i];
-    w.tasks[i].t_create = task_t_create[i];
-    w.tasks[i].mips_req = task_mips_req[i];
-    if (std::isfinite(task_t_create[i])) {
-      if (task_lost != nullptr && task_lost[i]) {
-        // wireless uplink loss, replayed from the engine's draw: the
-        // publish was sent (tx energy) but never reaches the broker
-        w.tasks[i].stage = kLost;
-      } else {
-        w.tasks[i].stage = kPubInflight;
-        w.tasks[i].t_at_broker =
-            task_t_create[i] + w.d_user(task_user[i], task_t_create[i]);
-        w.push(w.tasks[i].t_at_broker, kEvPubArrive, i);
+  bool user_mode = user_energy0 != nullptr;
+  if (user_mode) {
+    // self-timed workload: only the per-slot MIPS draws and loss draws
+    // are replayed; creation times come from the DES's own alive-gated
+    // send chain (compared against the engine's by the parity gate)
+    w.users.resize(n_users);
+    for (int u = 0; u < n_users; ++u) {
+      UserNode& un = w.users[u];
+      un.energy = static_cast<float>(user_energy0[u]);
+      un.cap = static_cast<float>(user_energy_cap[u]);
+      un.start_t = static_cast<float>(user_start[u]);
+      un.connected = !connect_gating;
+      if (!connect_gating)
+        un.next_send = static_cast<float>(user_start[u]);
+    }
+    for (int i = 0; i < n_tasks; ++i) {
+      w.tasks[i].user = i / max_sends_per_user;
+      w.tasks[i].t_create = kInf;
+      w.tasks[i].mips_req = task_mips_req[i];
+    }
+  } else {
+    for (int i = 0; i < n_tasks; ++i) {
+      w.tasks[i].user = task_user[i];
+      w.tasks[i].t_create = task_t_create[i];
+      w.tasks[i].mips_req = task_mips_req[i];
+      if (std::isfinite(task_t_create[i])) {
+        if (task_lost != nullptr && task_lost[i]) {
+          // wireless uplink loss, replayed from the engine's draw: the
+          // publish was sent (tx energy) but never reaches the broker
+          w.tasks[i].stage = kLost;
+        } else {
+          w.tasks[i].stage = kPubInflight;
+          w.tasks[i].t_at_broker =
+              task_t_create[i] + w.d_user(task_user[i], task_t_create[i]);
+          w.push(w.tasks[i].t_at_broker, kEvPubArrive, i);
+        }
       }
     }
   }
 
-  long n_events = w.run();
+  long n_events = user_mode ? w.run_user_energy() : w.run();
+
+  if (user_mode) {
+    for (int u = 0; u < n_users; ++u) {
+      if (o_user_energy != nullptr) o_user_energy[u] = w.users[u].energy;
+      if (o_user_alive != nullptr) o_user_alive[u] = w.users[u].alive;
+    }
+    if (o_t_create != nullptr)
+      for (int i = 0; i < n_tasks; ++i)
+        o_t_create[i] = w.tasks[i].t_create;
+  }
 
   if (o_fog_energy != nullptr) {
     for (int f = 0; f < n_fogs; ++f) {

@@ -123,3 +123,102 @@ def test_wireless_replay_requires_state0():
     final, _ = run(spec, state, net, bounds)
     with pytest.raises(NotImplementedError):
         bridge.replay_engine_world(spec, final, net)
+
+
+def test_wireless5_energy_churn_has_a_baseline():
+    """The flagship combination the r4 gate still excluded (VERDICT r4
+    missing item 1 / next-round item 5): 802.11 users whose batteries
+    drain, die and restart (wireless5.ini:150-166, mqttApp2.cc:471-492).
+
+    The DES derives its OWN alive trajectory — tick-quantised f32 energy
+    from its own tx/rx bookings, the alive-gated mqttApp2 send chain run
+    natively — rather than replaying the engine's; the gate then asserts
+    the two simulators independently produce the same publish schedule,
+    the same fog choices, the same latencies AND the same final battery/
+    lifecycle state.  Contention is held at zero (w_contention=0,
+    mac_model="linear") so the delay table stays alive-independent —
+    contention-under-churn remains the documented engine-only exclusion.
+
+    Batteries are sized for fast cycling: ~18 mW net drain while
+    publishing kills a 12 mJ battery in ~0.7 s; a dead user harvests
+    back to the 50% restart threshold in ~1.5 s — several death/revival
+    cycles per user inside the 4 s horizon.  ROUND_ROBIN scheduling: the
+    gate isolates LIFECYCLE dynamics, and RR choices are view-
+    independent, so the advert-boundary staleness races that churn-
+    synchronised publish bursts systematically trigger under view-based
+    policies (a pre-existing tick-model artifact documented in
+    PARITY.md, unrelated to energy) cannot contaminate the comparison.
+    """
+    from fognetsimpp_tpu import Policy
+
+    spec, state, net, bounds = wireless.wireless5(
+        numb_users=8,
+        horizon=4.0,
+        dt=1e-4,
+        send_interval=0.1,
+        w_contention=0.0,
+        mac_model="linear",
+        policy=int(Policy.ROUND_ROBIN),
+        energy_capacity_j=0.012,
+        tx_energy_j=2e-3,
+        rx_energy_j=1e-4,
+        idle_power_w=2e-3,
+        harvest_power_w=4e-3,
+        harvest_period_s=50.0,  # harvesting throughout the horizon
+        harvest_duty=0.5,
+    )
+    final, _ = run(spec, state, net, bounds)
+    U = spec.n_users
+    alive0 = np.asarray(state.nodes.alive)[:U]
+    alive1 = np.asarray(final.nodes.alive)[:U]
+    sent = np.asarray(final.users.send_count)
+    # the engine world really churns: publishing is battery-gated (every
+    # user sends, nobody sends the full uninterrupted schedule)
+    assert (sent > 0).all()
+    assert (sent < int(spec.horizon / spec.send_interval) - 3).any(), sent
+
+    des, used = bridge.replay_engine_world(
+        spec, final, net, state0=state, bounds=bounds
+    )
+    # independently derived publish schedule matches slot-for-slot
+    eng_create = np.asarray(final.tasks.t_create, np.float64)
+    eng_used = np.isfinite(eng_create)
+    des_used = np.isfinite(des["t_create"])
+    np.testing.assert_array_equal(eng_used, des_used)
+    np.testing.assert_allclose(
+        eng_create[eng_used], des["t_create"][des_used], rtol=1e-6
+    )
+    # same decisions and same fates
+    np.testing.assert_array_equal(
+        np.asarray(final.tasks.fog)[eng_used], des["fog"][eng_used]
+    )
+    eng_stage = np.asarray(final.tasks.stage)[eng_used]
+    for st in (Stage.DONE, Stage.NO_RESOURCE, Stage.LOST, Stage.DROPPED):
+        n_e = int((eng_stage == int(st)).sum())
+        n_d = int((des["stage"][eng_used] == int(st)).sum())
+        assert abs(n_e - n_d) <= 2, (st, n_e, n_d)
+    # latency parity: completion times cover every DONE task (ack6 is
+    # +inf on BOTH sides whenever the publisher died before the relay —
+    # churn's signature — so it yields few finite samples here)
+    t0c = eng_create[eng_used]
+    for col, min_n in (("t_complete", 40), ("t_ack6", 5)):
+        e = np.asarray(getattr(final.tasks, col), np.float64)[eng_used]
+        d = des[col][eng_used]
+        both = np.isfinite(e) & np.isfinite(d)
+        assert both.sum() >= min_n, (col, both.sum())
+        rel = np.abs(
+            (e[both] - t0c[both]) - (d[both] - t0c[both])
+        ) / np.maximum(d[both] - t0c[both], 1e-9)
+        assert rel.max() < 0.01, (col, rel.max())
+        # and inf-ness itself agrees (the ack died with the user on both
+        # sides, never on only one)
+        np.testing.assert_array_equal(np.isfinite(e), np.isfinite(d))
+    # independently integrated batteries agree: same final joules (f32
+    # accounting on both sides) and the same final lifecycle state
+    np.testing.assert_allclose(
+        np.asarray(final.nodes.energy, np.float64)[:U],
+        des["user_energy"],
+        rtol=1e-5, atol=1e-7,
+    )
+    np.testing.assert_array_equal(alive1, des["user_alive"].astype(bool))
+    assert (~alive1).any() or (sent < 55).any()  # churn left a visible mark

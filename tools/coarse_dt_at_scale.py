@@ -1,0 +1,127 @@
+"""Measure the dt-staleness envelope AT THE BENCH SHAPE (VERDICT r4 item 6).
+
+tests/test_coarse_dt.py pins the envelope on a 16-user toy; this script
+runs the 10k-user/32-fog bench world at dt=1 ms (exact ordering) and
+dt=5 ms (headline staleness) with the SAME seed and reports the per-fog
+assignment histogram L1 shift plus the dt-sensitive timing observables
+(wait-to-service, completions, drops) — turning the headline's fidelity
+claim into a measurement.  (Ack event times are exact at ANY dt by
+construction; what staleness can move is WHICH fog a task goes to and
+hence queue waits — measured here.  The 0.3 s horizon lets ~3 service
+generations complete on the saturated fogs.)
+
+Usage (TPU): python tools/coarse_dt_at_scale.py
+Prints one JSON line; recorded in BENCHMARKS.md.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fognetsimpp_tpu.compile_cache import enable_compile_cache
+from fognetsimpp_tpu.core.engine import run
+from fognetsimpp_tpu.scenarios import smoke
+
+
+def build(dt, fog_mips=(1000, 2000, 3000, 4000), queue_capacity=128,
+          horizon=0.3):
+    n_users, interval = 10_000, 0.0025
+    mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
+    return smoke.build(
+        n_users=n_users, n_fogs=32,
+        fog_mips=tuple(float(m) for m in fog_mips),
+        send_interval=interval, horizon=horizon, dt=dt,
+        max_sends_per_user=int(horizon / interval) + 4,
+        max_sends_per_tick=mspt,
+        arrival_window=4096, queue_capacity=queue_capacity,
+        start_time_max=min(0.05, horizon / 4),
+    )
+
+
+def stats(dt, **kw):
+    spec, state, net, bounds = build(dt, **kw)
+
+    @jax.jit
+    def go(s):
+        final, _ = run(spec, s, net, bounds)
+        t = final.tasks
+        lat = t.t_service_start - t.t_at_fog  # queue wait at the fog
+        ok = jnp.isfinite(lat) & (t.t_service_start <= final.t)
+        per_fog = jnp.sum(
+            (t.fog[None, :] == jnp.arange(spec.n_fogs)[:, None])
+            & (t.fog >= 0)[None, :],
+            axis=1,
+        )
+        latv = jnp.where(ok, lat, 0.0)
+        return (
+            per_fog,
+            jnp.sum(latv) / jnp.maximum(jnp.sum(ok), 1),
+            jnp.sum(ok),
+            final.metrics.n_scheduled,
+            final.metrics.n_deferred_max,
+            final.metrics.n_dropped,
+            jnp.sort(jnp.where(ok, lat, jnp.inf)),
+        )
+
+    per_fog, lat_mean, n_ok, n_sched, n_def, n_drop, lat_sorted = go(state)
+    per_fog = np.asarray(per_fog, np.float64)
+    n_ok = int(n_ok)
+    ls = np.asarray(lat_sorted)[:n_ok]
+    return {
+        "per_fog": per_fog,
+        "lat_mean": float(lat_mean),
+        "lat_p50": float(ls[n_ok // 2]) if n_ok else float("nan"),
+        "lat_p95": float(ls[int(n_ok * 0.95)]) if n_ok else float("nan"),
+        "n_ok": n_ok,
+        "n_sched": int(n_sched),
+        "n_deferred_max": int(n_def),
+        "n_dropped": int(n_drop),
+    }
+
+
+def report(name, a, b):
+    tot = a["per_fog"].sum()
+    l1 = float(np.abs(a["per_fog"] / tot - b["per_fog"] / b["per_fog"].sum()).sum())
+    print(json.dumps({
+        "regime": name,
+        "shape": "10k users / 32 fogs / 0.3 s",
+        "decisions_dt1": a["n_sched"], "decisions_dt5": b["n_sched"],
+        "assign_l1_shift": round(l1, 5),
+        "wait_mean_dt1_s": round(a["lat_mean"], 6),
+        "wait_mean_dt5_s": round(b["lat_mean"], 6),
+        "wait_mean_delta_pct": round(
+            100 * (b["lat_mean"] - a["lat_mean"])
+            / max(a["lat_mean"], 1e-12), 3),
+        "wait_p95_dt1_s": round(a["lat_p95"], 6),
+        "wait_p95_dt5_s": round(b["lat_p95"], 6),
+        "served_dt1": a["n_ok"], "served_dt5": b["n_ok"],
+        "dropped_dt1": a["n_dropped"], "dropped_dt5": b["n_dropped"],
+        "n_deferred_max": max(a["n_deferred_max"], b["n_deferred_max"]),
+    }))
+
+
+def main():
+    enable_compile_cache()
+    # The north-star world is inherently saturated (10k users publishing
+    # every 2.5 ms vs 32 fogs serving ~0.2-0.9 s tasks): the envelope
+    # observables are WHICH fog tasks go to, how many drop, and the
+    # queue waits of the genuinely-served population.  0.3 s captures
+    # the split/drop picture; 1.0 s lets each fog cycle a few services
+    # so the wait distribution is populated.  (A "served regime" at this
+    # shape does not exist: service capacity is ~1e3x under the offered
+    # load by construction — that IS the benchmark.)
+    report("saturated-0.3s", stats(1e-3), stats(5e-3))
+    report(
+        "saturated-1.0s-waits",
+        stats(1e-3, horizon=1.0),
+        stats(5e-3, horizon=1.0),
+    )
+
+
+if __name__ == "__main__":
+    main()
