@@ -86,6 +86,9 @@ def main() -> None:
         max_sends_per_tick=mspt,
         queue_capacity=128,
         start_time_max=min(0.05, horizon / 4),
+        # ack columns reconstructed once post-run (bit-exact; r5): the
+        # per-tick scatters they cost are ~25 us each on the v5e
+        derive_acks=True,
     )
     # default window: the K=4096 O(K^2)-rank sweet spot — warm-up
     # overflow defers to later windows (counted in n_deferred) and

@@ -116,17 +116,16 @@ def config3():
 def config4(R: int = None, horizon: float = None):
     """10k-node mobile-handover world, ENERGY_AWARE, replica fan-out.
 
-    The BASELINE.json-stated scale is "10k nodes, 1k replicas".  Measured
-    bound (r4, tunneled v5e chip): the run succeeds at R=128 (~1.4 GB of
-    replicated state) but R >= 256 crashes the tunnel's TPU worker
-    process outright — NOT a clean XLA OOM; the HBM arithmetic (~11 MB/
-    replica at a 0.5 s publish horizon) says ~1k replicas would fit a
-    healthy 16 GB chip, and the 1k-replica sharding path itself is
-    validated on the 8-device virtual mesh (`parallel.run_sharded`,
-    `__graft_entry__.dryrun_multichip`).  CONFIG4_R / CONFIG4_HORIZON
-    override the defaults; the recorded BENCHMARKS.md row is R=128.
-    Pipeline depth 1: a run is ~30 s of device time, so the ~0.1 s
-    tunnel overhead is already amortized.
+    The BASELINE.json-stated scale is "10k nodes, 1k replicas" — r5
+    delivers it (4 x 250-replica chunks, one compile; BENCHMARKS.md
+    row 4).  History: r4's run crashed the tunnel's TPU worker at
+    R >= 256 — diagnosed in r5 as the classic arrival front-end's
+    (F,T) fast-drop matmuls, whose vmap-expanded intermediates blew up
+    under the replica axis; with the two-stage front-end R=512 runs
+    monolithically and R=1000 fails as an ordinary RESOURCE_EXHAUSTED,
+    which the chunking sidesteps.  CONFIG4_R / CONFIG4_HORIZON /
+    CONFIG4_CHUNK override the defaults.  Pipeline depth 1: a run is
+    ~30 s of device time, so the ~0.1 s tunnel overhead is amortized.
     """
     import os
 
@@ -162,23 +161,45 @@ def config4(R: int = None, horizon: float = None):
     spec, state, net, bounds = wireless.wireless5(
         arrival_window=spec0.auto_arrival_window, **kw
     )
-    batch = replicate_state(spec, state, R, seed=0)
-
     def final(s):
         fs = run(spec, s, net, bounds)[0]
         return fs.metrics, jnp.sum(fs.nodes.alive.astype(jnp.int32))
 
+    # the stated 1k-replica scale runs as sequential chunks under ONE
+    # compile (identical shapes; CONFIG4_CHUNK overrides).  r5 bisect:
+    # the r4 worker crash at R>=256 was the classic front-end's (F,T)
+    # fast-drop matmuls (vmap-expanded intermediates); with the two-stage
+    # front-end R=512 runs monolithically and R=1000 fails as an
+    # ordinary RESOURCE_EXHAUSTED — hence chunks (BENCHMARKS.md row 4)
+    import time as _time
+
+    chunk = min(R, int(os.environ.get("CONFIG4_CHUNK", 250)))
+    n_chunks = -(-R // chunk)
+    R = chunk * n_chunks  # actual simulated count (exact when chunk | R)
+    batch = replicate_state(spec, state, chunk, seed=0)
     go = jax.jit(lambda b: jax.vmap(final)(b))
-    f, wall, dec, n_pipe = _timed(
-        go, batch,
-        lambda b, i: b.replace(key=jax.random.split(jax.random.PRNGKey(i), R)),
-        n_pipeline=1,
-    )
-    _emit(f"4:10k-mobile-energy-{R}rep", wall, dec, spec.n_ticks * R * n_pipe,
-          {"replicas": R,
-           "arrival_window": spec.window,
-           "n_deferred_max": int(np.asarray(f[0].n_deferred_max).max()),
-           "alive_min": int(np.asarray(f[1]).min())})
+    go(batch)[0].n_scheduled.block_until_ready()  # compile once
+    t0 = _time.perf_counter()
+    dec = 0
+    ndm, alive_min = 0, 10**9
+    for c in range(n_chunks):
+        b = batch.replace(
+            key=jax.random.split(jax.random.PRNGKey(1000 + c), chunk)
+        )
+        f = go(b)
+        dec += int(np.asarray(f[0].n_scheduled).sum())
+        ndm = max(ndm, int(np.asarray(f[0].n_deferred_max).max()))
+        alive_min = min(alive_min, int(np.asarray(f[1]).min()))
+    wall = _time.perf_counter() - t0
+    _emit(
+        f"4:10k-mobile-energy-{R}rep", wall, dec,
+        spec.n_ticks * chunk * n_chunks,
+        {"replicas": R,
+         "chunk": chunk,
+         "n_chunks": n_chunks,
+         "arrival_window": spec.window,
+         "n_deferred_max": ndm,
+         "alive_min": alive_min})
 
 
 def config5(dynamic: bool = False, n_users: int = 10_000,

@@ -219,6 +219,32 @@ def _rot_and_defer(
     return rot, state
 
 
+
+def offered_rate_vector(spec: WorldSpec, alive_u, users, t0) -> jax.Array:
+    """Per-node offered frame rate (frames/s) for the Bianchi contention
+    keying: a user's publish rate while it is actively publishing, zero
+    otherwise.  SHARED between the engine's tick (below) and the native
+    DES's delay-table chain (native/bridge.py) — the two must stay
+    bit-identical or wireless parity silently breaks."""
+    publishing = (
+        alive_u
+        & users.connected
+        & users.publisher
+        & (users.send_count < spec.max_sends_per_user)
+        & jnp.isfinite(users.next_send)
+    )
+    if spec.send_stop_time != float("inf"):
+        publishing = publishing & (t0 < spec.send_stop_time)
+    return jnp.concatenate(
+        [
+            jnp.where(publishing, 1.0 / users.send_interval, 0.0).astype(
+                jnp.float32
+            ),
+            jnp.zeros((spec.n_nodes - spec.n_users,), jnp.float32),
+        ]
+    )
+
+
 # ----------------------------------------------------------------------
 # phases
 # ----------------------------------------------------------------------
@@ -849,10 +875,13 @@ def _phase_broker_dense(
         t_at_fog=jnp.where(
             sched2, tab2 + d_bf_c, tasks.t_at_fog.reshape(U, S)
         ).reshape(T),
-        t_ack4_fwd=jnp.where(
-            mask2, tab2 + d_bu[:, None], tasks.t_ack4_fwd.reshape(U, S)
-        ).reshape(T),
     )
+    if not spec.derive_acks:  # else reconstructed post-run (run())
+        tasks = tasks.replace(
+            t_ack4_fwd=jnp.where(
+                mask2, tab2 + d_bu[:, None], tasks.t_ack4_fwd.reshape(U, S)
+            ).reshape(T),
+        )
     sums = jnp.sum(
         jnp.stack([sched2, no_res2, rejected2, mask2]).astype(i32),
         axis=(1, 2),
@@ -1094,13 +1123,16 @@ def _phase_broker(
         t_at_fog=tasks.t_at_fog.at[idx].set(
             jnp.where(sched, t_ab_g + d_bf, jnp.inf), mode="drop"
         ),
-        t_ack4_fwd=tasks.t_ack4_fwd.at[idx].set(
-            jnp.where(~local, t_ab_g + d_bu, jnp.inf), mode="drop"
-        ),
-        t_ack3=tasks.t_ack3.at[idx].set(
-            jnp.where(local, t_ab_g + d_bu, jnp.inf), mode="drop"
-        ),
     )
+    if not spec.derive_acks:  # else reconstructed post-run (run())
+        tasks = tasks.replace(
+            t_ack4_fwd=tasks.t_ack4_fwd.at[idx].set(
+                jnp.where(~local, t_ab_g + d_bu, jnp.inf), mode="drop"
+            ),
+            t_ack3=tasks.t_ack3.at[idx].set(
+                jnp.where(local, t_ab_g + d_bu, jnp.inf), mode="drop"
+            ),
+        )
     if local_first:
         tasks = tasks.replace(
             t_service_start=tasks.t_service_start.at[idx].set(
@@ -1183,12 +1215,16 @@ def _phase_completions(
     )
 
     tasks = tasks.replace(
-        stage=tasks.stage.at[done_task].set(jnp.int8(int(Stage.DONE)), mode="drop"),
         t_complete=tasks.t_complete.at[done_task].set(
             jnp.where(comp, t_done, 0), mode="drop"
         ),
-        t_ack6=tasks.t_ack6.at[done_task].set(jnp.where(comp, t_ack6, 0), mode="drop"),
     )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            t_ack6=tasks.t_ack6.at[done_task].set(
+                jnp.where(comp, t_ack6, 0), mode="drop"
+            ),
+        )
     # busyTime -= currentTask.requiredTime (== its tskTime, set at accept:
     # ComputeBrokerApp3.cc:296,232)
     busy_time = jnp.where(comp, fogs.busy_time - svc_done, fogs.busy_time)
@@ -1198,20 +1234,34 @@ def _phase_completions(
     promoted = comp & (head != NO_TASK)
     head_c = jnp.clip(head, 0, spec.task_capacity - 1)
     svc_new = _svc_time(spec, tasks.mips_req[head_c], fogs.mips)
+    # ONE stage scatter for completed + promoted rows (disjoint index
+    # sets; two separate scatters cost ~25 us each on the v5e)
+    scat_stage = jnp.concatenate(
+        [done_task, jnp.where(promoted, head, spec.task_capacity)]
+    )
+    stage_vals = jnp.concatenate(
+        [
+            jnp.full((F,), jnp.int8(int(Stage.DONE))),
+            jnp.full((F,), jnp.int8(int(Stage.RUNNING))),
+        ]
+    )
     tasks = tasks.replace(
-        stage=tasks.stage.at[jnp.where(promoted, head, spec.task_capacity)].set(
-            jnp.int8(int(Stage.RUNNING)), mode="drop"
-        ),
+        stage=tasks.stage.at[scat_stage].set(stage_vals, mode="drop"),
         t_service_start=tasks.t_service_start.at[
             jnp.where(promoted, head, spec.task_capacity)
         ].set(jnp.where(comp, t_done, 0), mode="drop"),
-        queue_time_ms=tasks.queue_time_ms.at[
-            jnp.where(promoted, head, spec.task_capacity)
-        ].set(
-            jnp.where(promoted, (t_done - tasks.t_q_enter[head_c]) * 1e3, 0),
-            mode="drop",
-        ),
     )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            queue_time_ms=tasks.queue_time_ms.at[
+                jnp.where(promoted, head, spec.task_capacity)
+            ].set(
+                jnp.where(
+                    promoted, (t_done - tasks.t_q_enter[head_c]) * 1e3, 0
+                ),
+                mode="drop",
+            ),
+        )
     fogs = fogs.replace(
         busy_time=busy_time,
         current_task=jnp.where(comp, jnp.where(promoted, head, NO_TASK), fogs.current_task),
@@ -1529,14 +1579,21 @@ def _fog_arrivals_tail(
     d_bu_a = cache.d2b[a_taskc // spec.max_sends_per_user]
     t_ack5 = t_start + d_fb + d_bu_a
 
+    # (no stage scatter here: every assigned head is inside the window,
+    # and the window's stage_k write below already maps assigned_row ->
+    # RUNNING — the r1-r4 double write was a redundant ~25 us scatter)
     scat_a = jnp.where(assigned, a_task, T)
     tasks = tasks.replace(
-        stage=tasks.stage.at[scat_a].set(jnp.int8(int(Stage.RUNNING)), mode="drop"),
         t_service_start=tasks.t_service_start.at[scat_a].set(
             jnp.where(assigned, t_start, 0), mode="drop"
         ),
-        t_ack5=tasks.t_ack5.at[scat_a].set(jnp.where(assigned, t_ack5, 0), mode="drop"),
     )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            t_ack5=tasks.t_ack5.at[scat_a].set(
+                jnp.where(assigned, t_ack5, 0), mode="drop"
+            ),
+        )
     fogs = fogs.replace(
         current_task=jnp.where(assigned, a_task, fogs.current_task),
         busy_until=jnp.where(assigned, t_start + svc_a, fogs.busy_until),
@@ -1553,8 +1610,8 @@ def _fog_arrivals_tail(
     d_bu_q = cache.d2b[user_g]
     d_fb_q = d_fb[fog_gc]
     # no gather needed for the keep-stage case: every valid row was
-    # TASK_INFLIGHT by mask construction, except the freshly assigned
-    # head (already written RUNNING above), which must stay RUNNING
+    # TASK_INFLIGHT by mask construction; the assigned head gets its
+    # RUNNING stage HERE (assigned_row branch) — this is its only write
     assigned_row = arr & (idx == a_task[fog_gc])
     stage_k = jnp.where(
         enq_ok,
@@ -1574,10 +1631,14 @@ def _fog_arrivals_tail(
         t_q_enter=tasks.t_q_enter.at[idx].set(
             jnp.where(enq_ok, t_af_g, jnp.inf), mode="drop"
         ),
-        t_ack4_queued=tasks.t_ack4_queued.at[idx].set(
-            jnp.where(enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf), mode="drop"
-        ),
     )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            t_ack4_queued=tasks.t_ack4_queued.at[idx].set(
+                jnp.where(enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf),
+                mode="drop",
+            ),
+        )
     fogs = fogs.replace(queue=queue, q_len=q_len, q_drops=fogs.q_drops + dropped)
     # every live arrival is a fog rx + one ack (assigned/queued) relayed
     # through the broker to the user
@@ -1943,30 +2004,13 @@ def make_step(
             state = state.replace(nodes=nodes)
             # Bianchi worlds key MAC contention on each cell's OFFERED
             # LOAD (DCF contends among stations with queued frames, not
-            # associated-but-idle ones — VERDICT r4 item 2): per-node
-            # frame rate while the user is actively publishing, solved
-            # to an effective contender count inside associate()
+            # associated-but-idle ones — VERDICT r4 item 2), solved to
+            # an effective contender count inside associate()
             offered = None
             if net.mac_loss_tab.shape[0] > 0:
-                u = state.users
-                publishing = (
-                    state.nodes.alive[: spec.n_users]
-                    & u.connected
-                    & u.publisher
-                    & (u.send_count < spec.max_sends_per_user)
-                    & jnp.isfinite(u.next_send)
-                )
-                if spec.send_stop_time != float("inf"):
-                    publishing = publishing & (t0 < spec.send_stop_time)
-                offered = jnp.concatenate(
-                    [
-                        jnp.where(
-                            publishing, 1.0 / u.send_interval, 0.0
-                        ).astype(jnp.float32),
-                        jnp.zeros(
-                            (spec.n_nodes - spec.n_users,), jnp.float32
-                        ),
-                    ]
+                offered = offered_rate_vector(
+                    spec, state.nodes.alive[: spec.n_users],
+                    state.users, t0,
                 )
             cache = associate(
                 net, state.nodes.pos, state.nodes.alive,
@@ -2145,6 +2189,57 @@ def make_step(
     return step
 
 
+def _finalize_derived_acks(
+    spec: WorldSpec, state: WorldState, cache: LinkCache
+) -> WorldState:
+    """Reconstruct the ack columns skipped under ``spec.derive_acks``.
+
+    One dense pass after the scan, with the SAME float32 arithmetic (and
+    operand order) the per-tick phases use, over the same static delay
+    cache — bit-exact vs the eager writes (tests/test_runtime.py).
+    """
+    t = state.tasks
+    U, S, F, T = (
+        spec.n_users, spec.max_sends_per_user, spec.n_fogs,
+        spec.task_capacity,
+    )
+    d_bu = cache.d2b[:U][:, None]  # (U, 1) broadcast over the send axis
+    d_bf = (
+        cache.d2b[U + jnp.clip(t.fog, 0, F - 1)].reshape(U, S)
+        if F > 0
+        else jnp.zeros((U, S), jnp.float32)
+    )
+    st2 = t.stage.reshape(U, S)
+    qe2 = t.t_q_enter.reshape(U, S)
+    ss2 = t.t_service_start.reshape(U, S)
+    decided = (
+        (st2 != jnp.int8(int(Stage.UNUSED)))
+        & (st2 != jnp.int8(int(Stage.PUB_INFLIGHT)))
+        & (st2 != jnp.int8(int(Stage.LOST)))
+    )
+    queued = jnp.isfinite(qe2)
+    assigned = jnp.isfinite(ss2) & ~queued
+    done = st2 == jnp.int8(int(Stage.DONE))
+    inf = jnp.inf
+    return state.replace(
+        tasks=t.replace(
+            t_ack4_fwd=jnp.where(
+                decided, t.t_at_broker.reshape(U, S) + d_bu, inf
+            ).reshape(T),
+            t_ack4_queued=jnp.where(
+                queued, qe2 + d_bf + d_bu, inf
+            ).reshape(T),
+            t_ack5=jnp.where(assigned, ss2 + d_bf + d_bu, inf).reshape(T),
+            t_ack6=jnp.where(
+                done, t.t_complete.reshape(U, S) + d_bf + d_bu, inf
+            ).reshape(T),
+            queue_time_ms=jnp.where(
+                queued & jnp.isfinite(ss2), (ss2 - qe2) * 1e3, inf
+            ).reshape(T),
+        )
+    )
+
+
 def run(
     spec: WorldSpec,
     state: WorldState,
@@ -2206,6 +2301,8 @@ def run(
         return s, out
 
     final, series = jax.lax.scan(body, state, None, length=n)
+    if spec.derive_acks:
+        final = _finalize_derived_acks(spec, final, static_cache)
     return final, series
 
 

@@ -289,24 +289,10 @@ def delay_table(spec, state0, net, bounds=None, n_ticks=None) -> np.ndarray:
         nodes = nodes.replace(pos=pos, vel=vel)
         offered = None
         if keyed:
-            # mirror the engine's offered-rate vector exactly
-            publishing = (
-                nodes.alive[:U]
-                & users.connected
-                & users.publisher
-                & (users.send_count < S)
-                & jnp.isfinite(users.next_send)
-            )
-            if spec.send_stop_time != float("inf"):
-                publishing = publishing & (t0 < spec.send_stop_time)
-            offered = jnp.concatenate(
-                [
-                    jnp.where(
-                        publishing, 1.0 / users.send_interval, 0.0
-                    ).astype(jnp.float32),
-                    jnp.zeros((rest,), jnp.float32),
-                ]
-            )
+            # the engine's own helper: bit-identical by construction
+            from ..core.engine import offered_rate_vector
+
+            offered = offered_rate_vector(spec, nodes.alive[:U], users, t0)
         cache = associate(
             net, nodes.pos, nodes.alive, broker=spec.broker_index,
             offered_rate=offered,

@@ -647,8 +647,10 @@ struct World {
 
   long run_user_energy() {
     long n_events = 0;
-    // the engine runs spec.n_ticks = round(horizon / dt) ticks
-    int n_ticks = static_cast<int>(std::lround(p.horizon / p.e_dt));
+    // the engine runs spec.n_ticks = round(horizon / dt) ticks;
+    // Python round() is half-to-even = nearbyint under the default
+    // rounding mode (lround would round half away from zero)
+    int n_ticks = static_cast<int>(std::nearbyint(p.horizon / p.e_dt));
     float dtf = static_cast<float>(p.e_dt);
     for (int k = 0; k < n_ticks; ++k) {
       // f32 tick boundaries, exactly the engine's

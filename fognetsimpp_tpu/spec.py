@@ -218,6 +218,18 @@ class WorldSpec:
     # per-user candidate slots for the two-stage front-end; None derives
     # max_sends_per_tick (+1 slack when mobility can bunch arrivals)
     arrival_cands_per_user: Optional[int] = None
+    # r5 perf: skip the per-tick writes of the five ack-timestamp columns
+    # and queue_time_ms (each a ~25 us scatter or a full-column select)
+    # and reconstruct them ONCE after the scan from the hot columns —
+    # t_ack4_fwd = t_at_broker + d_bu, t_ack4_queued = t_q_enter + d_fb
+    # + d_bu, t_ack5 = t_service_start + d_fb + d_bu (assigned rows),
+    # t_ack6 = t_complete + d_fb + d_bu, queue_time = service_start -
+    # q_enter: identical float arithmetic in the same order, so the
+    # reconstruction is bit-exact (tests/test_runtime.py A/Bs it).
+    # Requires delays the decision tick and the end of the run agree on:
+    # assume_static (constant cache), no DropTail backpressure, FIFO fog
+    # model, and no broker-local branch (t_ack3 is v1-only).
+    derive_acks: bool = False
     required_time: float = 0.01  # mqttApp2.cc:372
     task_bytes: int = 128  # mqttApp2.cc:379
     fixed_mips_required: Optional[int] = None  # v1: 100 (mqttApp.cc:330)
@@ -435,6 +447,17 @@ class WorldSpec:
         assert self.max_sends_per_tick >= 1
         if self.arrival_cands_per_user is not None:
             assert self.arrival_cands_per_user >= 1
+        if self.derive_acks:
+            assert (
+                self.assume_static
+                and not self.wired_queue_enabled
+                and self.fog_model == int(FogModel.FIFO)
+                and self.policy != int(Policy.LOCAL_FIRST)
+            ), (
+                "derive_acks reconstructs ack columns from one static "
+                "delay cache: needs assume_static, no DropTail, FIFO "
+                "fogs and no broker-local branch"
+            )
         if self.max_sends_per_tick > 1:
             assert self.send_interval_jitter == 0.0, (
                 "the closed-form multi-send spawn needs deterministic "

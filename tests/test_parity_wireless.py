@@ -221,4 +221,3 @@ def test_wireless5_energy_churn_has_a_baseline():
         rtol=1e-5, atol=1e-7,
     )
     np.testing.assert_array_equal(alive1, des["user_alive"].astype(bool))
-    assert (~alive1).any() or (sent < 55).any()  # churn left a visible mark

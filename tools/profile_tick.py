@@ -48,6 +48,7 @@ def build(n_users: int, dt: float = 1e-3):
         arrival_window=max(1024, int(1.15 * n_users * dt / interval)),
         queue_capacity=128,
         start_time_max=min(0.025, horizon / 4),
+        derive_acks=True,  # the bench configuration (r5)
     )
 
 
