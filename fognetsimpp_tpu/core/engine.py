@@ -1314,9 +1314,9 @@ def _phase_fog_arrivals(
     Two front-ends produce the compacted arrival window (r5 perf):
     ``spec.two_stage_arrivals`` selects the per-user candidate reduction
     over the (U, S) task-table view (:func:`_fog_arrivals_front_two_stage`)
-    instead of the classic full-table compaction — same decisions, ~20x
-    fewer bytes at bench shapes; the shared tail does the assignment,
-    queueing and ack bookkeeping either way.
+    instead of the classic full-table compaction — same decisions with
+    the (F,T) matmuls and T-compaction gone; the shared tail does the
+    assignment, queueing and ack bookkeeping either way.
     """
     if spec.two_stage_arrivals:
         return _fog_arrivals_front_two_stage(spec, state, net, cache, buf, t1)
@@ -1429,8 +1429,8 @@ def _fog_arrivals_front_two_stage(
 
     The saturated-fog fast drop happens on the candidate list: per-fog
     tail-drop sums become one (F, U*R) membership GEMM instead of the
-    classic front-end's (F, T) matmuls — the r4 bandwidth hot spot
-    (857 MB/tick -> the (F,T) passes alone were ~200 MB of it).
+    classic front-end's (F, T) matmuls (~44x smaller at the bench
+    shape; with them went r4's replica-fan-out worker crash).
     """
     tasks, fogs = state.tasks, state.fogs
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window

@@ -212,8 +212,10 @@ class WorldSpec:
     # same decisions whenever at most R tasks per user mature per tick
     # (always, at dt <= send_interval with R >= max_sends_per_tick);
     # excess matured tasks defer one tick exactly like window overflow
-    # (Metrics.n_deferred).  ~20x fewer bytes/tick at the 10k bench
-    # shape; tests/test_compaction.py A/Bs the two paths bit-for-bit.
+    # (Metrics.n_deferred).  Removes the (F,T) fast-drop matmuls and the
+    # T-sized compaction (~100 MB + 200 MFLOP of the tick's cost
+    # analysis at the 10k bench shape, and the r4 replica-fan-out crash
+    # with them); tests/test_compaction.py A/Bs the paths bit-for-bit.
     two_stage_arrivals: bool = True
     # per-user candidate slots for the two-stage front-end; None derives
     # max_sends_per_tick (+1 slack when mobility can bunch arrivals)
