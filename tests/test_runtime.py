@@ -270,3 +270,33 @@ def test_derive_acks_reconstruction_is_bit_exact():
             np.asarray(getattr(f_der.tasks, col)),
             err_msg=col,
         )
+
+
+def test_derive_acks_with_chunked_run_matches_single_scan():
+    """run_chunked calls run() per chunk, so the derived ack columns are
+    written at every chunk boundary from partial state; the final
+    chunk's reconstruction must still equal the single-scan result (the
+    derivation is a pure function of the hot columns, so intermediate
+    writes are benign overwrites)."""
+    import numpy as np
+
+    from fognetsimpp_tpu import run
+    from fognetsimpp_tpu.core.engine import run_chunked
+    from fognetsimpp_tpu.scenarios import smoke
+
+    kw = dict(
+        horizon=0.5, send_interval=0.004, dt=1e-3, n_users=32, n_fogs=3,
+        fog_mips=(800.0, 1600.0, 2400.0), queue_capacity=8,
+        start_time_max=0.01, derive_acks=True,
+    )
+    spec, state, net, bounds = smoke.build(**kw)
+    f_one, _ = run(spec, state, net, bounds)
+    spec2, state2, net2, bounds2 = smoke.build(**kw)
+    f_chunk = run_chunked(spec2, state2, net2, bounds2, chunk_ticks=120)
+    for col in ("stage", "t_ack4_fwd", "t_ack4_queued", "t_ack5",
+                "t_ack6", "queue_time_ms"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(f_one.tasks, col)),
+            np.asarray(getattr(f_chunk.tasks, col)),
+            err_msg=col,
+        )

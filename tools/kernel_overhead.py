@@ -1,0 +1,61 @@
+"""Measure the per-kernel overhead of a scan body on this TPU (r5).
+
+The r5 finding: the dt=1 ms tick floor (~0.79 ms) is flat in table size,
+i.e. per-op overhead, not bytes.  This microbench calibrates that
+constant: a lax.scan whose body is a chain of N deliberately unfusable
+ops (each a scatter touching a distinct buffer region — XLA cannot merge
+them) timed by the two-length difference quotient.  ms/tick divided by N
+estimates the per-kernel cost the engine's ~100-op tick pays.
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp, numpy as np
+from fognetsimpp_tpu.compile_cache import enable_compile_cache
+
+N_LO, N_HI = 200, 1000
+
+
+def chain(n_ops, size=440_000, k=4096):
+    """Scan body = n_ops sequential K-index scatters into a (size,) buf."""
+    idx0 = jnp.arange(k, dtype=jnp.int32) * (size // k)
+
+    def body(carry, t):
+        buf = carry
+        for j in range(n_ops):
+            buf = buf.at[(idx0 + j) % size].add(1.0)
+        return buf, ()
+
+    def run(n_ticks):
+        @jax.jit
+        def go(b):
+            out, _ = jax.lax.scan(body, b, jnp.arange(n_ticks))
+            return jnp.sum(out)
+        return go
+
+    b0 = jnp.zeros((size,), jnp.float32)
+    lo, hi = run(N_LO), run(N_HI)
+
+    def wall(fn):
+        np.asarray(fn(b0))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(fn(b0))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    w_lo, w_hi = wall(lo), wall(hi)
+    ms = (w_hi - w_lo) / (N_HI - N_LO) * 1e3
+    return ms
+
+
+def main():
+    enable_compile_cache()
+    for n_ops in (1, 8, 32, 64):
+        ms = chain(n_ops)
+        print(f"n_ops={n_ops:3d}: {ms:7.4f} ms/tick  "
+              f"({ms / n_ops * 1e3:6.1f} us/op)")
+
+
+if __name__ == "__main__":
+    main()
