@@ -155,7 +155,14 @@ def main(argv=None) -> int:
                           "dynamic": dynamic, "scenario": name}))
         return 0
 
-    spec, state, net, bounds = build_from_config(cfg, seed=args.seed)
+    try:
+        spec, state, net, bounds = build_from_config(cfg, seed=args.seed)
+    except ValueError as e:
+        # e.g. an .ini referencing an unknown scenario/network name: a
+        # one-line actionable error (listing the known names), not a
+        # traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     t0 = time.perf_counter()
     if args.progress:
         if args.ticks or args.trails:

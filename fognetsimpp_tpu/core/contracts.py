@@ -1,0 +1,238 @@
+"""Trace-time shape/dtype contracts on the engine's phase pipeline.
+
+The OMNeT++ reference leans on its type system and nedtool codegen to keep
+message schemas and scheduler state honest; the batched engine's analog is
+the *carry contract*: every phase, and the whole tick step, must be an
+endomorphism over the :class:`~fognetsimpp_tpu.state.WorldState` /
+:class:`~fognetsimpp_tpu.core.engine.TickBuf` pytrees — same tree
+structure, same shapes, same dtypes.  A phase that silently promotes a
+carry leaf (int8 stage -> int32, f32 timestamp -> f64) would not crash:
+under `lax.scan` it triggers a carry-mismatch error at best and a silent
+recompile-per-tick on TPU at worst.  Checking the contract via
+:func:`jax.eval_shape` costs a CPU trace (no FLOPs, no device buffers), so
+promotion bugs fail in seconds in tier-1 instead of minutes into a TPU
+run.
+
+This is the trace-time half of the ``simlint`` static pass (rule R8,
+``tools/simlint/RULES.md``): the AST side checks that every
+``_phase_*`` function in the engine is registered in
+:data:`PHASE_CONTRACTS`; the functions here actually trace them.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..spec import FogModel, WorldSpec
+
+
+class ContractError(AssertionError):
+    """A pytree violated its declared shape/dtype contract."""
+
+
+def _leaf_struct(x) -> Tuple[tuple, str]:
+    return (tuple(x.shape), np.dtype(x.dtype).name)
+
+
+def assert_same_struct(expected, got, what: str = "pytree") -> None:
+    """Raise :class:`ContractError` unless ``got`` has exactly the tree
+    structure, shapes and dtypes of ``expected`` (weak-type flags are
+    ignored: weak f32 and strong f32 lower identically)."""
+    exp_paths, exp_def = jax.tree_util.tree_flatten_with_path(expected)
+    got_paths, got_def = jax.tree_util.tree_flatten_with_path(got)
+    if exp_def != got_def:
+        raise ContractError(
+            f"{what}: tree structure changed\n"
+            f"  expected: {exp_def}\n  got:      {got_def}"
+        )
+    errs = []
+    for (path, e), (_, g) in zip(exp_paths, got_paths):
+        se, sg = _leaf_struct(e), _leaf_struct(g)
+        if se != sg:
+            errs.append(
+                f"  {jax.tree_util.keystr(path)}: expected "
+                f"{se[1]}{list(se[0])}, got {sg[1]}{list(sg[0])}"
+            )
+    if errs:
+        raise ContractError(
+            f"{what}: {len(errs)} leaf contract violation(s)\n"
+            + "\n".join(errs)
+        )
+
+
+def _zero_buf(spec: WorldSpec):
+    from .engine import TickBuf
+
+    i32 = jnp.int32
+    return TickBuf(
+        tx_u=jnp.zeros((spec.n_users,), i32),
+        rx_u=jnp.zeros((spec.n_users,), i32),
+        tx_f=jnp.zeros((spec.n_fogs,), i32),
+        rx_f=jnp.zeros((spec.n_fogs,), i32),
+        tx_b=jnp.zeros((), i32),
+        rx_b=jnp.zeros((), i32),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseContract:
+    """One engine phase + how to invoke it for a shape-only trace.
+
+    ``call(spec, state, net, cache, buf, t0, t1)`` returns the phase's
+    raw result (``state`` or ``(state, buf[, extra])``); ``when`` gates
+    phases that only trace under certain static specs (e.g. the dense
+    broker path).
+    """
+
+    name: str
+    call: Callable
+    when: Callable[[WorldSpec], bool] = lambda spec: True
+
+
+def _contracts() -> Tuple[PhaseContract, ...]:
+    from . import engine as E
+
+    fifo = lambda sp: sp.n_fogs > 0 and sp.fog_model == int(FogModel.FIFO)
+    return (
+        PhaseContract(
+            "_phase_connect",
+            lambda sp, s, n, c, b, t0, t1: E._phase_connect(
+                sp, s, n, c, b, t0, t1
+            ),
+        ),
+        PhaseContract(
+            "_phase_adverts",
+            lambda sp, s, n, c, b, t0, t1: E._phase_adverts(s, t1),
+        ),
+        PhaseContract(
+            "_phase_spawn",
+            lambda sp, s, n, c, b, t0, t1: E._phase_spawn(
+                sp, s, n, c, b, t0, t1
+            ),
+        ),
+        PhaseContract(
+            "_phase_spawn_multi",
+            lambda sp, s, n, c, b, t0, t1: E._phase_spawn_multi(
+                sp, s, n, c, b, t0, t1
+            ),
+            when=lambda sp: sp.max_sends_per_tick > 1,
+        ),
+        PhaseContract(
+            "_phase_v2_release",
+            lambda sp, s, n, c, b, t0, t1: E._phase_v2_release(
+                sp, s, n, c, b, t1, before_broker=True
+            ),
+        ),
+        PhaseContract(
+            "_phase_broker",
+            lambda sp, s, n, c, b, t0, t1: E._phase_broker(
+                sp, s, n, c, b, t1
+            )[:2],
+        ),
+        PhaseContract(
+            "_phase_broker_dense",
+            lambda sp, s, n, c, b, t0, t1: E._phase_broker_dense(
+                sp, s, n, c, b, t1
+            ),
+            when=E._broker_dense_ok,
+        ),
+        PhaseContract(
+            "_phase_completions",
+            lambda sp, s, n, c, b, t0, t1: E._phase_completions(
+                sp, s, n, c, b, t1
+            ),
+            when=fifo,
+        ),
+        PhaseContract(
+            "_phase_fog_arrivals",
+            lambda sp, s, n, c, b, t0, t1: E._phase_fog_arrivals(
+                sp, s, n, c, b, t1
+            ),
+            when=fifo,
+        ),
+        PhaseContract(
+            "_phase_pool_completions",
+            lambda sp, s, n, c, b, t0, t1: E._phase_pool_completions(
+                sp, s, n, c, b, t1
+            ),
+            when=lambda sp: sp.n_fogs > 0,
+        ),
+        PhaseContract(
+            "_phase_pool_arrivals",
+            lambda sp, s, n, c, b, t0, t1: E._phase_pool_arrivals(
+                sp, s, n, c, b, t1
+            ),
+            when=lambda sp: sp.n_fogs > 0,
+        ),
+        PhaseContract(
+            "_phase_local_completions",
+            lambda sp, s, n, c, b, t0, t1: E._phase_local_completions(
+                sp, s, n, c, b, t1
+            ),
+        ),
+        PhaseContract(
+            "_phase_periodic_adverts",
+            lambda sp, s, n, c, b, t0, t1: E._phase_periodic_adverts(
+                sp, s, n, c, t0, t1
+            ),
+        ),
+    )
+
+
+# The registry simlint R8 checks engine `_phase_*` definitions against.
+# Adding a phase to core/engine.py without registering it here is a lint
+# failure; registering it without a passing eval_shape trace is a tier-1
+# test failure (tests/test_contracts.py).
+PHASE_CONTRACTS: Tuple[PhaseContract, ...] = _contracts()
+
+
+def check_phase_contracts(spec: WorldSpec, state, net) -> Tuple[str, ...]:
+    """eval_shape every phase applicable under ``spec``; raise
+    :class:`ContractError` on any carry-structure change.  Returns the
+    names of the phases actually checked."""
+    from ..net.topology import associate
+
+    checked = []
+    for pc in PHASE_CONTRACTS:
+        if not pc.when(spec):
+            continue
+
+        def trace(s, _call=pc.call):
+            cache = associate(
+                net, s.nodes.pos, s.nodes.alive, broker=spec.broker_index
+            )
+            buf = _zero_buf(spec)
+            t0 = jnp.float32(0.0)
+            t1 = jnp.float32(spec.dt)
+            return _call(spec, s, net, cache, buf, t0, t1)
+
+        out = jax.eval_shape(trace, state)
+        new_state = out[0] if isinstance(out, tuple) else out
+        assert_same_struct(state, new_state, what=f"{pc.name}: WorldState")
+        if isinstance(out, tuple) and len(out) >= 2:
+            assert_same_struct(
+                _zero_buf(spec), out[1], what=f"{pc.name}: TickBuf"
+            )
+        checked.append(pc.name)
+    return tuple(checked)
+
+
+def check_step_contract(
+    spec: WorldSpec, state, net, bounds=None, step: Optional[Callable] = None
+) -> None:
+    """The whole-tick contract: ``step`` must be a `lax.scan`-safe carry
+    endomorphism.  ``step`` defaults to :func:`engine.make_step`; pass a
+    wrapper to test instrumented steps."""
+    from ..net.mobility import default_bounds
+    from .engine import make_step
+
+    if bounds is None:
+        bounds = default_bounds()
+    if step is None:
+        step = make_step(spec)
+    got = jax.eval_shape(lambda s: step(s, net, bounds), state)
+    assert_same_struct(state, got, what="tick carry (lax.scan endomorphism)")

@@ -41,6 +41,7 @@ from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..net.mobility import MobilityBounds, step_mobility
 from ..net.energy import step_energy
@@ -49,6 +50,23 @@ from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
 from ..ops.sched import scalar_winner, schedule_batch, task_uniform
 from ..spec import FogModel, Policy, Stage, WorldSpec
 from ..state import WorldState
+
+# Stage tags as hoisted int8 scalar constants (simlint R7): the hot phases
+# previously rebuilt `jnp.int8(int(Stage.X))` per use (~15x per trace in
+# this module).  numpy scalars carry the same int8 dtype through every jnp
+# op (selects, fills, scatters, compares) with zero per-trace constant
+# construction and no device-array creation at import time.
+_ST_UNUSED = np.int8(int(Stage.UNUSED))
+_ST_PUB_INFLIGHT = np.int8(int(Stage.PUB_INFLIGHT))
+_ST_TASK_INFLIGHT = np.int8(int(Stage.TASK_INFLIGHT))
+_ST_QUEUED = np.int8(int(Stage.QUEUED))
+_ST_RUNNING = np.int8(int(Stage.RUNNING))
+_ST_DONE = np.int8(int(Stage.DONE))
+_ST_NO_RESOURCE = np.int8(int(Stage.NO_RESOURCE))
+_ST_DROPPED = np.int8(int(Stage.DROPPED))
+_ST_LOCAL_RUN = np.int8(int(Stage.LOCAL_RUN))
+_ST_REJECTED = np.int8(int(Stage.REJECTED))
+_ST_LOST = np.int8(int(Stage.LOST))
 
 
 class TickBuf(NamedTuple):
@@ -458,7 +476,7 @@ def _phase_spawn(
     if warm_lost is not None:
         lost = lost | (warm_lost & net.is_wireless[:U])
     stage_new = jnp.where(
-        lost, jnp.int8(int(Stage.LOST)), jnp.int8(int(Stage.PUB_INFLIGHT))
+        lost, _ST_LOST, _ST_PUB_INFLIGHT
     )
     # claimed slot per user: send-index k == send_count, as an (U, S) mask
     sel = due[:, None] & (
@@ -618,7 +636,7 @@ def _phase_spawn_multi(
 
     st2 = tasks.stage.reshape(U, S)
     stage_new = jnp.where(
-        lost2, jnp.int8(int(Stage.LOST)), jnp.int8(int(Stage.PUB_INFLIGHT))
+        lost2, _ST_LOST, _ST_PUB_INFLIGHT
     )
     tasks = tasks.replace(
         stage=jnp.where(due2, stage_new, st2).reshape(T),
@@ -694,7 +712,7 @@ def _phase_v2_release(
         # precedes the fire — so this pass only fires timers that precede
         # every pending arrival
         arr2 = (
-            tasks.stage.reshape(U, S) == jnp.int8(int(Stage.PUB_INFLIGHT))
+            tasks.stage.reshape(U, S) == _ST_PUB_INFLIGHT
         ) & (tasks.t_at_broker.reshape(U, S) <= t1)
         t_first_arr = jnp.min(
             jnp.where(arr2, tasks.t_at_broker.reshape(U, S), jnp.inf)
@@ -715,7 +733,7 @@ def _phase_v2_release(
     selc = jnp.clip(sel, 0, T - 1)
     user_sel = selc // S
     ack_t = fire_t + cache.d2b[user_sel]
-    was_local = tasks.stage[selc] == jnp.int8(int(Stage.LOCAL_RUN))
+    was_local = tasks.stage[selc] == _ST_LOCAL_RUN
 
     # the self-message is spent whether or not a request matched; when the
     # broker phase deferred a reschedule behind an already-due fire (ADVICE
@@ -739,7 +757,7 @@ def _phase_v2_release(
             jnp.where(have, ack_t, jnp.inf), mode="drop"
         ),
         stage=tasks.stage.at[scat_local].set(
-            jnp.int8(int(Stage.DONE)), mode="drop"
+            _ST_DONE, mode="drop"
         ),
         t_complete=tasks.t_complete.at[scat_local].set(
             jnp.where(have, fire_t, 0.0), mode="drop"
@@ -806,7 +824,7 @@ def _phase_broker_dense(
     i32 = jnp.int32
     st2 = tasks.stage.reshape(U, S)
     tab2 = tasks.t_at_broker.reshape(U, S)
-    mask2 = (st2 == jnp.int8(int(Stage.PUB_INFLIGHT))) & (tab2 <= t1)
+    mask2 = (st2 == _ST_PUB_INFLIGHT) & (tab2 <= t1)
     cnt_u = jnp.sum(mask2, axis=1, dtype=i32)  # (U,) decided per user
 
     metrics = state.metrics
@@ -858,11 +876,11 @@ def _phase_broker_dense(
 
     new_stage2 = jnp.where(
         sched2,
-        jnp.int8(int(Stage.TASK_INFLIGHT)),
+        _ST_TASK_INFLIGHT,
         jnp.where(
             rejected2,
-            jnp.int8(int(Stage.REJECTED)),
-            jnp.int8(int(Stage.NO_RESOURCE)),
+            _ST_REJECTED,
+            _ST_NO_RESOURCE,
         ),
     )
     d_bf_c = cache.d2b[U + jnp.clip(choice_s, 0, F - 1)] if F > 0 else 0.0
@@ -931,7 +949,7 @@ def _phase_broker(
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
     S = spec.max_sends_per_user
     v2_resched = None  # deferred release-timer reschedule (v2 broker only)
-    mask = (tasks.stage == jnp.int8(int(Stage.PUB_INFLIGHT))) & (
+    mask = (tasks.stage == _ST_PUB_INFLIGHT) & (
         tasks.t_at_broker <= t1
     )
     rot, state = _rot_and_defer(spec, state, mask, K)
@@ -1104,14 +1122,14 @@ def _phase_broker(
 
     new_stage = jnp.where(
         sched,
-        jnp.int8(int(Stage.TASK_INFLIGHT)),
+        _ST_TASK_INFLIGHT,
         jnp.where(
             local,
-            jnp.int8(int(Stage.LOCAL_RUN)),
+            _ST_LOCAL_RUN,
             jnp.where(
                 rejected,
-                jnp.int8(int(Stage.REJECTED)),
-                jnp.int8(int(Stage.NO_RESOURCE)),
+                _ST_REJECTED,
+                _ST_NO_RESOURCE,
             ),
         ),
     )
@@ -1241,8 +1259,8 @@ def _phase_completions(
     )
     stage_vals = jnp.concatenate(
         [
-            jnp.full((F,), jnp.int8(int(Stage.DONE))),
-            jnp.full((F,), jnp.int8(int(Stage.RUNNING))),
+            jnp.full((F,), _ST_DONE),
+            jnp.full((F,), _ST_RUNNING),
         ]
     )
     tasks = tasks.replace(
@@ -1334,7 +1352,7 @@ def _fog_arrivals_front_full(
     i32 = jnp.int32
     fog_alive = state.nodes.alive[U : U + F]
 
-    arr_full = (tasks.stage == jnp.int8(int(Stage.TASK_INFLIGHT))) & (
+    arr_full = (tasks.stage == _ST_TASK_INFLIGHT) & (
         tasks.t_at_fog <= t1
     )
     # ---- full-fog fast drop (dense) -----------------------------------
@@ -1372,7 +1390,7 @@ def _fog_arrivals_front_full(
         fast_drop = arr_full & droppy_t
         tasks = tasks.replace(
             stage=jnp.where(
-                fast_drop, jnp.int8(int(Stage.DROPPED)), tasks.stage
+                fast_drop, _ST_DROPPED, tasks.stage
             )
         )
         arr_full = arr_full & ~fast_drop
@@ -1446,7 +1464,7 @@ def _fog_arrivals_front_two_stage(
     mip2 = tasks.mips_req.reshape(U, S)
     kk = jnp.arange(S, dtype=i32)[None, :]
 
-    m = (st2 == jnp.int8(int(Stage.TASK_INFLIGHT))) & (taf2 <= t1)
+    m = (st2 == _ST_TASK_INFLIGHT) & (taf2 <= t1)
     # R earliest matured slots per user; argmin returns the FIRST min, so
     # time ties break by slot id exactly like the classic selection
     cks, cts, cfs, cms, cvs = [], [], [], [], []
@@ -1514,7 +1532,7 @@ def _fog_arrivals_front_two_stage(
             )
         tasks = tasks.replace(
             stage=jnp.where(
-                sel_fast, jnp.int8(int(Stage.DROPPED)), st2
+                sel_fast, _ST_DROPPED, st2
             ).reshape(T)
         )
         cand_v = cand_v & ~fast_drop
@@ -1615,14 +1633,14 @@ def _fog_arrivals_tail(
     assigned_row = arr & (idx == a_task[fog_gc])
     stage_k = jnp.where(
         enq_ok,
-        jnp.int8(int(Stage.QUEUED)),
+        _ST_QUEUED,
         jnp.where(
             (to_queue & ~enq_ok) | dead_dst,
-            jnp.int8(int(Stage.DROPPED)),
+            _ST_DROPPED,
             jnp.where(
                 assigned_row,
-                jnp.int8(int(Stage.RUNNING)),
-                jnp.int8(int(Stage.TASK_INFLIGHT)),
+                _ST_RUNNING,
+                _ST_TASK_INFLIGHT,
             ),
         ),
     )
@@ -1691,7 +1709,7 @@ def _phase_pool_completions(
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
     i32 = jnp.int32
     comp_full = (
-        (tasks.stage == jnp.int8(int(Stage.RUNNING)))
+        (tasks.stage == _ST_RUNNING)
         & (tasks.fog >= 0)
         & (tasks.t_complete <= t1)
     )
@@ -1712,7 +1730,7 @@ def _phase_pool_completions(
     t_ack6 = t_done + d_fb + d_bu
 
     tasks = tasks.replace(
-        stage=tasks.stage.at[idx].set(jnp.int8(int(Stage.DONE)), mode="drop"),
+        stage=tasks.stage.at[idx].set(_ST_DONE, mode="drop"),
     )
     if spec.app_gen >= 2:
         tasks = tasks.replace(
@@ -1761,7 +1779,7 @@ def _phase_pool_arrivals(
     i32 = jnp.int32
     fog_alive = state.nodes.alive[U : U + F]
 
-    arr_full = (tasks.stage == jnp.int8(int(Stage.TASK_INFLIGHT))) & (
+    arr_full = (tasks.stage == _ST_TASK_INFLIGHT) & (
         tasks.t_at_fog <= t1
     )
     rot, state = _rot_and_defer(spec, state, arr_full, K)
@@ -1793,11 +1811,11 @@ def _phase_pool_arrivals(
 
     stage_k = jnp.where(
         accept,
-        jnp.int8(int(Stage.RUNNING)),
+        _ST_RUNNING,
         jnp.where(
             reject,
-            jnp.int8(int(Stage.REJECTED)),
-            jnp.where(dead_dst, jnp.int8(int(Stage.DROPPED)), tasks.stage[idxc]),
+            _ST_REJECTED,
+            jnp.where(dead_dst, _ST_DROPPED, tasks.stage[idxc]),
         ),
     )
     tasks = tasks.replace(
@@ -1842,7 +1860,7 @@ def _phase_local_completions(
     tasks = state.tasks
     T, K = spec.task_capacity, spec.window
     i32 = jnp.int32
-    comp_full = (tasks.stage == jnp.int8(int(Stage.LOCAL_RUN))) & (
+    comp_full = (tasks.stage == _ST_LOCAL_RUN) & (
         tasks.t_complete <= t1
     )
     rot, state = _rot_and_defer(spec, state, comp_full, K)
@@ -1851,7 +1869,7 @@ def _phase_local_completions(
     t_done = tasks.t_complete[idxc]
     d_bu = cache.d2b[user_g]
     tasks = tasks.replace(
-        stage=tasks.stage.at[idx].set(jnp.int8(int(Stage.DONE)), mode="drop"),
+        stage=tasks.stage.at[idx].set(_ST_DONE, mode="drop"),
         t_ack6=tasks.t_ack6.at[idx].set(
             jnp.where(valid, t_done + d_bu, jnp.inf), mode="drop"
         ),
@@ -2213,13 +2231,13 @@ def _finalize_derived_acks(
     qe2 = t.t_q_enter.reshape(U, S)
     ss2 = t.t_service_start.reshape(U, S)
     decided = (
-        (st2 != jnp.int8(int(Stage.UNUSED)))
-        & (st2 != jnp.int8(int(Stage.PUB_INFLIGHT)))
-        & (st2 != jnp.int8(int(Stage.LOST)))
+        (st2 != _ST_UNUSED)
+        & (st2 != _ST_PUB_INFLIGHT)
+        & (st2 != _ST_LOST)
     )
     queued = jnp.isfinite(qe2)
     assigned = jnp.isfinite(ss2) & ~queued
-    done = st2 == jnp.int8(int(Stage.DONE))
+    done = st2 == _ST_DONE
     inf = jnp.inf
     return state.replace(
         tasks=t.replace(
@@ -2306,6 +2324,30 @@ def run(
     return final, series
 
 
+def _dealias_for_donation(state: WorldState) -> WorldState:
+    """Buffer donation requires every donated leaf to own its buffer.
+
+    World builders may alias one array into several fields (e.g.
+    ``smoke.build`` seeds ``fogs.pool_avail`` with the ``mips`` array
+    itself), and XLA's Execute() rejects donating the same buffer twice.
+    Copy the second and later references; unaliased states pass through
+    untouched, so this never changes results.
+    """
+    seen = set()
+
+    def one(x):
+        try:
+            key = x.unsafe_buffer_pointer()
+        except Exception:  # sharded / numpy / non-addressable leaves
+            key = id(x)
+        if key in seen:
+            return jnp.copy(x)
+        seen.add(key)
+        return x
+
+    return jax.tree.map(one, state)
+
+
 def run_chunked(
     spec: WorldSpec,
     state: WorldState,
@@ -2328,6 +2370,16 @@ def run_chunked(
 
     Per-tick series recording is not supported here (the chunks' series
     would be silently dropped): record via the callback instead.
+
+    Buffer donation (simlint R6): without a ``callback``, each chunk
+    DONATES its input carry, so XLA serves the next chunk's state from
+    the previous chunk's buffers in place instead of holding two copies
+    of the dominant task-table footprint — the ``state`` argument itself
+    feeds the first chunk, so do not reuse it after calling (platforms
+    without donation support just ignore the hint).  WITH a callback the
+    chunks do not donate: the callback may retain each chunk-boundary
+    state (checkpoint streaming), and donating it to the next chunk
+    would delete those buffers behind the callback's back.
     """
     if spec.record_tick_series:
         raise ValueError(
@@ -2342,25 +2394,54 @@ def run_chunked(
     total = spec.n_ticks
     chunk = min(chunk_ticks, total)
 
-    @functools.partial(jax.jit, static_argnums=0)
-    def go(n, s):
-        final, _ = run(spec, s, net, bounds, n_ticks=n)
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def go(
+        n: int, s: WorldState, net_: NetParams, bounds_: MobilityBounds
+    ) -> WorldState:
+        final, _ = run(spec, s, net_, bounds_, n_ticks=n)
         return final
 
+    # simlint: disable=R6 -- the callback path must NOT donate: callbacks
+    # may retain each chunk-boundary state (checkpoint streaming), and the
+    # next chunk would delete those buffers behind the callback's back
+    @functools.partial(jax.jit, static_argnums=0)
+    def go_keep(
+        n: int, s: WorldState, net_: NetParams, bounds_: MobilityBounds
+    ) -> WorldState:
+        final, _ = run(spec, s, net_, bounds_, n_ticks=n)
+        return final
+
+    donating = callback is None
     done = 0
     while done < total:
         n = min(chunk, total - done)
-        state = go(n, state)
+        if donating:
+            state = go(n, _dealias_for_donation(state), net, bounds)
+        else:
+            state = go_keep(n, state, net, bounds)
         done += n
         if callback is not None:
             callback(state, done)
     return state
 
 
-@functools.partial(jax.jit, static_argnums=0)
 def run_jit(
     spec: WorldSpec, state: WorldState, net: NetParams, bounds: MobilityBounds
 ) -> WorldState:
-    """Whole-run jit entry (spec static): scan over the full horizon."""
+    """Whole-run jit entry (spec static): scan over the full horizon.
+
+    ``state`` is DONATED (simlint R6): the carry dominates the bytes/tick
+    footprint, and donation lets XLA alias the initial state's buffers
+    into the scan carry instead of copying them.  Do not reuse ``state``
+    after calling; rebuild (or ``jax.tree.map(jnp.copy, ...)``) if the
+    initial world is needed again.
+    """
+    return _run_jit(spec, _dealias_for_donation(state), net, bounds)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _run_jit(
+    spec: WorldSpec, state: WorldState, net: NetParams, bounds: MobilityBounds
+) -> WorldState:
     final, _ = run(spec, state, net, bounds)
     return final

@@ -15,6 +15,7 @@ per-user publish interval (the ``volatile sendInterval`` NED parameter,
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 from typing import Dict, Optional
 
@@ -78,17 +79,29 @@ def run_replicated(
 ) -> WorldState:
     """Advance every replica over the horizon: ``jit(vmap(scan(step)))``.
 
-    ``net``/``bounds`` are shared (broadcast) across replicas.  Returns the
-    batched final state; pull per-replica scalars with
-    :func:`replica_counters`.
+    ``net``/``bounds`` are shared (broadcast via ``in_axes=None``) across
+    replicas — passed as jit arguments, not closure-captured (simlint R3:
+    captured arrays are baked into the trace as constants and retrace per
+    call; as arguments the jitted program is cached on ``(spec,
+    n_ticks)`` across calls).  Returns the batched final state; pull
+    per-replica scalars with :func:`replica_counters`.
     """
+    return _run_replicated(spec, n_ticks, batch, net, bounds)
 
-    def run_one(s: WorldState) -> WorldState:
-        final, _ = run(spec, s, net, bounds, n_ticks=n_ticks)
+
+# simlint: disable=R6 -- callers A/B the same batch across run_replicated
+# and run_sharded (tests/test_parallel.py); donating it would invalidate
+# the shared input
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _run_replicated(
+    spec: WorldSpec, n_ticks: Optional[int], batch: WorldState,
+    net: NetParams, bounds: MobilityBounds,
+) -> WorldState:
+    def run_one(s, net_, bounds_):
+        final, _ = run(spec, s, net_, bounds_, n_ticks=n_ticks)
         return final
 
-    fn = jax.jit(jax.vmap(run_one))
-    return fn(batch)
+    return jax.vmap(run_one, in_axes=(0, None, None))(batch, net, bounds)
 
 
 def replica_counters(final_batch: WorldState) -> Dict[str, np.ndarray]:

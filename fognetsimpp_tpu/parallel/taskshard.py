@@ -72,6 +72,9 @@ def shard_state_by_node(
     )
 
 
+# simlint: disable=R6 -- a chained run_node_sharded call can pass an
+# already-sharded state whose device_put is a no-op aliasing the caller's
+# buffers; donating here would invalidate them behind the caller's back
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _advance(
     spec: WorldSpec, n_ticks: Optional[int], state: WorldState,

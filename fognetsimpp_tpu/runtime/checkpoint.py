@@ -41,7 +41,19 @@ def load(path: str) -> Tuple[WorldSpec, WorldState]:
     spec = dict_to_spec(spec_d)
     skeleton = init_state(spec)
     treedef = jax.tree.structure(skeleton)
+    if len(leaves) != treedef.num_leaves:
+        raise ValueError(
+            f"checkpoint {path!r} has {len(leaves)} state leaves, spec "
+            f"expects {treedef.num_leaves} — saved by an incompatible "
+            "WorldState layout"
+        )
     state = jax.tree.unflatten(
         treedef, [jax.numpy.asarray(x) for x in leaves]
     )
+    # trace-time contract (simlint R8 layer): a leaf whose shape/dtype
+    # drifted from the spec's skeleton would otherwise surface as a
+    # recompile or an opaque scan carry-mismatch deep inside the engine
+    from ..core.contracts import assert_same_struct
+
+    assert_same_struct(skeleton, state, what=f"checkpoint {path!r}")
     return spec, state

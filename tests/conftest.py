@@ -44,6 +44,14 @@ _QUICK_FILES = {
     "test_engine_smoke.py",
     "test_compaction.py",
     "test_pallas.py",
+    # simlint static pass + trace-time contracts (PR 1): pure AST walks
+    # and eval_shape traces — seconds, and exactly the checks that should
+    # gate every edit loop
+    "test_simlint.py",
+    "test_simlint_rules.py",
+    "test_contracts.py",
+    "test_donation.py",
+    "test_cli_errors.py",
 }
 
 

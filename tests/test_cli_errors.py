@@ -1,0 +1,24 @@
+"""CLI error-path regressions: an .ini referencing an unknown scenario/
+network name must produce a one-line actionable error, not a traceback."""
+from fognetsimpp_tpu.__main__ import main
+
+
+def test_unknown_scenario_flag_is_clear_error(capsys):
+    rc = main(["--scenario", "wirelessnet-42"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "unknown scenario" in captured.err
+    assert "Traceback" not in captured.err
+    # the known names are listed so the fix is obvious
+    assert "wireless5" in captured.err and "smoke" in captured.err
+
+
+def test_unknown_network_in_ini_is_clear_error(tmp_path, capsys):
+    ini = tmp_path / "run.ini"
+    ini.write_text("[General]\nscenario = NoSuchNetwork\n")
+    rc = main(["--config", str(ini)])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "NoSuchNetwork" in captured.err
+    assert "Traceback" not in captured.err

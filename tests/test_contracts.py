@@ -1,0 +1,100 @@
+"""Tier-1 trace-time contract checks (simlint R8's runtime half).
+
+Everything here runs under JAX_PLATFORMS=cpu via jax.eval_shape — no
+FLOPs, no device buffers — so a carry-dtype promotion that would silently
+recompile every tick on TPU fails in seconds on CPU instead."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu.core import contracts
+from fognetsimpp_tpu.core.engine import make_step
+from fognetsimpp_tpu.scenarios import smoke
+
+
+def _worlds():
+    # FIFO v3 argmin-family world (dense broker), v2 POOL LOCAL_FIRST
+    # world (compacted broker + pool phases + v2 release timer), and a
+    # coarse-dt multi-send world (spawn_multi)
+    return [
+        smoke.build(horizon=0.4),
+        smoke.build(
+            horizon=0.4, dt=1e-3, send_interval=0.008, n_users=3,
+            n_fogs=2, app_gen=2, fog_model=1, policy=5,
+            broker_mips=2048.0, v2_local_broker=True,
+        ),
+        smoke.build(
+            horizon=0.3, dt=0.2, send_interval=0.05, max_sends_per_tick=8
+        ),
+    ]
+
+
+def test_step_contract_holds_for_all_worlds():
+    for spec, state, net, bounds in _worlds():
+        contracts.check_step_contract(spec, state, net, bounds)
+
+
+def test_phase_contracts_hold_and_cover_registry():
+    checked = set()
+    for spec, state, net, _ in _worlds():
+        checked.update(contracts.check_phase_contracts(spec, state, net))
+    registry = {pc.name for pc in contracts.PHASE_CONTRACTS}
+    assert checked == registry, (
+        f"phases never traced by any test world: {registry - checked}"
+    )
+
+
+def test_injected_carry_dtype_promotion_fails():
+    spec, state, net, bounds = _worlds()[0]
+    step = make_step(spec)
+
+    def promoted_step(s, n, b):
+        out = step(s, n, b)
+        # int8 stage + strong int32 promotes the carry leaf to int32 —
+        # exactly the class of bug R8 exists to catch
+        return out.replace(
+            tasks=out.tasks.replace(stage=out.tasks.stage + jnp.int32(1))
+        )
+
+    with pytest.raises(contracts.ContractError, match="stage"):
+        contracts.check_step_contract(
+            spec, state, net, bounds, step=promoted_step
+        )
+
+
+def test_injected_shape_drift_fails():
+    spec, state, net, bounds = _worlds()[0]
+    step = make_step(spec)
+
+    def truncated_step(s, n, b):
+        out = step(s, n, b)
+        return out.replace(
+            tasks=out.tasks.replace(mips_req=out.tasks.mips_req[:-1])
+        )
+
+    with pytest.raises(contracts.ContractError, match="mips_req"):
+        contracts.check_step_contract(
+            spec, state, net, bounds, step=truncated_step
+        )
+
+
+def test_checkpoint_load_rejects_drifted_leaf(tmp_path):
+    from fognetsimpp_tpu.runtime import checkpoint
+
+    spec, state, net, bounds = _worlds()[0]
+    p = str(tmp_path / "ck.npz")
+    checkpoint.save(p, spec, state)
+    spec2, state2 = checkpoint.load(p)  # clean round-trip still works
+
+    # tamper one int8 leaf into int32 (the promotion a buggy writer or a
+    # layout drift would produce) and reload
+    with np.load(p) as z:
+        data = {k: z[k] for k in z.files}
+    victim = next(
+        k for k, v in data.items()
+        if k.startswith("leaf_") and v.dtype == np.int8
+    )
+    data[victim] = data[victim].astype(np.int32)
+    np.savez_compressed(p, **data)
+    with pytest.raises(contracts.ContractError, match="int32"):
+        checkpoint.load(p)

@@ -7,7 +7,12 @@ property layer the reference never had (SURVEY.md §4 implication note).
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from fognetsimpp_tpu import Stage, run
 from fognetsimpp_tpu.core.engine import prime_initial_advertisements

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# CI/developer gate: the simlint static pass, then the quick test tier.
+#
+#   tools/ci_check.sh            # lint + quick tests (the <60 s loop)
+#   tools/ci_check.sh --full     # lint + the whole suite
+#
+# simlint runs first and fails fast: an unsuppressed JAX/TPU hazard
+# (tools/simlint/RULES.md) never reaches the test run.  The suppression
+# baseline lives at tools/simlint/baseline.json; grandfather a finding
+# with `python -m tools.simlint --update-baseline fognetsimpp_tpu` and
+# commit the (reviewable) diff.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== simlint =="
+python -m tools.simlint fognetsimpp_tpu
+
+MARKER="quick"
+if [[ "${1:-}" == "--full" ]]; then
+    MARKER="not slow or slow"
+fi
+
+echo "== pytest (-m '${MARKER}') =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "${MARKER}" \
+    -p no:cacheprovider

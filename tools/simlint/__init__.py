@@ -1,0 +1,12 @@
+"""simlint: the repo's JAX/TPU-hazard static-analysis pass.
+
+Usage::
+
+    python -m tools.simlint fognetsimpp_tpu        # lint the package
+    python -m tools.simlint --list-rules
+    python -m tools.simlint --update-baseline fognetsimpp_tpu
+
+Programmatic: :func:`tools.simlint.core.lint`.
+"""
+from .core import Finding, LintResult, lint  # noqa: F401
+from .rules import default_rules  # noqa: F401

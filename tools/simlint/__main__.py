@@ -1,0 +1,91 @@
+"""CLI for the simlint static pass: nonzero exit on unsuppressed findings."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import lint, write_baseline
+from .rules import default_rules
+
+_DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.simlint",
+        description="JAX/TPU-hazard static analysis for this repo "
+        "(rules: tools/simlint/RULES.md)",
+    )
+    ap.add_argument("paths", nargs="*", help="packages/files to lint")
+    ap.add_argument(
+        "--baseline", default=_DEFAULT_BASELINE,
+        help="suppression baseline JSON (default: tools/simlint/"
+        "baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report everything)",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="write the current unsuppressed findings into the baseline "
+        "and exit 0 (grandfathering workflow: lint, fix what you can, "
+        "baseline the rest with a reviewable diff)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument(
+        "--show-baselined", action="store_true",
+        help="also print findings matched by the baseline",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in default_rules():
+            print(f"{r.id}: {r.title}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: python -m tools.simlint "
+                 "fognetsimpp_tpu)")
+
+    baseline = None if args.no_baseline else args.baseline
+    result = lint(args.paths, baseline_path=baseline)
+
+    if args.update_baseline:
+        write_baseline(args.baseline, result.findings + result.baselined)
+        print(
+            f"simlint: baselined {len(result.findings)} new finding(s) "
+            f"({len(result.baselined)} kept) -> {args.baseline}"
+        )
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "files": result.n_files,
+            "findings": [f.__dict__ for f in result.findings],
+            "baselined": [f.__dict__ for f in result.baselined],
+            "inline_suppressed": result.inline_suppressed,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        if args.show_baselined:
+            for f in result.baselined:
+                print(f"[baselined] {f.render()}")
+        status = "clean" if result.ok else f"{len(result.findings)} finding(s)"
+        print(
+            f"simlint: {result.n_files} file(s), {status} "
+            f"({len(result.baselined)} baselined, "
+            f"{result.inline_suppressed} inline-suppressed)",
+            file=sys.stderr,
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,575 @@
+"""simlint core: AST framework, device-context classifier, suppressions.
+
+The OMNeT++ reference gets schema/state discipline from nedtool codegen
+and the C++ type system; this JAX port gets it from here.  simlint is a
+*codebase-specific* static pass: it knows which modules are device code
+(traced into `lax.scan` bodies and jit programs), which parameter types
+are static under `jax.jit` (``WorldSpec``, plain ints) versus traced
+(``WorldState``, ``NetParams``, ``jax.Array``), and it checks the hazard
+classes that repeatedly cost us TPU performance or correctness — hidden
+host syncs, recompile triggers, dtype promotion, nondeterminism, missing
+buffer donation, per-trace constant churn, and uncontracted engine
+phases.  See ``tools/simlint/RULES.md`` for the rule catalogue.
+
+Architecture:
+
+* :class:`ModuleInfo` — one parsed file: AST + parent links + the set of
+  *device functions* (see below) + per-function scope tables.
+* :class:`Rule` — ``check_module`` runs per file; ``check_project`` runs
+  once over the whole corpus (used by R8 contract coverage).
+* Device classification — a function is device code when it (a) lives in
+  a blanket device module (``DEVICE_MODULE_GLOBS``: the engine, ops,
+  kernels, state), (b) is jit/pallas-decorated or passed to a tracing
+  combinator (``lax.scan``, ``jax.vmap``, ...), (c) is named like an
+  engine phase (``_phase_*``), (d) is nested in or called from a device
+  function (module-local call-graph fixpoint).
+* Suppressions — inline ``# simlint: disable=R6 -- reason`` on the
+  finding line or in the comment block directly above it, plus a JSON
+  baseline file for grandfathered findings (``--update-baseline``
+  refreshes it; new findings stay fatal).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ----------------------------------------------------------------------
+# repo-specific configuration
+# ----------------------------------------------------------------------
+
+# Modules whose every function is device code (hot path / traced).
+DEVICE_MODULE_GLOBS: Tuple[str, ...] = (
+    "core/engine.py",
+    "core/contracts.py",
+    "ops/*.py",
+    "net/energy.py",
+    "net/mobility.py",
+    "parallel/tp.py",
+    "state.py",
+)
+
+# Annotation tokens that mean "static under jit" (hashable, not traced).
+STATIC_TYPE_TOKENS: Set[str] = {
+    "int", "float", "bool", "str", "bytes", "None", "Optional",
+    "WorldSpec", "Policy", "Stage", "FogModel", "Mobility", "NodeKind",
+    "Callable", "Sequence", "Dict", "List", "Mesh", "str",
+}
+
+# Unannotated parameter names assumed static (the spec convention).
+STATIC_PARAM_NAMES: Set[str] = {"spec", "self", "cls", "sp"}
+
+# Attribute accesses that yield static metadata even on traced arrays.
+STATIC_ATTRS: Set[str] = {"shape", "ndim", "dtype", "size", "sharding"}
+
+# Calls whose function-name arguments become traced (device) code.
+TRACING_COMBINATORS: Set[str] = {
+    "jax.lax.scan", "lax.scan", "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop", "jax.lax.cond", "lax.cond",
+    "jax.lax.switch", "lax.switch", "jax.lax.map", "lax.map",
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.eval_shape", "jax.linearize",
+    "jax.custom_jvp", "jax.custom_vjp",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*simlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+# ----------------------------------------------------------------------
+# findings
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    relpath: str
+    line: int
+    message: str
+    text: str  # stripped source line: the line-number-stable baseline key
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.relpath, self.text)
+
+    def render(self) -> str:
+        return f"{self.relpath}:{self.line}: {self.rule}: {self.message}"
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]          # unsuppressed: these fail the build
+    baselined: List[Finding]         # matched the suppression baseline
+    inline_suppressed: int
+    n_files: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+# ----------------------------------------------------------------------
+# AST helpers
+# ----------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _ann_is_static(ann: Optional[ast.AST]) -> Optional[bool]:
+    """True/False from an annotation, None when there is no annotation."""
+    if ann is None:
+        return None
+    text = ast.unparse(ann)
+    idents = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", text))
+    return bool(idents) and idents <= STATIC_TYPE_TOKENS
+
+
+def param_is_static(arg: ast.arg) -> bool:
+    by_ann = _ann_is_static(arg.annotation)
+    if by_ann is not None:
+        return by_ann
+    return arg.arg in STATIC_PARAM_NAMES or arg.arg.isupper()
+
+
+def func_params(fn: ast.FunctionDef) -> List[ast.arg]:
+    a = fn.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs]
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` / pallas."""
+    name = dotted(dec)
+    if name in ("jax.jit", "jit") or (name or "").endswith("pallas_call"):
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted(dec.func)
+        if fname in ("jax.jit", "jit") or (fname or "").endswith(
+            "pallas_call"
+        ):
+            return True
+        if fname in ("functools.partial", "partial") and dec.args:
+            inner = dotted(dec.args[0])
+            return inner in ("jax.jit", "jit")
+    return False
+
+
+def jit_call_kwargs(dec: ast.AST) -> Optional[Dict[str, ast.AST]]:
+    """Keyword args of a jit decorator/call, else None."""
+    if isinstance(dec, ast.Call):
+        return {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+    return {} if dotted(dec) in ("jax.jit", "jit") else None
+
+
+def const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Literal int / tuple-of-ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def is_const_expr(node: ast.AST) -> bool:
+    """Compile-time constant-ish: literals, enum members, int()/float()
+    of those, and arithmetic over them — the R7 "rebuilt every trace"
+    class."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return is_const_expr(node.operand)
+    if isinstance(node, ast.BinOp):
+        return is_const_expr(node.left) and is_const_expr(node.right)
+    if isinstance(node, ast.Attribute):
+        name = dotted(node)
+        # Stage.LOST / Policy.MAX_MIPS: CamelCase root = enum class
+        return bool(name) and name.split(".")[0][:1].isupper()
+    if isinstance(node, ast.Name):
+        return node.id.isupper()  # module-level constant convention
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("int", "float", "bool") and len(node.args) == 1:
+            return is_const_expr(node.args[0])
+    return False
+
+
+# ----------------------------------------------------------------------
+# module model
+# ----------------------------------------------------------------------
+
+_FuncNode = ast.FunctionDef  # (async defs are treated identically)
+
+
+class ModuleInfo:
+    """Parsed file + device classification + scope tables."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.functions: List[_FuncNode] = [
+            n
+            for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # suffix-anchored against BOTH the scan-relative path and the
+        # absolute path, so classification is independent of the scan
+        # root: `fognetsimpp_tpu`, `.`, `fognetsimpp_tpu/core`, or the
+        # file itself all classify core/engine.py as a device module
+        abspath = os.path.abspath(path).replace(os.sep, "/")
+        self.blanket_device = any(
+            fnmatch.fnmatch(cand, g) or fnmatch.fnmatch(cand, "*/" + g)
+            for g in DEVICE_MODULE_GLOBS
+            for cand in (self.relpath, abspath)
+        )
+        self._locals: Dict[_FuncNode, Set[str]] = {
+            f: self._collect_locals(f) for f in self.functions
+        }
+        self.device_funcs: Set[_FuncNode] = self._classify_device()
+
+    # -- scopes --------------------------------------------------------
+
+    def _collect_locals(self, fn: _FuncNode) -> Set[str]:
+        names = {a.arg for a in func_params(fn)}
+        if fn.args.vararg:
+            names.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            names.add(fn.args.kwarg.arg)
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if self.enclosing_function(node) is not fn:
+                continue  # belongs to a nested scope
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                names.add(node.id)
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                names.add(node.name)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    names.add((alias.asname or alias.name).split(".")[0])
+        return names
+
+    def enclosing_function(self, node: ast.AST) -> Optional[_FuncNode]:
+        """Nearest FunctionDef strictly above ``node`` (None: module)."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def function_chain(self, fn: _FuncNode) -> List[_FuncNode]:
+        """``fn`` and every function lexically enclosing it, inner-first."""
+        chain = [fn]
+        cur = self.enclosing_function(fn)
+        while cur is not None:
+            chain.append(cur)
+            cur = self.enclosing_function(cur)
+        return chain
+
+    def local_names(self, fn: _FuncNode) -> Set[str]:
+        return self._locals[fn]
+
+    # -- device classification ----------------------------------------
+
+    def _classify_device(self) -> Set[_FuncNode]:
+        by_name: Dict[str, List[_FuncNode]] = {}
+        for f in self.functions:
+            by_name.setdefault(f.name, []).append(f)
+
+        device: Set[_FuncNode] = set()
+        for f in self.functions:
+            if self.blanket_device or f.name.startswith("_phase_"):
+                device.add(f)
+            elif any(is_jit_decorator(d) for d in f.decorator_list):
+                device.add(f)
+
+        # functions passed (by name) to tracing combinators
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if dotted(call.func) not in TRACING_COMBINATORS:
+                continue
+            for arg in ast.walk(call):
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    device.update(by_name[arg.id])
+
+        # fixpoint: nested-in-device + called-from-device (module-local)
+        changed = True
+        while changed:
+            changed = False
+            for f in self.functions:
+                if f in device:
+                    continue
+                enc = self.enclosing_function(f)
+                if enc is not None and enc in device:
+                    device.add(f)
+                    changed = True
+            for df in list(device):
+                for node in ast.walk(df):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in by_name
+                    ):
+                        for target in by_name[node.func.id]:
+                            if target not in device:
+                                device.add(target)
+                                changed = True
+        return device
+
+    # -- tracedness ----------------------------------------------------
+
+    def traced_roots(self, fn: _FuncNode) -> Set[str]:
+        """Names that are traced arrays inside ``fn``: its own non-static
+        params plus those of enclosing device functions."""
+        roots: Set[str] = set()
+        for f in self.function_chain(fn):
+            for a in func_params(f):
+                if not param_is_static(a):
+                    roots.add(a.arg)
+        return roots
+
+    def expr_is_traced(self, node: ast.AST, roots: Set[str]) -> bool:
+        """Conservative syntactic test: does ``node`` produce (or contain)
+        a traced value?  Attribute chains through ``.shape``-style static
+        metadata and ``is None`` checks are static."""
+        if isinstance(node, ast.Name):
+            return node.id in roots
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_is_traced(node.value, roots)
+        if isinstance(node, ast.Subscript):
+            return self.expr_is_traced(node.value, roots) or (
+                self.expr_is_traced(node.slice, roots)
+            )
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if name.startswith(("jnp.", "jax.", "lax.")):
+                return True
+            if isinstance(node.func, ast.Attribute) and self.expr_is_traced(
+                node.func.value, roots
+            ):
+                return True  # method call on a traced object (x.sum(), ...)
+            return any(
+                self.expr_is_traced(a, roots) for a in node.args
+            ) or any(
+                self.expr_is_traced(k.value, roots) for k in node.keywords
+            )
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # `x is None` host checks on optionals
+            return self.expr_is_traced(node.left, roots) or any(
+                self.expr_is_traced(c, roots) for c in node.comparators
+            )
+        if isinstance(node, (ast.BoolOp,)):
+            return any(self.expr_is_traced(v, roots) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self.expr_is_traced(
+                node.left, roots
+            ) or self.expr_is_traced(node.right, roots)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_is_traced(node.operand, roots)
+        if isinstance(node, ast.IfExp):
+            return any(
+                self.expr_is_traced(n, roots)
+                for n in (node.test, node.body, node.orelse)
+            )
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr_is_traced(e, roots) for e in node.elts)
+        return False
+
+    # -- iteration helpers --------------------------------------------
+
+    def device_nodes(self) -> Iterable[Tuple[_FuncNode, ast.AST]]:
+        """(device_function, node) for every node inside device code."""
+        for f in self.device_funcs:
+            for node in ast.walk(f):
+                enc = self.enclosing_function(node)
+                if enc is f:
+                    yield f, node
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule, self.relpath, line, message, self.line_text(line))
+
+
+# ----------------------------------------------------------------------
+# rules + runner
+# ----------------------------------------------------------------------
+
+class Rule:
+    id: str = "R0"
+    title: str = ""
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def check_project(
+        self, mods: Sequence[ModuleInfo]
+    ) -> Iterable[Finding]:
+        return ()
+
+
+def _inline_suppressed(mod: ModuleInfo, f: Finding) -> bool:
+    """``# simlint: disable=Rx`` on the finding line, or anywhere in the
+    contiguous comment block directly above it."""
+
+    def match(text: str) -> bool:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            return False
+        rules = {r.strip().split()[0] for r in m.group(1).split(",")}
+        return f.rule in rules or "all" in rules
+
+    if match(mod.line_text(f.line)):
+        return True
+    i = f.line - 1
+    while i >= 1:
+        text = mod.line_text(i)
+        if not text.startswith("#"):
+            break
+        if match(text):
+            return True
+        i -= 1
+    return False
+
+
+def collect_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """(abspath, relpath) for every .py under ``paths``; relpath is
+    relative to the scanned top-level dir (device-glob keys)."""
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            # full path (not basename) so suffix-anchored device-module
+            # globs still classify directly-linted files correctly
+            out.append((p, p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs if d not in ("__pycache__", ".git")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    full = os.path.join(root, name)
+                    out.append((full, os.path.relpath(full, p)))
+    return out
+
+
+def load_baseline(
+    path: Optional[str],
+) -> Dict[Tuple[str, str, str], int]:
+    """key -> grandfathered occurrence count.  Counted (not a set) so a
+    future textually-identical violation in the same file is NOT covered
+    by an older grandfathered one — new findings stay fatal."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("suppress", []):
+        key = (e["rule"], e["path"], e["text"])
+        counts[key] = counts.get(key, 0) + int(e.get("count", 1))
+    return counts
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    data = {
+        "_comment": (
+            "simlint suppression baseline: grandfathered findings keyed "
+            "by (rule, path, source-line text, occurrence count) so line "
+            "drift does not invalidate them.  Regenerate with "
+            "--update-baseline; new findings (including new copies of a "
+            "baselined line) stay fatal until fixed or re-baselined."
+        ),
+        "suppress": [
+            {
+                "rule": r, "path": p, "text": t,
+                **({"count": c} if c > 1 else {}),
+            }
+            for (r, p, t), c in sorted(counts.items())
+        ],
+    }
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def lint(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    baseline_path: Optional[str] = None,
+) -> LintResult:
+    if rules is None:
+        from .rules import default_rules
+
+        rules = default_rules()
+    baseline = load_baseline(baseline_path)
+
+    mods: List[ModuleInfo] = []
+    for full, rel in collect_files(paths):
+        with open(full, encoding="utf-8") as fh:
+            src = fh.read()
+        mods.append(ModuleInfo(full, rel, src))
+
+    raw: List[Tuple[ModuleInfo, Finding]] = []
+    by_rel = {m.relpath: m for m in mods}
+    for mod in mods:
+        for rule in rules:
+            for f in rule.check_module(mod):
+                raw.append((mod, f))
+    for rule in rules:
+        for f in rule.check_project(mods):
+            raw.append((by_rel.get(f.relpath, mods[0]), f))
+
+    findings: List[Finding] = []
+    baselined: List[Finding] = []
+    used: Dict[Tuple[str, str, str], int] = {}
+    n_inline = 0
+    for mod, f in sorted(
+        raw, key=lambda mf: (mf[1].relpath, mf[1].line, mf[1].rule)
+    ):
+        if _inline_suppressed(mod, f):
+            n_inline += 1
+        elif used.get(f.key(), 0) < baseline.get(f.key(), 0):
+            used[f.key()] = used.get(f.key(), 0) + 1
+            baselined.append(f)
+        else:
+            findings.append(f)
+    return LintResult(findings, baselined, n_inline, len(mods))

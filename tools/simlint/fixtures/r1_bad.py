@@ -1,0 +1,13 @@
+"""R1 fixture: host syncs inside device code (every marked line fires)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(state):
+    total = float(state.sum())          # R1: float() on a traced value
+    n = jnp.mean(state).item()          # R1: .item() host sync
+    host = np.asarray(state * 2.0)      # R1: np.asarray on a device array
+    flag = bool(jnp.any(state > 0))     # R1: bool() concretization
+    return state + total + n + host.shape[0] + flag

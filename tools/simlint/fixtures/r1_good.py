@@ -1,0 +1,19 @@
+"""R1 fixture: the sanctioned alternatives — everything stays on device."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(arr):
+    total = jnp.sum(arr)                # stays a 0-d device array
+    n = jnp.mean(arr)
+    flag = jnp.any(arr > 0)
+    return arr + total + n + flag.astype(arr.dtype)
+
+
+def summarize(final_state) -> float:
+    # host conversion OUTSIDE the jit boundary is fine (and `float()` of
+    # a plain Python constant never fires)
+    scale = float("1e3")
+    return float(np.asarray(final_state).mean()) * scale

@@ -1,0 +1,11 @@
+"""R2 fixture: Python control flow branching on traced comparisons."""
+import jax
+
+
+@jax.jit
+def clamp(x, lo):
+    if x > lo:                  # R2: Python `if` on a traced compare
+        return lo
+    while x < lo:               # R2: Python `while` on a traced compare
+        x = x + 1.0
+    return x

@@ -1,0 +1,18 @@
+"""R2 fixture: masked selects / lax loops; static-spec branches are fine."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def clamp(x, lo):
+    x = jnp.where(x > lo, lo, x)                   # select, not branch
+    return lax.while_loop(lambda v: jnp.all(v < lo), lambda v: v + 1.0, x)
+
+
+def build(spec: int, x, threshold: float = 0.5):
+    if spec > 2:              # static (annotated int): host branch is fine
+        return clamp(x, jnp.float32(threshold))
+    if x is None:             # `is None` optional-arg checks are host-side
+        return None
+    return clamp(x, jnp.float32(0.0))

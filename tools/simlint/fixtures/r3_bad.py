@@ -1,0 +1,27 @@
+"""R3 fixture: recompile hazards — array-valued static args and array
+closure capture at a jit boundary."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def scale(x, factor: jax.Array):        # R3a: array marked static
+    return x * factor
+
+
+def make_runner(table: jax.Array):
+    @jax.jit
+    def inner(x):
+        return x + table                # R3b: traced closure capture
+    return inner
+
+
+def sweep(batch, weights: jax.Array):
+    lut = jnp.cumsum(weights)
+
+    @jax.jit
+    def apply(x):
+        return x * lut                  # R3b: derived-array capture
+    return apply(batch)

@@ -1,0 +1,17 @@
+"""R3 fixture: static args are hashable scalars; arrays ride as
+arguments through the jit boundary."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def scale(x, factor: int):              # hashable static: fine
+    return x * factor
+
+
+def make_runner(table: jax.Array):
+    @jax.jit
+    def inner(x, tab):
+        return x + tab                  # array passed as an argument
+    return functools.partial(inner, tab=table)

@@ -1,0 +1,12 @@
+"""R4 fixture: 64-bit dtypes on the device path + process-wide x64 flip."""
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)   # R4: global promotion flip
+
+
+@jax.jit
+def accumulate(x):
+    acc = jnp.zeros((4,), jnp.float64)      # R4: 64-bit device dtype
+    big = jnp.arange(8, dtype="int64")      # R4: 64-bit dtype string
+    return acc + x + big.sum()

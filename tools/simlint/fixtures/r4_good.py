@@ -1,0 +1,18 @@
+"""R4 fixture: 32-bit device dtypes; host-side np.float64 stays legal
+(the scave exporter / Bianchi-table pattern)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def accumulate(x):
+    acc = jnp.zeros((4,), jnp.float32)
+    big = jnp.arange(8, dtype="int32")
+    return acc + x + big.sum()
+
+
+def export_stats(values) -> float:
+    # host-side double-precision accumulation for result files is exactly
+    # what runtime/scave.py does — legal outside device code
+    return float(np.asarray(values, np.float64).sum())

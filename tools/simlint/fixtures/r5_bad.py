@@ -1,0 +1,11 @@
+"""R5 fixture: host RNG inside device code."""
+import random
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def jitter(x):
+    x = x + np.random.uniform()     # R5: numpy global RNG in device code
+    return x * random.random()      # R5: stdlib wall-clock-seeded RNG

@@ -1,0 +1,10 @@
+"""R5 fixture: keyed jax.random draws — deterministic under one seed."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def jitter(x, key):
+    k1, k2 = jax.random.split(key)
+    x = x + jax.random.uniform(k1)
+    return x * jax.random.uniform(k2), jnp.asarray(0)

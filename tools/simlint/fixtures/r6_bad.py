@@ -1,0 +1,18 @@
+"""R6 fixture: a whole-run jit entry that keeps two copies of the carry."""
+import functools
+
+import jax
+
+
+class WorldState:  # stand-in for the real carry pytree
+    pass
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def advance(spec, state: WorldState, net):   # R6: carry not donated
+    return state
+
+
+@jax.jit
+def advance_unannotated(state, net):   # R6: dropping the annotation is
+    return state                       # not an escape hatch

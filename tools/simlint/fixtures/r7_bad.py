@@ -1,0 +1,17 @@
+"""R7 fixture: the same scalar constant rebuilt at every use site."""
+import enum
+
+import jax
+import jax.numpy as jnp
+
+
+class Stage(enum.IntEnum):
+    LOST = 10
+
+
+@jax.jit
+def mark(stage, lost):
+    a = jnp.where(lost, jnp.int8(int(Stage.LOST)), stage)   # R7 (x3)
+    b = stage == jnp.int8(int(Stage.LOST))
+    c = jnp.full((4,), jnp.int8(int(Stage.LOST)))
+    return a, b, c

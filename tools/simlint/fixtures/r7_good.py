@@ -1,0 +1,22 @@
+"""R7 fixture: hoisted module-level constant (numpy scalar keeps the
+dtype through every jnp op with zero per-trace churn)."""
+import enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Stage(enum.IntEnum):
+    LOST = 10
+
+
+_ST_LOST = np.int8(int(Stage.LOST))
+
+
+@jax.jit
+def mark(stage, lost):
+    a = jnp.where(lost, _ST_LOST, stage)
+    b = stage == _ST_LOST
+    c = jnp.full((4,), _ST_LOST)
+    return a, b, c

@@ -1,0 +1,12 @@
+"""R8 fixture: an engine phase with no trace-time contract entry."""
+import jax.numpy as jnp
+
+PHASE_CONTRACTS = ()  # the registry forgot this phase
+
+
+def _phase_orphan(spec, state, net, cache, buf, t0, t1):   # R8
+    return state, buf
+
+
+def helper(x):
+    return jnp.asarray(x)

@@ -1,0 +1,15 @@
+"""R8 fixture: the phase is registered in the contract registry, so the
+tier-1 eval_shape check covers it."""
+import jax.numpy as jnp
+
+PHASE_CONTRACTS = (
+    ("_phase_orphan", "checked by tests/test_contracts.py"),
+)
+
+
+def _phase_orphan(spec, state, net, cache, buf, t0, t1):
+    return state, buf
+
+
+def helper(x):
+    return jnp.asarray(x)

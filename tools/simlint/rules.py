@@ -1,0 +1,496 @@
+"""The simlint rule catalogue (R1-R8).  See RULES.md for the narrative
+version with offending/sanctioned snippets; docstrings here are the
+machine-adjacent summary."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    const_int_tuple,
+    dotted,
+    func_params,
+    is_const_expr,
+    is_jit_decorator,
+    jit_call_kwargs,
+    param_is_static,
+)
+
+_NP_SYNC_CALLS = {
+    "np.asarray", "np.array", "np.ascontiguousarray",
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+}
+
+_64BIT_DTYPES = {
+    "jnp.float64", "jnp.int64", "jnp.uint64", "jnp.complex128",
+    "np.float64", "np.int64", "numpy.float64", "numpy.int64",
+}
+_64BIT_STRINGS = {"float64", "int64", "uint64", "complex128"}
+
+_SMALL_DTYPES = {
+    "jnp.int8", "jnp.int16", "jnp.int32", "jnp.uint8", "jnp.uint16",
+    "jnp.uint32", "jnp.float32", "jnp.float16", "jnp.bfloat16",
+}
+
+
+class HostSyncRule(Rule):
+    """R1: host-sync in device code — ``.item()``, ``float()/int()/
+    bool()`` on traced values, ``np.asarray``/``np.array`` on device
+    arrays.  Each forces a device->host transfer that serializes the
+    step stream (and is simply invalid under `lax.scan` tracing)."""
+
+    id = "R1"
+    title = "host sync in device code"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn, node in mod.device_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            roots = mod.traced_roots(fn)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                yield mod.finding(
+                    self.id, node,
+                    "`.item()` forces a blocking device->host sync inside "
+                    "device code; keep the value on device (0-d array) or "
+                    "move the readback outside the jit/scan boundary",
+                )
+                continue
+            name = dotted(node.func)
+            if name in _NP_SYNC_CALLS and any(
+                mod.expr_is_traced(a, roots) for a in node.args
+            ):
+                yield mod.finding(
+                    self.id, node,
+                    f"`{name}(...)` on a traced value materializes it on "
+                    "host; use `jnp` ops (or hoist the conversion out of "
+                    "the device path)",
+                )
+                continue
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and mod.expr_is_traced(node.args[0], roots)
+            ):
+                yield mod.finding(
+                    self.id, node,
+                    f"`{node.func.id}(...)` of a traced value is a hidden "
+                    "host sync (concretization error under jit); use "
+                    "`.astype(...)` / `jnp.*` casts instead",
+                )
+
+
+class TracedBranchRule(Rule):
+    """R2: Python ``if``/``while`` branching on traced comparisons —
+    a concretization error under jit, and a per-value recompile when it
+    accidentally works via early concrete values."""
+
+    id = "R2"
+    title = "Python branch on traced value"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn, node in mod.device_nodes():
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            roots = mod.traced_roots(fn)
+            if mod.expr_is_traced(node.test, roots):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield mod.finding(
+                    self.id, node,
+                    f"Python `{kind}` on a traced comparison; use "
+                    "`jnp.where` / `lax.cond` / `lax.while_loop` (static "
+                    "spec fields are fine — annotate them)",
+                )
+
+
+def _jit_sites(
+    mod: ModuleInfo,
+) -> Iterable[Tuple[ast.AST, Optional[ast.FunctionDef], Dict[str, ast.AST]]]:
+    """(site_node, wrapped_function_def_or_None, jit_kwargs)."""
+    by_name = {f.name: f for f in mod.functions}
+    for f in mod.functions:
+        for dec in f.decorator_list:
+            if is_jit_decorator(dec):
+                yield dec, f, (jit_call_kwargs(dec) or {})
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or dotted(node.func) not in (
+            "jax.jit", "jit",
+        ):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        wrapped: Optional[ast.FunctionDef] = None
+        if node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Name):
+                wrapped = by_name.get(a0.id)
+            elif isinstance(a0, ast.Call):  # jax.jit(jax.vmap(f))
+                for inner in ast.walk(a0):
+                    if isinstance(inner, ast.Name) and inner.id in by_name:
+                        wrapped = by_name[inner.id]
+                        break
+        yield node, wrapped, kwargs
+
+
+def _module_globals(mod: ModuleInfo) -> Set[str]:
+    names: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else
+                [node.target]
+            )
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, (ast.If, ast.Try)):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for alias in sub.names:
+                        names.add((alias.asname or alias.name).split(".")[0])
+    return names
+
+
+class RecompileHazardRule(Rule):
+    """R3: recompile triggers at jit boundaries — (a) array-annotated
+    params marked static (retrace per value, unhashable TypeError), and
+    (b) traced values captured by closure into a jit entry point (baked
+    in as constants; silently retraced/re-embedded per call)."""
+
+    id = "R3"
+    title = "recompile hazard at jit boundary"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        import builtins
+
+        mod_globals = _module_globals(mod)
+        for site, wrapped, kwargs in _jit_sites(mod):
+            # (a) static argnums pointing at array-annotated params
+            if wrapped is not None and "static_argnums" in kwargs:
+                idxs = const_int_tuple(kwargs["static_argnums"]) or ()
+                params = func_params(wrapped)
+                for i in idxs:
+                    if i < len(params):
+                        p = params[i]
+                        if p.annotation is not None and not param_is_static(p):
+                            yield mod.finding(
+                                self.id, site,
+                                f"static_argnums marks `{p.arg}: "
+                                f"{ast.unparse(p.annotation)}` static: "
+                                "arrays are unhashable (TypeError) or "
+                                "retrace per value; pass it traced or "
+                                "donate it",
+                            )
+            if "static_argnames" in kwargs and wrapped is not None:
+                names = {
+                    n.value
+                    for n in ast.walk(kwargs["static_argnames"])
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str)
+                }
+                for p in func_params(wrapped):
+                    if p.arg in names and p.annotation is not None and not (
+                        param_is_static(p)
+                    ):
+                        yield mod.finding(
+                            self.id, site,
+                            f"static_argnames marks array-annotated "
+                            f"`{p.arg}` static (recompile per value)",
+                        )
+            # (b) closure capture of traced values from outside the boundary
+            if wrapped is None:
+                continue
+            outer_chain = mod.function_chain(wrapped)[1:]  # strictly outside
+            if not outer_chain:
+                continue
+            inside = {wrapped, *(
+                f for f in mod.functions
+                if wrapped in mod.function_chain(f)
+            )}
+            inside_locals: Set[str] = set()
+            for f in inside:
+                inside_locals |= mod.local_names(f)
+            reported: Set[str] = set()
+            for node in ast.walk(wrapped):
+                if not (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                ):
+                    continue
+                name = node.id
+                if (
+                    name in reported
+                    or name in inside_locals
+                    or name in mod_globals
+                    or hasattr(builtins, name)
+                ):
+                    continue
+                for outer in outer_chain:
+                    if name not in mod.local_names(outer):
+                        continue
+                    params = {a.arg: a for a in func_params(outer)}
+                    traced = False
+                    if name in params:
+                        traced = not param_is_static(params[name])
+                    else:
+                        roots = mod.traced_roots(outer)
+                        for stmt in ast.walk(outer):
+                            if isinstance(stmt, ast.Assign) and any(
+                                isinstance(t, ast.Name) and t.id == name
+                                for t in stmt.targets
+                            ):
+                                if mod.expr_is_traced(stmt.value, roots):
+                                    traced = True
+                    if traced:
+                        reported.add(name)
+                        yield mod.finding(
+                            self.id, node,
+                            f"jit entry `{wrapped.name}` closes over traced "
+                            f"`{name}` from `{outer.name}`: the array is "
+                            "baked into the trace as a constant (re-traced "
+                            "and re-embedded per call); pass it as an "
+                            "argument",
+                        )
+                    break
+
+
+class DtypePromotionRule(Rule):
+    """R4: dtype discipline — 64-bit dtypes in device paths (silent f32
+    truncation with x64 off, 2x memory + carry mismatch with it on) and
+    `jax_enable_x64` flips anywhere.  Host-side `np.float64` (scave
+    exporters, Bianchi tables in net/topology.py) stays legal."""
+
+    id = "R4"
+    title = "64-bit dtype in device path"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn, node in mod.device_nodes():
+            if isinstance(node, ast.Attribute):
+                name = dotted(node)
+                if name in _64BIT_DTYPES:
+                    parent = mod.parents.get(node)
+                    if isinstance(parent, ast.Attribute):
+                        continue  # report the outermost chain only
+                    yield mod.finding(
+                        self.id, node,
+                        f"`{name}` in device code: with x64 disabled this "
+                        "silently becomes 32-bit; with it enabled it "
+                        "doubles memory and breaks carry contracts — use "
+                        "an explicit 32-bit dtype",
+                    )
+            elif isinstance(node, ast.Call):
+                cname = dotted(node.func) or ""
+                if not cname.startswith(("jnp.", "jax.")):
+                    continue
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value in _64BIT_STRINGS
+                    ):
+                        yield mod.finding(
+                            self.id, node,
+                            f'dtype="{kw.value.value}" in device code '
+                            "(see R4: 64-bit dtypes are banned on the "
+                            "device path)",
+                        )
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and dotted(node.func) == "jax.config.update"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "jax_enable_x64"
+            ):
+                yield mod.finding(
+                    self.id, node,
+                    "`jax_enable_x64` flip: the engine's carries and "
+                    "parity gates are f32/int8-disciplined; enabling x64 "
+                    "process-wide changes every weak-typed promotion",
+                )
+
+
+class NondeterminismRule(Rule):
+    """R5: host RNG in device paths — `random`/`np.random` draws are
+    invisible to the jax PRNG key threading, so same-seed determinism
+    (and the DES parity gates) silently break; the engine is
+    `jax.random`-only."""
+
+    id = "R5"
+    title = "host RNG in device path"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        imports_random = any(
+            isinstance(node, ast.Import)
+            and any(a.name == "random" for a in node.names)
+            for node in ast.walk(mod.tree)
+        )
+        for fn, node in mod.device_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if name.startswith(("np.random.", "numpy.random.")):
+                yield mod.finding(
+                    self.id, node,
+                    f"`{name}` in device code: numpy RNG state is host-"
+                    "global and unkeyed — use `jax.random` with a "
+                    "threaded key (same-seed determinism gate)",
+                )
+            elif name.startswith("random.") and imports_random:
+                yield mod.finding(
+                    self.id, node,
+                    f"stdlib `{name}` in device code: wall-clock-seeded "
+                    "host RNG (the reference's rand() bug class); use "
+                    "`jax.random`",
+                )
+
+
+class DonationRule(Rule):
+    """R6: jit entry points taking the WorldState carry must donate it —
+    the carry dominates the bytes/tick footprint, and without
+    `donate_argnums` XLA keeps input and output copies live."""
+
+    id = "R6"
+    title = "missing donate_argnums on large-carry jit entry"
+
+    # unannotated params with these names count as carries too, so
+    # dropping the WorldState annotation cannot evade the rule
+    CARRY_NAMES = {"state", "batch", "carry", "world"}
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for site, wrapped, kwargs in _jit_sites(mod):
+            if wrapped is None:
+                continue
+            if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+                continue
+            carry = [
+                p.arg
+                for p in func_params(wrapped)
+                if (
+                    p.annotation is not None
+                    and "WorldState" in ast.unparse(p.annotation)
+                )
+                or (p.annotation is None and p.arg in self.CARRY_NAMES)
+            ]
+            if carry:
+                yield mod.finding(
+                    self.id, site,
+                    f"jit entry `{wrapped.name}` takes WorldState carry "
+                    f"`{carry[0]}` without donate_argnums: input + output "
+                    "copies of the dominant state footprint stay live; "
+                    "donate the carry (or suppress with a reason if "
+                    "callers must reuse the input)",
+                )
+
+
+class ConstantChurnRule(Rule):
+    """R7: the same scalar constant (`jnp.int8(int(Stage.X))`-style)
+    constructed repeatedly inside device functions of one module — each
+    occurrence re-enters tracing and op-by-op dispatch; hoist one
+    module-level constant."""
+
+    id = "R7"
+    title = "repeated per-call scalar constant construction"
+    threshold = 3
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        seen: Dict[str, List[ast.Call]] = {}
+        for fn, node in mod.device_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func) in _SMALL_DTYPES and node.args and all(
+                is_const_expr(a) for a in node.args
+            ):
+                seen.setdefault(ast.unparse(node), []).append(node)
+        for text, nodes in seen.items():
+            if len(nodes) >= self.threshold:
+                first = min(nodes, key=lambda n: n.lineno)
+                yield mod.finding(
+                    self.id, first,
+                    f"`{text}` constructed {len(nodes)}x in this module's "
+                    "device functions; hoist it to one module-level "
+                    "constant (numpy scalars keep the dtype with zero "
+                    "per-trace churn)",
+                )
+
+
+class ContractCoverageRule(Rule):
+    """R8: every engine phase (`_phase_*`) must be registered in the
+    trace-time contract registry (PHASE_CONTRACTS /
+    core/contracts.py) so tier-1 eval_shape checks catch carry
+    promotion before it recompiles on TPU."""
+
+    id = "R8"
+    title = "engine phase missing a trace-time contract"
+
+    def check_project(
+        self, mods: Sequence[ModuleInfo]
+    ) -> Iterable[Finding]:
+        phases: List[Tuple[ModuleInfo, ast.FunctionDef]] = []
+        covered: Set[str] = set()
+        for mod in mods:
+            for f in mod.functions:
+                if f.name.startswith("_phase_"):
+                    phases.append((mod, f))
+            for node in ast.walk(mod.tree):
+                is_registry_assign = (
+                    isinstance(node, (ast.Assign, ast.AnnAssign))
+                    and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "PHASE_CONTRACTS"
+                        for t in (
+                            node.targets
+                            if isinstance(node, ast.Assign)
+                            else [node.target]
+                        )
+                    )
+                )
+                if is_registry_assign and node.value is not None:
+                    for c in ast.walk(node.value):
+                        if isinstance(c, ast.Constant) and isinstance(
+                            c.value, str
+                        ):
+                            covered.add(c.value)
+                if (
+                    isinstance(node, ast.Call)
+                    and (dotted(node.func) or "").endswith("PhaseContract")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    covered.add(node.args[0].value)
+        for mod, f in phases:
+            if f.name not in covered:
+                yield mod.finding(
+                    self.id, f,
+                    f"engine phase `{f.name}` has no entry in "
+                    "PHASE_CONTRACTS (core/contracts.py): its carry "
+                    "shape/dtype contract is unchecked in tier-1 — "
+                    "register it (and let tests/test_contracts.py trace "
+                    "it)",
+                )
+
+
+def default_rules() -> Tuple[Rule, ...]:
+    return (
+        HostSyncRule(),
+        TracedBranchRule(),
+        RecompileHazardRule(),
+        DtypePromotionRule(),
+        NondeterminismRule(),
+        DonationRule(),
+        ConstantChurnRule(),
+        ContractCoverageRule(),
+    )
