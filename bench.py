@@ -32,7 +32,12 @@ reference itself publishes no throughput numbers — BASELINE.md).
 
 Env knobs: BENCH_USERS, BENCH_FOGS, BENCH_HORIZON, BENCH_INTERVAL,
 BENCH_DT, BENCH_PIPELINE, BENCH_REPS, BENCH_REPLICAS (vmap fan-out),
-auto-shrunk world on cpu backends.
+auto-shrunk world on cpu backends.  BENCH_POLICY=<name|id> (e.g. ``ucb``,
+``ducb``, ``exp3``) swaps the scheduler — the learned-policy rows track
+the overhead of the in-loop bandit updates (decision bookkeeping +
+delayed-reward credit phase) against the min_busy default; learned
+policies disable the derive_acks fast path (they credit at ack time
+inside the tick).
 """
 from __future__ import annotations
 
@@ -73,6 +78,9 @@ def main() -> None:
     from fognetsimpp_tpu.core.engine import run
     from fognetsimpp_tpu.parallel import replicate_state
     from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.spec import LEARNED_POLICIES, policy_from_name
+
+    policy = policy_from_name(os.environ.get("BENCH_POLICY", "min_busy"))
 
     mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
     build_kw = dict(
@@ -82,13 +90,16 @@ def main() -> None:
         send_interval=interval,
         horizon=horizon,
         dt=dt,
+        policy=int(policy),
         max_sends_per_user=int(horizon / interval) + 4,
         max_sends_per_tick=mspt,
         queue_capacity=128,
         start_time_max=min(0.05, horizon / 4),
         # ack columns reconstructed once post-run (bit-exact; r5): the
-        # per-tick scatters they cost are ~25 us each on the v5e
-        derive_acks=True,
+        # per-tick scatters they cost are ~25 us each on the v5e —
+        # except for the learned policies, which must observe the
+        # status-6 ack inside the tick to credit their rewards
+        derive_acks=policy not in LEARNED_POLICIES,
     )
     # default window: the K=4096 O(K^2)-rank sweet spot — warm-up
     # overflow defers to later windows (counted in n_deferred) and
@@ -172,6 +183,7 @@ def main() -> None:
                 "value": round(value, 1),
                 "unit": "decisions/s",
                 "vs_baseline": round(value / 1e6, 4),
+                "policy": policy.name.lower(),
                 "backend": backend,
                 "n_users": n_users,
                 "n_fogs": n_fogs,

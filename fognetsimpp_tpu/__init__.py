@@ -16,6 +16,8 @@ See SURVEY.md at the repository root for the reference structural analysis
 this build follows, and README.md for usage.
 """
 from .spec import (  # noqa: F401
+    ARGMIN_FAMILY,
+    LEARNED_POLICIES,
     BugCompat,
     FogModel,
     Mobility,
@@ -23,6 +25,7 @@ from .spec import (  # noqa: F401
     Policy,
     Stage,
     WorldSpec,
+    policy_from_name,
 )
 from .state import WorldState, init_state  # noqa: F401
 from .core.engine import (  # noqa: F401

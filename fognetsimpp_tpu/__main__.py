@@ -21,12 +21,29 @@ import time
 
 
 def main(argv=None) -> int:
+    # spec-only import (no jax): the help text enumerates the live policy
+    # catalogue from the enum instead of hardcoding a count that rots
+    from .spec import (
+        ARGMIN_FAMILY,
+        LEARNED_POLICIES,
+        Policy,
+        policy_from_name,
+    )
+
+    _policy_catalogue = ", ".join(
+        f"{p.name.lower()}={int(p)}" for p in Policy
+    )
     ap = argparse.ArgumentParser(
         prog="python -m fognetsimpp_tpu",
         description="TPU-native fog-computing simulator (FogNetSim++ capability set)",
     )
     ap.add_argument("--config", "-c", help="ini-style config file")
     ap.add_argument("--scenario", "-s", help="scenario builder name")
+    ap.add_argument(
+        "--policy", "-p", default=None, metavar="NAME|ID",
+        help="scheduling policy by name or id (shorthand for "
+        f"scenario.policy): {_policy_catalogue}",
+    )
     ap.add_argument(
         "--set", action="append", default=[], metavar="KEY=VALUE",
         help="config override (e.g. spec.horizon=2.0, fog.0.mips=4000); "
@@ -49,13 +66,25 @@ def main(argv=None) -> int:
                     help="force a jax platform (cpu/tpu)")
     ap.add_argument("--analyze", metavar="DIR", default=None,
                     help="analyse recorded runs in DIR and exit (.anf analog)")
+    _dyn_names = ", ".join(
+        p.name.lower() for p in tuple(ARGMIN_FAMILY) + tuple(LEARNED_POLICIES)
+    )
     ap.add_argument("--sweep", metavar="GRID", default=None,
                     help="policy x load sweep over the scenario, e.g. "
-                    "'policies=0,1,2 loads=0.01,0.02,0.05 reps=4 "
-                    "dynamic=1' — one JSON line per (policy, load); "
-                    "dynamic=1 compiles the whole grid ONCE "
-                    "(Policy.DYNAMIC, argmin-family ids 0-4)")
+                    "'policies=min_busy,ucb loads=0.01,0.02,0.05 reps=4 "
+                    "dynamic=1' — policies by name or id; one JSON line "
+                    "per (policy, load); dynamic=1 compiles the whole "
+                    f"grid ONCE (Policy.DYNAMIC: {_dyn_names}); "
+                    "'policy=ucb explores=0.1,0.5 loads=...' instead "
+                    "sweeps a learned policy's exploration-rate x load "
+                    "grid under one compile")
     args = ap.parse_args(argv)
+    if args.policy is not None:
+        try:
+            args.policy = int(policy_from_name(args.policy))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
 
     if args.analyze:
         from .runtime.analysis import analyze, render_report
@@ -84,6 +113,8 @@ def main(argv=None) -> int:
     pre = []
     if args.scenario:
         pre.append(f"scenario = {args.scenario}")
+    if args.policy is not None:
+        pre.append(f"scenario.policy = {args.policy}")
     for o in args.set:
         if "=" not in o:
             ap.error(f"--set needs KEY=VALUE, got {o!r}")
@@ -95,14 +126,58 @@ def main(argv=None) -> int:
     cfg = Config.from_str("\n".join(pre) + "\n" + text)
 
     if args.sweep:
+        import numpy as np
+
         from .config.ini import scenario_builders
-        from .parallel import sweep_policies
+        from .parallel import sweep_explore, sweep_policies
 
         if args.ticks or args.trails:
             ap.error("--sweep is incompatible with --ticks/--trails "
                      "(sweeps return counter grids, not series)")
+        if args.policy is not None:
+            print(
+                "error: --policy conflicts with --sweep (the sweep owns "
+                "the policy axis: use 'policies=...' or 'policy=...' "
+                "inside the grid spec)",
+                file=sys.stderr,
+            )
+            return 2
         opts = dict(kv.split("=", 1) for kv in args.sweep.split())
-        policies = [int(p) for p in opts.get("policies", "0").split(",")]
+        try:
+            # policy tokens are names OR ids (PR 1's unknown-name
+            # convention: a typo is a one-line error, never a traceback)
+            policies = [
+                int(policy_from_name(p))
+                for p in opts.get("policies", "0").split(",")
+            ]
+            explores = (
+                [float(x) for x in opts["explores"].split(",")]
+                if "explores" in opts
+                else None
+            )
+            exp_policy = (
+                int(policy_from_name(opts["policy"]))
+                if "policy" in opts
+                else None
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if explores is not None and exp_policy is None:
+            print(
+                "error: explores= sweeps need policy=<learned policy> "
+                f"(one of {', '.join(p.name.lower() for p in LEARNED_POLICIES)})",
+                file=sys.stderr,
+            )
+            return 2
+        if exp_policy is not None and explores is None:
+            print(
+                "error: policy= selects the exploration-rate sweep and "
+                "needs explores=<rates>; for a plain policy grid use "
+                "policies=... instead",
+                file=sys.stderr,
+            )
+            return 2
         loads = [float(x) for x in opts.get("loads", "0.05").split(",")]
         reps = int(opts.get("reps", "1"))
         dynamic = opts.get("dynamic", "0") not in ("0", "false", "")
@@ -132,16 +207,63 @@ def main(argv=None) -> int:
             )
         build_kwargs = cfg.matching("scenario")
         build_kwargs.pop("seed", None)
+        # the sweep owns the policy axis; a scenario.policy override would
+        # collide with the per-cell policy= kwarg inside the driver
+        build_kwargs.pop("policy", None)
         t0 = time.perf_counter()
-        grids = sweep_policies(
-            builders[name],
-            policies=policies,
-            load_intervals=loads,
-            n_replicas_per_load=reps,
-            dynamic=dynamic,
-            seed=args.seed or 0,
-            **build_kwargs,
-        )
+        if explores is not None:
+            try:
+                grids = sweep_explore(
+                    builders[name],
+                    policy=exp_policy,
+                    explore_rates=explores,
+                    load_intervals=loads,
+                    n_replicas_per_load=reps,
+                    seed=args.seed or 0,
+                    **build_kwargs,
+                )
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            for rate, g in grids.items():
+                for li, load in enumerate(loads):
+                    # mean over the replicas that credited anything (a
+                    # single empty replica must not NaN-poison the
+                    # cell); null — not a bare NaN token, invalid JSON —
+                    # when none did
+                    cell = g["lat_mean_s"][li]
+                    lm = (
+                        float(np.nanmean(cell))
+                        if np.isfinite(cell).any()
+                        else None
+                    )
+                    print(json.dumps({
+                        "policy": exp_policy, "explore": rate,
+                        "send_interval": load,
+                        "n_scheduled_mean": float(g["n_scheduled"][li].mean()),
+                        "n_completed_mean": float(g["n_completed"][li].mean()),
+                        "lat_mean_s": lm,
+                        "reps": reps,
+                    }))
+            print(json.dumps(
+                {"sweep_wall_s": round(time.perf_counter() - t0, 2),
+                 "explores": explores, "scenario": name}))
+            return 0
+        try:
+            grids = sweep_policies(
+                builders[name],
+                policies=policies,
+                load_intervals=loads,
+                n_replicas_per_load=reps,
+                dynamic=dynamic,
+                seed=args.seed or 0,
+                **build_kwargs,
+            )
+        except ValueError as e:
+            # e.g. a policy outside the traced-dispatch families under
+            # dynamic=1 — actionable one-liner, not a traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         for pol, g in grids.items():
             for li, load in enumerate(loads):
                 print(json.dumps({

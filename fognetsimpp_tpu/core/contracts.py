@@ -169,6 +169,13 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             when=lambda sp: sp.n_fogs > 0,
         ),
         PhaseContract(
+            "_phase_learn_credit",
+            lambda sp, s, n, c, b, t0, t1: E._phase_learn_credit(
+                sp, s, n, c, b, t1
+            ),
+            when=lambda sp: sp.learn_active,
+        ),
+        PhaseContract(
             "_phase_local_completions",
             lambda sp, s, n, c, b, t0, t1: E._phase_local_completions(
                 sp, s, n, c, b, t1

@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..learn.bandits import arms_view, exp3_probs
+from ..learn.rewards import credit_batch
 from ..net.mobility import MobilityBounds, step_mobility
 from ..net.energy import step_energy
 from ..net.topology import LinkCache, NetParams, associate
@@ -1087,9 +1089,12 @@ def _phase_broker(
     )
 
     offl = valid & ~local
-    if spec.policy in (int(Policy.RANDOM), int(Policy.DYNAMIC)):
+    if spec.policy in (
+        int(Policy.RANDOM), int(Policy.DYNAMIC), int(Policy.EXP3)
+    ):
         # the RANDOM stream is keyed on the global task id (shared with
-        # the native DES, see ops/sched.py::task_uniform)
+        # the native DES, see ops/sched.py::task_uniform); EXP3 samples
+        # its arm from the same batching-independent stream
         rand_u = task_uniform(
             jax.random.PRNGKey(spec.policy_seed), idxc
         )
@@ -1100,6 +1105,7 @@ def _phase_broker(
         b.registered, fog_alive, fog_efrac, rtt_bf, b.rr_next, k_sched,
         spec.bug_compat.mips0_divisor, spec.bug_compat.v1_max_scan,
         policy_id=b.policy_id, order_t=t_ab_g, rand_u=rand_u,
+        learn=arms_view(state.learn) if spec.learn_active else None,
     )
     choice_ok = choice >= 0
     guard_fail = jnp.zeros((K,), bool)
@@ -1119,6 +1125,43 @@ def _phase_broker(
     sched = offl & any_fog & choice_ok & ~guard_fail
     rejected = offl & any_fog & guard_fail
     no_res = offl & (~any_fog | (~choice_ok & ~guard_fail))
+
+    # ---- bandit decision bookkeeping (learn/bandits.py) ---------------
+    # Pick counts advance at the END of the window (every same-window
+    # arrival scored the same snapshot — the broker-view staleness
+    # contract), and the per-task provenance records the probability the
+    # picked arm had at decision time so the delayed credit phase can
+    # importance-weight EXP3 updates.  Statically gated: worlds on the
+    # pre-existing policies trace none of this.
+    learn2 = state.learn
+    if spec.learn_active:
+        picked = _per_fog(sched, choice, F)  # (F, K) membership
+        learn2 = learn2.replace(
+            pick_count=learn2.pick_count
+            + jnp.sum(picked, axis=1, dtype=jnp.float32)
+        )
+        exp3ish = spec.policy == int(Policy.EXP3) or (
+            spec.policy == int(Policy.DYNAMIC) and spec.learn_in_dynamic
+        )
+        if exp3ish:
+            p_vec = exp3_probs(
+                learn2.logw, b.registered & fog_alive, learn2.explore
+            )
+            # p at the chosen fog per row via the membership matrix (a
+            # (K,) gather from an (F,) table serializes under vmap)
+            p_row = jnp.sum(jnp.where(picked, p_vec[:, None], 0.0), axis=0)
+            if spec.policy == int(Policy.DYNAMIC):
+                p_row = jnp.where(
+                    b.policy_id == int(Policy.EXP3), p_row, 1.0
+                )
+            # only EXP3-capable specs store provenance: the UCB family's
+            # pick_p stays at its all-ones init, so scattering ones per
+            # tick would be a dead ~25 us op in the hot broker phase
+            learn2 = learn2.replace(
+                pick_p=learn2.pick_p.at[idx].set(
+                    jnp.where(sched, p_row, 1.0), mode="drop"
+                )
+            )
 
     new_stage = jnp.where(
         sched,
@@ -1196,7 +1239,7 @@ def _phase_broker(
     return (
         state.replace(
             tasks=tasks, users=users, broker=b.replace(rr_next=rr_new),
-            metrics=metrics, key=key,
+            metrics=metrics, key=key, learn=learn2,
         ),
         buf,
         v2_resched,
@@ -1892,6 +1935,61 @@ def _phase_local_completions(
     )
 
 
+def _phase_learn_credit(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Delayed-reward credit assignment for the bandit schedulers.
+
+    A decision earns its reward only when the status-6 "performed" ack
+    reaches the client: each tick this phase finds the DONE tasks whose
+    ``t_ack6`` has passed and is not yet credited, and folds
+    ``reward = -latency`` (bounded via learn/rewards.py) into the arm
+    statistics of the fog picked at publish time (``tasks.fog``) — not
+    the fog that would be picked now.  The per-task ``credited`` flag
+    makes the credit exactly-once; rows beyond this tick's K-window
+    simply credit a later tick (the flag persists), so no reward is ever
+    lost or double-counted.  The discounted-UCB statistics decay once
+    per tick here whether or not anything credits (D-UCB's clock is
+    time, not events).
+    """
+    tasks, learn = state.tasks, state.learn
+    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    i32 = jnp.int32
+
+    due = (
+        (tasks.stage == _ST_DONE)
+        & (learn.credited == 0)
+        & (tasks.fog >= 0)
+        & (tasks.t_ack6 <= t1)
+    )
+    # same tick-keyed scan-origin rotation as the decision phases (so a
+    # sustained overflow cannot starve high-id tasks of credit), but no
+    # n_deferred accounting: that gauge tracks *decision* backlog
+    if K < T:
+        rot = (
+            (state.tick.astype(jnp.uint32) * jnp.uint32(2654435761))
+            % jnp.uint32(T)
+        ).astype(i32)
+    else:
+        rot = None
+    idx, idxc, valid = _compact(due, K, T, rot)
+    fog_g = tasks.fog[idxc]  # picked-at-publish-time fog (provenance)
+    lat = jnp.where(
+        valid, tasks.t_ack6[idxc] - tasks.t_create[idxc], 0.0
+    )
+    pick_p_g = learn.pick_p[idxc]
+    memb = _per_fog(valid, fog_g, F)  # (F, K)
+    learn = credit_batch(
+        learn, valid, memb, lat, pick_p_g,
+        spec.n_fogs, spec.learn_discount, spec.learn_reward_scale,
+    )
+    learn = learn.replace(
+        credited=learn.credited.at[idx].set(jnp.int8(1), mode="drop")
+    )
+    return state.replace(learn=learn), buf
+
+
 def _phase_periodic_adverts(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     t0: jax.Array, t1: jax.Array,
@@ -2115,6 +2213,10 @@ def make_step(
                 state, buf = _phase_fog_arrivals(spec, state, net, cache, buf, t1)
         if spec.policy == int(Policy.LOCAL_FIRST) and not spec.v2_local_broker:
             state, buf = _phase_local_completions(spec, state, net, cache, buf, t1)
+        if spec.learn_active:
+            # delayed-reward credit: after completions/arrivals so a
+            # status-6 ack that lands inside this tick credits this tick
+            state, buf = _phase_learn_credit(spec, state, net, cache, buf, t1)
 
         # 7b. flat per-node views of this tick's message counts, feeding
         # the cumulative per-module counters, the DropTail queues and the
@@ -2310,6 +2412,14 @@ def run(
                 # the tick's own association instead of recomputing it
                 "n_assoc": aux["n_assoc"],
             }
+            if spec.learn_active:
+                # bandit trajectory: per-fog cumulative picks + credited
+                # raw-latency accumulators — the regret harness
+                # (learn/eval.py) turns these into learnRegret /
+                # learnPicks curves without re-reading the task table
+                out["learn_picks"] = s.learn.pick_count
+                out["learn_lat_sum"] = s.learn.lat_sum
+                out["learn_lat_cnt"] = s.learn.lat_cnt
             if spec.record_trails:
                 # Tkenv movement-trail analog (runtime/trails.py)
                 out["pos"] = s.nodes.pos

@@ -1,0 +1,31 @@
+"""learn/ — online bandit schedulers inside the jitted tick loop.
+
+The subsystem has three layers:
+
+  * :mod:`.bandits` — the :class:`LearnState` pytree (carried in
+    ``WorldState``) plus the UCB1 / discounted-UCB index kernels and the
+    EXP3 distribution/sampling helpers that ``ops/sched.py`` dispatches
+    as ``Policy.UCB`` / ``Policy.DUCB`` / ``Policy.EXP3``;
+  * :mod:`.rewards` — delayed-reward credit assignment: reward =
+    ``-latency`` observed at status-5/6 ack time, credited to the fog
+    picked at publish time (``core/engine._phase_learn_credit``);
+  * :mod:`.eval` — the regret harness: replays one world under each
+    learned policy vs. the static per-world oracle and emits
+    ``learnRegret`` / ``learnPicks`` curves through the recorder.
+
+``.eval`` imports the engine, so it is NOT imported here (the engine's
+scheduler imports this package); reach it explicitly::
+
+    from fognetsimpp_tpu.learn import eval as learn_eval
+"""
+from .bandits import (  # noqa: F401
+    BanditArms,
+    LearnState,
+    arms_view,
+    ducb_scores,
+    exp3_probs,
+    exp3_sample,
+    init_learn_state,
+    ucb_scores,
+)
+from .rewards import credit_batch, reward_from_latency  # noqa: F401

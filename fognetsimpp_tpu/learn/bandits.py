@@ -1,0 +1,203 @@
+"""Bandit arm state + index kernels for the in-loop learned schedulers.
+
+Each fog node is an arm; the base broker is the learner.  The whole
+learner lives in :class:`LearnState` — a small pytree carried inside
+:class:`~fognetsimpp_tpu.state.WorldState` so the optimizer state is
+scan-carry-resident (compiled once, donated with the rest of the world,
+checkpointable, replicable under ``vmap``).  Decisions ride the existing
+``ops/sched.py`` argmin machinery: UCB/discounted-UCB are one masked
+argmax over a per-fog index vector (task-independent, like the
+reference's own scan between two advertisement arrivals), EXP3 samples
+per task from the softmax weights via the task-id-keyed uniform stream.
+
+Batched-decision semantics: every arrival decided in one tick window
+sees the SAME arm statistics snapshot — the exact analog of the broker
+view staleness the reference already has (``BrokerBaseApp3.cc:123-136``)
+— and the pick counts advance at the end of the window.  Rewards arrive
+*later* (status-5/6 ack time) and are credited by
+``core/engine._phase_learn_credit`` to the fog recorded at publish time.
+
+References: UCB node selection under delayed feedback follows "Learn and
+Pick Right Nodes to Offload" (arxiv 1804.08416); the discounted variant
+is D-UCB (arxiv 0805.3415); EXP3 is Auer et al.'s adversarial bandit.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..spec import WorldSpec
+
+# Score assigned to a never-picked arm: forces one exploratory pull per
+# arm before any index comparison matters (hoisted, simlint R7).
+_UNTRIED = np.float32(3.4e38)
+# Floor for discounted counts: an abandoned arm's decayed count tends to
+# zero, which would blow the confidence bonus to inf; the floor caps the
+# bonus while still making stale arms maximally attractive to re-probe.
+_DISC_FLOOR = np.float32(1e-3)
+_NEG_BIG = np.float32(-3.4e38)
+
+
+@struct.dataclass
+class LearnState:
+    """Carry-resident bandit learner (one per world / replica).
+
+    The (F,)-sized arm statistics are always allocated (a few hundred
+    bytes); the per-task decision-provenance columns are sized
+    ``spec.learn_capacity`` — the full task capacity when the learn
+    subsystem is active, zero rows otherwise.
+    """
+
+    pick_count: jax.Array  # (F,) f32 decisions routed to each fog
+    reward_cnt: jax.Array  # (F,) f32 rewards credited so far
+    reward_sum: jax.Array  # (F,) f32 sum of bounded rewards r in [0, 1]
+    disc_cnt: jax.Array  # (F,) f32 gamma-discounted credit count (D-UCB)
+    disc_sum: jax.Array  # (F,) f32 gamma-discounted reward sum
+    logw: jax.Array  # (F,) f32 EXP3 log-weights (kept mean-centred)
+    explore: jax.Array  # () f32 live exploration rate — TRACED, so a
+    #   replica fan-out sweeps exploration rates under one compile
+    lat_sum: jax.Array  # () f32 cumulative credited raw latency (s) —
+    #   feeds the regret harness (learn/eval.py) without re-reading the
+    #   task table per tick
+    lat_cnt: jax.Array  # () f32 number of credited tasks
+    # --- per-task decision provenance (learn_capacity rows) -----------
+    pick_p: jax.Array  # (Tl,) f32 probability the picked arm had at
+    #   decision time (1.0 for the deterministic UCB family); EXP3's
+    #   importance weights divide by this at credit time
+    credited: jax.Array  # (Tl,) i8 1 once the task's reward was credited
+
+
+def init_learn_state(spec: WorldSpec) -> LearnState:
+    """The t=0 learner for ``spec`` (inert zero-row provenance when the
+    learn subsystem is off)."""
+    F, Tl = spec.n_fogs, spec.learn_capacity
+    f32 = jnp.float32
+    return LearnState(
+        pick_count=jnp.zeros((F,), f32),
+        reward_cnt=jnp.zeros((F,), f32),
+        reward_sum=jnp.zeros((F,), f32),
+        disc_cnt=jnp.zeros((F,), f32),
+        disc_sum=jnp.zeros((F,), f32),
+        logw=jnp.zeros((F,), f32),
+        explore=jnp.asarray(spec.learn_explore, f32),
+        lat_sum=jnp.zeros((), f32),
+        lat_cnt=jnp.zeros((), f32),
+        pick_p=jnp.ones((Tl,), f32),
+        credited=jnp.zeros((Tl,), jnp.int8),
+    )
+
+
+class BanditArms(NamedTuple):
+    """The read-only arm view ``ops/sched.py`` scores against.
+
+    A plain NamedTuple (not the full LearnState) so the scheduler kernel
+    signature stays a flat list of arrays — the same convention as the
+    broker-view columns it sits next to.
+    """
+
+    pick_count: jax.Array  # (F,) f32
+    reward_cnt: jax.Array  # (F,) f32
+    reward_sum: jax.Array  # (F,) f32
+    disc_cnt: jax.Array  # (F,) f32
+    disc_sum: jax.Array  # (F,) f32
+    logw: jax.Array  # (F,) f32
+    explore: jax.Array  # () f32 traced
+
+
+def arms_view(learn: LearnState) -> BanditArms:
+    """The scheduler-facing slice of a :class:`LearnState`."""
+    return BanditArms(
+        pick_count=learn.pick_count,
+        reward_cnt=learn.reward_cnt,
+        reward_sum=learn.reward_sum,
+        disc_cnt=learn.disc_cnt,
+        disc_sum=learn.disc_sum,
+        logw=learn.logw,
+        explore=learn.explore,
+    )
+
+
+def ucb_scores(arms: BanditArms, avail: jax.Array) -> jax.Array:
+    """UCB1 index per arm (higher = better): mean + c*sqrt(ln t / n).
+
+    ``n`` is the PLAY count (decisions), the mean is over CREDITED
+    rewards only — under delayed feedback an arm with outstanding picks
+    keeps its exploration bonus shrinking while its mean lags, which is
+    exactly the optimism the delayed-ack setting needs (arxiv
+    1804.08416 §III).  Never-picked available arms score ``_UNTRIED``.
+    """
+    n = arms.pick_count
+    total = jnp.sum(jnp.where(avail, n, 0.0))
+    mean = arms.reward_sum / jnp.maximum(arms.reward_cnt, 1.0)
+    bonus = arms.explore * jnp.sqrt(jnp.log1p(total) / jnp.maximum(n, 1.0))
+    return jnp.where(n > 0, mean + bonus, _UNTRIED)
+
+
+def ducb_scores(arms: BanditArms, avail: jax.Array) -> jax.Array:
+    """Discounted-UCB index (D-UCB): UCB over gamma-decayed statistics.
+
+    The credit phase decays ``disc_cnt``/``disc_sum`` every tick, so an
+    arm unvisited for a while sees its effective count shrink and its
+    bonus regrow — the forgetting that tracks non-stationary fog load.
+    """
+    n = jnp.maximum(arms.disc_cnt, _DISC_FLOOR)
+    total = jnp.sum(jnp.where(avail, n, 0.0))
+    mean = arms.disc_sum / n
+    bonus = arms.explore * jnp.sqrt(jnp.log1p(total) / n)
+    return jnp.where(arms.pick_count > 0, mean + bonus, _UNTRIED)
+
+
+def exp3_probs(
+    logw: jax.Array, avail: jax.Array, gamma: jax.Array
+) -> jax.Array:
+    """EXP3 arm distribution over the available fogs.
+
+    ``p = (1-gamma) * softmax(logw | avail) + gamma/|avail|`` — the
+    uniform mixing floor bounds every importance weight by
+    ``|avail|/gamma``, which (with the mean-centring applied at credit
+    time) keeps the log-weights finite under adversarial rewards.
+    Unavailable arms get exactly 0.  All-unavailable returns the zero
+    vector; callers route those decisions to NO_RESOURCE like every
+    other policy.
+    """
+    z = jnp.where(avail, logw, _NEG_BIG)
+    z = z - jnp.max(z)
+    w = jnp.where(avail, jnp.exp(z), 0.0)
+    sm = w / jnp.maximum(jnp.sum(w), 1e-30)
+    n_avail = jnp.sum(avail.astype(jnp.float32))
+    mix = jnp.clip(gamma, 0.0, 1.0)
+    p = (1.0 - mix) * sm + mix * avail.astype(jnp.float32) / jnp.maximum(
+        n_avail, 1.0
+    )
+    # exact renormalisation over the available set (mix mass on
+    # unavailable arms was dropped by the mask above)
+    return p / jnp.maximum(jnp.sum(p), 1e-30)
+
+
+def exp3_sample(p: jax.Array, u: jax.Array) -> jax.Array:
+    """Inverse-CDF sample per task, guaranteed inside the support of p.
+
+    ``u`` is the task-id-keyed uniform stream (``ops.sched.task_uniform``)
+    — a pure function of the global task id, so the draw is independent
+    of tick batching, exactly like Policy.RANDOM's stream.
+
+    The target is ``clip(u, eps, 1) * cdf[-1]``, not ``u`` itself: a raw
+    ``u == 0.0`` draw (jax uniforms are [0, 1)) or a float32 cumsum that
+    tops out below 1 would otherwise let the first-True argmax land on a
+    zero-probability (unavailable) arm or fall off the end.  With a
+    strictly positive target bounded by the actual cumsum total, the
+    first bin reaching it always carries p > 0: either it is bin 0 (then
+    cdf[0] = p[0] >= target > 0) or its predecessor was below the target
+    (so this bin added mass).  The eps floor redistributes only the
+    bottom 1e-7 of mass.
+    """
+    cdf = jnp.cumsum(p)
+    total = cdf[-1]
+    target = jnp.clip(u, 1e-7, 1.0)[:, None] * total
+    arm = jnp.argmax(cdf[None, :] >= target, axis=1).astype(jnp.int32)
+    # degenerate all-zero p (no available arm): signal -1
+    return jnp.where(total > 0, arm, -1)

@@ -1,0 +1,71 @@
+"""Delayed-reward credit assignment for the bandit schedulers.
+
+The reward of an offload decision is the *observed* end-to-end latency:
+nothing is credited when the broker picks a fog, only when the status-6
+"performed" ack reaches the client (``core/engine._phase_learn_credit``
+finds those arrivals each tick).  The raw reward is ``-latency``; the
+bandit statistics store the bounded monotone transform
+
+    r = exp(-latency / learn_reward_scale)  in (0, 1]
+
+so UCB confidence bonuses have a fixed scale and EXP3's importance
+weights stay bounded.  The raw latency is accumulated separately
+(``lat_sum``/``lat_cnt``) for the regret harness, which reports regret
+in latency units, not reward units.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .bandits import LearnState
+
+
+def reward_from_latency(lat: jax.Array, scale: float) -> jax.Array:
+    """Bounded reward in (0, 1] from an observed ack latency (seconds)."""
+    return jnp.exp(-jnp.maximum(lat, 0.0) / jnp.float32(scale))
+
+
+def credit_batch(
+    learn: LearnState,
+    valid: jax.Array,  # (K,) bool — rows of this tick's credit window
+    memb: jax.Array,  # (F, K) bool — row f marks credits bound for fog f
+    lat: jax.Array,  # (K,) f32 observed latency (t_ack6 - t_create)
+    pick_p_g: jax.Array,  # (K,) f32 decision-time pick probability
+    n_fogs: int,
+    discount: float,
+    reward_scale: float,
+) -> LearnState:
+    """Fold one tick's credit window into the arm statistics.
+
+    All per-fog reductions are membership selects over the (F, K)
+    matrix — the same vmap-collapse-safe shape every engine phase uses
+    instead of scatter-adds.  The per-task ``credited`` flags are the
+    caller's to set (it owns the compaction indices).
+    """
+    f32 = jnp.float32
+    r01 = jnp.where(valid, reward_from_latency(lat, reward_scale), 0.0)
+    cnt_f = jnp.sum(memb, axis=1, dtype=f32)  # (F,)
+    sum_f = jnp.sum(jnp.where(memb, r01[None, :], 0.0), axis=1)
+
+    # EXP3 importance-weighted gain: eta * r / p(pick), eta = gamma/F.
+    # pick_p is 1.0 for the UCB family, so the update is a bounded
+    # spectator there; its floor mirrors exp3_probs' mixing floor.
+    eta = learn.explore / f32(max(n_fogs, 1))
+    gain = r01 / jnp.maximum(pick_p_g, 1e-6)
+    gain_f = eta * jnp.sum(jnp.where(memb, gain[None, :], 0.0), axis=1)
+    logw = learn.logw + gain_f
+    # mean-centring is a softmax invariant; it pins the weight drift so
+    # adversarial reward sequences cannot walk the weights to +/-inf
+    logw = logw - jnp.mean(logw)
+
+    g = f32(discount)
+    return learn.replace(
+        reward_cnt=learn.reward_cnt + cnt_f,
+        reward_sum=learn.reward_sum + sum_f,
+        disc_cnt=learn.disc_cnt * g + cnt_f,
+        disc_sum=learn.disc_sum * g + sum_f,
+        logw=logw,
+        lat_sum=learn.lat_sum + jnp.sum(jnp.where(valid, lat, 0.0)),
+        lat_cnt=learn.lat_cnt + jnp.sum(valid, dtype=f32),
+    )

@@ -24,6 +24,13 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..learn.bandits import (
+    BanditArms,
+    ducb_scores,
+    exp3_probs,
+    exp3_sample,
+    ucb_scores,
+)
 from ..spec import Policy
 
 _BIG = jnp.float32(3.4e38)
@@ -135,7 +142,12 @@ def schedule_batch(
     #   RANDOM — a pure function of the global task id (engine supplies
     #   task_uniform(spec.policy_seed, ids)) so the native DES can consume
     #   the identical stream; None derives a stream from `key` + index
-    #   (unit-test convenience, no DES parity)
+    #   (unit-test convenience, no DES parity).  EXP3 samples its arm
+    #   from the same stream.
+    learn: Optional[BanditArms] = None,  # bandit arm statistics view
+    #   (learn/bandits.py), required for the learned policies UCB/DUCB/
+    #   EXP3; when supplied under DYNAMIC the traced switch also covers
+    #   the bandit ids 8-10
 ) -> Tuple[jax.Array, jax.Array]:
     """Pick a fog node for every masked task. Returns ((T,) i32 fog, rr').
 
@@ -258,12 +270,46 @@ def schedule_batch(
         choice = jnp.where(n_ok > 0, choice, -1)
         return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
 
+    # ---- learned bandit policies (learn/bandits.py) -------------------
+    # UCB/DUCB are task-independent masked argmaxes over the arm index
+    # vector — one winner per window, exactly the shape of the argmin
+    # family above; EXP3 samples per task from the softmax weights via
+    # the task-id-keyed uniform stream.  Dead fogs are unusable (a pick
+    # would never ack, starving the learner of its own reward signal).
+    def _winner_from_index(scores, avail_):
+        win = jnp.argmax(jnp.where(avail_, scores, -_BIG)).astype(jnp.int32)
+        win = jnp.where(jnp.any(avail_), win, -1)
+        return jnp.where(mask, win, -1).astype(jnp.int32), rr_cursor
+
+    def b_ucb():
+        return _winner_from_index(
+            ucb_scores(learn, avail & fog_alive), avail & fog_alive
+        )
+
+    def b_ducb():
+        return _winner_from_index(
+            ducb_scores(learn, avail & fog_alive), avail & fog_alive
+        )
+
+    def b_exp3():
+        ok = avail & fog_alive
+        p = exp3_probs(learn.logw, ok, learn.explore)
+        if rand_u is None:
+            u = task_uniform(key, jnp.arange(T, dtype=jnp.int32))
+        else:
+            u = rand_u
+        choice = exp3_sample(p, u)
+        return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
+
     branches = {
         int(Policy.MIN_BUSY): b_min_busy,
         int(Policy.ROUND_ROBIN): b_round_robin,
         int(Policy.MIN_LATENCY): b_min_latency,
         int(Policy.ENERGY_AWARE): b_energy_aware,
         int(Policy.RANDOM): b_random,
+        int(Policy.UCB): b_ucb,
+        int(Policy.DUCB): b_ducb,
+        int(Policy.EXP3): b_exp3,
     }
     if policy == int(Policy.DYNAMIC):
         if policy_id is None:
@@ -278,7 +324,24 @@ def schedule_batch(
         idx = jnp.where(
             (policy_id < 0) | (policy_id > 4), 5, policy_id
         ).astype(jnp.int32)
+        if learn is not None:
+            # the traced switch additionally covers the bandit ids: the
+            # branch table appends [ucb, ducb, exp3] at 6..8 and the id
+            # remap sends 8..10 there (5..7 stay invalid — LOCAL_FIRST/
+            # MAX_MIPS/DYNAMIC have no traced dispatch)
+            ordered = ordered + [b_ucb, b_ducb, b_exp3]
+            bandit = (policy_id >= int(Policy.UCB)) & (
+                policy_id <= int(Policy.EXP3)
+            )
+            idx = jnp.where(bandit, policy_id - 2, idx).astype(jnp.int32)
         return jax.lax.switch(idx, ordered)
+    if policy in (int(Policy.UCB), int(Policy.DUCB), int(Policy.EXP3)):
+        if learn is None:
+            raise ValueError(
+                f"policy {Policy(policy).name} needs the bandit arm view "
+                "(learn=BanditArms)"
+            )
+        return branches[policy]()
     if policy not in branches:
         raise ValueError(f"unknown policy {policy}")
     return branches[policy]()

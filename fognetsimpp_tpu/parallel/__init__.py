@@ -11,7 +11,10 @@ The TPU-native scale-out axes this package provides instead:
     matrix via ``shard_map`` with cross-shard argmin combines, for worlds
     whose fog population exceeds one chip's comfortable tile.
   * **EP** — :func:`sweep.sweep_policies`: the policy axis of the grid
-    (the reference's dead ``algo`` parameter made sweepable).
+    (the reference's dead ``algo`` parameter made sweepable), and
+    :func:`sweep.sweep_explore`: the exploration-rate axis of the
+    learned bandit schedulers (``LearnState.explore`` as carry data —
+    the whole rate × load grid under one compile).
 
 Collectives ride the mesh (ICI within a slice, DCN across) through XLA —
 ``all_gather``/``pmin`` inserted by ``shard_map`` — never hand-written
@@ -20,6 +23,6 @@ transports.
 from .replicas import replicate_state, run_replicated, replica_counters  # noqa: F401
 from .mesh import make_mesh, replica_sharding, shard_replicas, run_sharded  # noqa: F401
 from .multihost import global_mesh, initialize  # noqa: F401
-from .sweep import sweep_policies  # noqa: F401
+from .sweep import sweep_explore, sweep_policies  # noqa: F401
 from .taskshard import run_node_sharded, shard_state_by_node  # noqa: F401
 from .tp import sharded_min_busy  # noqa: F401

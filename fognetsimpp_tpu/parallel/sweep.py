@@ -5,10 +5,16 @@ schedulers × 256 load levels").  The *load* axis is always dynamic — the
 per-user publish interval is a state array (``users.send_interval``, the
 reference's volatile ``sendInterval`` NED parameter), so every load level
 × Monte-Carlo replica runs inside one ``vmap``.  The *policy* axis has two
-modes: static (one compile per policy — any policy, incl. LOCAL_FIRST/
-MAX_MIPS) or ``dynamic=True`` (``Policy.DYNAMIC``: the policy id rides in
-``BrokerView.policy_id`` as traced data, so the ENTIRE grid is one
-compile; argmin family only).  Either way the grid shards over the mesh.
+modes: static (one compile per policy — any member of ``spec.Policy``,
+incl. LOCAL_FIRST/MAX_MIPS and the learned bandits) or ``dynamic=True``
+(``Policy.DYNAMIC``: the policy id rides in ``BrokerView.policy_id`` as
+traced data, so the ENTIRE grid is one compile; the argmin family
+``spec.ARGMIN_FAMILY`` plus — when bandit ids appear in the grid — the
+learned ``spec.LEARNED_POLICIES``).  For the learned policies the
+*exploration rate* is one more data axis (``LearnState.explore`` is
+carry-resident and traced): :func:`sweep_explore` runs a whole
+exploration-rate × load grid for one bandit under a single compile.
+Either way the grid shards over the mesh.
 """
 from __future__ import annotations
 
@@ -19,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from ..spec import ARGMIN_FAMILY, LEARNED_POLICIES, Policy
 from .mesh import run_sharded
 from .replicas import replica_counters, replicate_state, run_replicated
 
@@ -41,10 +48,12 @@ def sweep_policies(
     ``load_intervals`` are publish intervals in seconds (smaller = heavier).
 
     ``dynamic=True`` runs the whole grid under ONE compile: the world is
-    built with ``Policy.DYNAMIC`` and each replica carries its policy id as
-    data (argmin-family policies 0-4 only).  The static path compiles one
-    program per policy — prefer it when a policy outside that family is in
-    the grid.
+    built with ``Policy.DYNAMIC`` and each replica carries its policy id
+    as data (the argmin family, plus the learned bandit ids when any
+    appear in ``policies`` — the build then carries live LearnState via
+    ``learn_in_dynamic``).  The static path compiles one program per
+    policy — prefer it when a policy outside those families is in the
+    grid.
 
     Returns ``{policy: {counter: (n_loads, n_replicas) array}}``.
     """
@@ -75,12 +84,20 @@ def sweep_policies(
 
     out: Dict[int, Dict[str, np.ndarray]] = {}
     if dynamic:
-        from ..spec import Policy
-
-        if any(not 0 <= int(p) <= 4 for p in policies):
-            raise ValueError(
-                "dynamic sweeps cover the argmin family (policy ids 0-4)"
+        argmin_ids = {int(p) for p in ARGMIN_FAMILY}
+        learned_ids = {int(p) for p in LEARNED_POLICIES}
+        if any(int(p) not in argmin_ids | learned_ids for p in policies):
+            names = ", ".join(
+                f"{p.name.lower()}={int(p)}"
+                for p in ARGMIN_FAMILY + LEARNED_POLICIES
             )
+            raise ValueError(
+                f"dynamic sweeps cover the traced-dispatch families "
+                f"({names})"
+            )
+        if any(int(p) in learned_ids for p in policies):
+            # carry live bandit state + extend the traced switch
+            build_kwargs.setdefault("learn_in_dynamic", True)
         spec, state, net, bounds = build(
             policy=int(Policy.DYNAMIC), **build_kwargs
         )
@@ -121,5 +138,83 @@ def sweep_policies(
         out[int(pol)] = {
             k: v.reshape(n_loads, n_replicas_per_load)
             for k, v in replica_counters(final).items()
+        }
+    return out
+
+
+def sweep_explore(
+    build: Callable[..., tuple],
+    policy: int,
+    explore_rates: Sequence[float],
+    load_intervals: Sequence[float],
+    n_replicas_per_load: int = 1,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    n_ticks: Optional[int] = None,
+    **build_kwargs,
+) -> Dict[float, Dict[str, np.ndarray]]:
+    """Exploration-rate × load grid for ONE learned policy, one compile.
+
+    The bandit's exploration rate lives in the scan carry
+    (``LearnState.explore``, traced) rather than the static spec, so the
+    whole grid is a single replica fan-out of one compiled program — no
+    ``Policy.DYNAMIC`` switch needed, the policy itself is static.
+    Replica order is (explore, load, rep), mirroring
+    :func:`sweep_policies`' (policy, load, rep).
+
+    Returns ``{explore_rate: {counter: (n_loads, n_replicas) array}}``;
+    each grid additionally carries ``lat_mean_s`` (mean credited task
+    latency — the regret harness's raw material) and ``lat_cnt``.
+    """
+    if int(policy) not in {int(p) for p in LEARNED_POLICIES}:
+        names = ", ".join(p.name.lower() for p in LEARNED_POLICIES)
+        raise ValueError(
+            f"sweep_explore sweeps the learned policies ({names}); got "
+            f"policy id {int(policy)}"
+        )
+    n_loads, n_exp = len(load_intervals), len(explore_rates)
+    build_kwargs.setdefault("send_interval", min(load_intervals))
+    spec, state, net, bounds = build(policy=int(policy), **build_kwargs)
+    nlr = n_loads * n_replicas_per_load
+    R = n_exp * nlr
+    # one nlr-wide replica block, tiled per exploration rate: every rate
+    # sees the same per-replica PRNG keys/start times (grid cells differ
+    # only where the experiment says they should)
+    base = replicate_state(spec, state, nlr, seed=seed)
+    batch = jax.tree.map(lambda x: jnp.concatenate([x] * n_exp, axis=0), base)
+    exp_col = jnp.repeat(
+        jnp.asarray(explore_rates, jnp.float32), nlr
+    )  # (R,)
+    batch = batch.replace(learn=batch.learn.replace(explore=exp_col))
+    si = jnp.tile(
+        jnp.repeat(
+            jnp.asarray(load_intervals, jnp.float32), n_replicas_per_load
+        ),
+        n_exp,
+    )
+    batch = batch.replace(
+        users=batch.users.replace(
+            send_interval=jnp.broadcast_to(si[:, None], (R, spec.n_users))
+        )
+    )
+    if mesh is not None:
+        final = run_sharded(spec, batch, net, bounds, mesh, n_ticks=n_ticks)
+    else:
+        final = run_replicated(spec, batch, net, bounds, n_ticks=n_ticks)
+    counters = replica_counters(final)
+    cnt = np.asarray(final.learn.lat_cnt)
+    counters["lat_cnt"] = cnt
+    # NaN (not 0.0) for cells where nothing was credited: a zero mean
+    # would read as the best possible latency for the emptiest cell
+    counters["lat_mean_s"] = np.where(
+        cnt > 0, np.asarray(final.learn.lat_sum) / np.maximum(cnt, 1.0),
+        np.nan,
+    )
+    out: Dict[float, Dict[str, np.ndarray]] = {}
+    for i, e in enumerate(explore_rates):
+        sl = slice(i * nlr, (i + 1) * nlr)
+        out[float(e)] = {
+            k: v[sl].reshape(n_loads, n_replicas_per_load)
+            for k, v in counters.items()
         }
     return out

@@ -92,6 +92,9 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
     pool = np.asarray(final.fogs.pool_avail)
     q_len = np.asarray(final.fogs.q_len)
     q_drops = np.asarray(final.fogs.q_drops)
+    learn_picks = (
+        np.asarray(final.learn.pick_count) if spec.learn_active else None
+    )
     # stack-level rows (r2 missing #4): per-node message counters — the
     # "packets sent"/"packets received" and per-NIC traffic rows of the
     # reference's ~1.5k-scalar .sca — plus per-AP association occupancy.
@@ -132,6 +135,13 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
             "tx_msgs": int(tx[U + f]),
             "rx_msgs": int(rx[U + f]),
             "link_bytes": int(link_bytes[U + f]),
+            # bandit-scheduler arm row (the learnPicks[f] scalar): only
+            # present when the learn subsystem is live for this spec
+            **(
+                {"learn_picks": float(learn_picks[f])}
+                if learn_picks is not None
+                else {}
+            ),
         }
         for f in range(F)
     ]
@@ -173,12 +183,18 @@ def record_run(
     run_id: str = "General-0",
     attrs: Optional[Dict] = None,
     scave: bool = True,
+    extra_vectors: Optional[Dict[str, np.ndarray]] = None,
 ) -> Dict[str, str]:
     """Persist one finished run. Returns {'sca': path, 'vec': path}.
 
     ``scave=True`` additionally emits OMNeT++ text-format twins
     (``<run_id>.sca`` / ``.vec`` + a ``General.anf`` descriptor) readable
     by the reference's Scave tooling (:mod:`fognetsimpp_tpu.runtime.scave`).
+
+    ``extra_vectors`` adds caller-computed signal vectors to the
+    ``.vec.npz`` under their given names (unlike ``series``, whose keys
+    get the ``tick.`` prefix) — the regret harness emits its
+    ``learnRegret``/``learnPicks`` curves this way (learn/eval.py).
     """
     os.makedirs(outdir, exist_ok=True)
     sca_path = os.path.join(outdir, f"{run_id}.sca.json")
@@ -203,6 +219,9 @@ def record_run(
     if series is not None:
         for k, v in series.items():
             vectors[f"tick.{k}"] = np.asarray(v)
+    if extra_vectors is not None:
+        for k, v in extra_vectors.items():
+            vectors[k] = np.asarray(v)
     np.savez_compressed(vec_path, **vectors)
     paths = {"sca": sca_path, "vec": vec_path}
     if scave:

@@ -87,4 +87,16 @@ def summarize(final: WorldState) -> Dict[str, float]:
         out[f"{name}_n"] = int(v.size)
         out[f"{name}_mean_ms"] = float(v.mean()) if v.size else float("nan")
         out[f"{name}_max_ms"] = float(v.max()) if v.size else float("nan")
+    # bandit-scheduler roll-up (learn/): credited-reward census + the
+    # credited mean latency the regret harness compares against oracles.
+    # pick_p has learn_capacity rows, so its size doubles as the
+    # subsystem's is-active flag without needing the spec here.
+    if np.asarray(final.learn.pick_p).size:
+        lat_cnt = float(final.learn.lat_cnt)
+        out["learn_credited"] = int(lat_cnt)
+        out["learn_lat_mean_ms"] = (
+            float(final.learn.lat_sum) / lat_cnt * 1e3
+            if lat_cnt > 0
+            else float("nan")
+        )
     return out

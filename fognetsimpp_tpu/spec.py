@@ -94,8 +94,56 @@ class Policy(enum.IntEnum):
     #               compares every candidate to brokers[0]
     #               (BrokerBaseApp.cc:228-240; see BugCompat.v1_max_scan)
     DYNAMIC = 7  # policy chosen by the *traced* BrokerView.policy_id
-    #              (ids 0-4, the argmin family): one compile covers a whole
-    #              policy x load x replica sweep grid (EP axis as data)
+    #              (the argmin family ids 0-4, plus the learned bandit ids
+    #              8-10 when spec.learn_in_dynamic): one compile covers a
+    #              whole policy x load x replica sweep grid (EP axis as data)
+    # --- online bandit schedulers (fognetsimpp_tpu.learn) -------------
+    # Each fog node is an arm; the broker learns from observed ack
+    # latencies (reward = -latency, credited at status-5/6 ack time to
+    # the fog picked at publish time — core/engine._phase_learn_credit).
+    UCB = 8  # UCB1 over per-fog reward means + exploration bonus
+    DUCB = 9  # discounted UCB (gamma-decayed stats; non-stationary worlds)
+    EXP3 = 10  # adversarial EXP3 (softmax log-weights, importance-weighted)
+
+
+#: The traced-dispatch family Policy.DYNAMIC covers via ``policy_id``.
+ARGMIN_FAMILY: Tuple[Policy, ...] = (
+    Policy.MIN_BUSY,
+    Policy.ROUND_ROBIN,
+    Policy.MIN_LATENCY,
+    Policy.ENERGY_AWARE,
+    Policy.RANDOM,
+)
+
+#: The online-learning policies backed by the ``learn/`` subsystem.
+LEARNED_POLICIES: Tuple[Policy, ...] = (Policy.UCB, Policy.DUCB, Policy.EXP3)
+
+
+def policy_from_name(name) -> Policy:
+    """Resolve a policy given either its integer id or its enum name.
+
+    Accepts ``"ucb"``, ``"MIN_BUSY"``, ``"3"``, ``3`` ... — the CLI tier
+    (``--policy``, ``--sweep 'policies=...'``) goes through here so an
+    unknown name becomes one actionable ``ValueError`` listing the valid
+    names instead of a traceback.
+    """
+    if isinstance(name, (int, Policy)):
+        try:
+            return Policy(int(name))
+        except ValueError:
+            pass
+    else:
+        s = str(name).strip()
+        try:
+            return Policy(int(s))
+        except ValueError:
+            pass
+        try:
+            return Policy[s.upper()]
+        except KeyError:
+            pass
+    known = ", ".join(f"{p.name.lower()}={int(p)}" for p in Policy)
+    raise ValueError(f"unknown policy {name!r} (have {known})")
 
 
 class FogModel(enum.IntEnum):
@@ -266,6 +314,25 @@ class WorldSpec:
     # effectively 2x this.  See _phase_pool_arrivals.
     pool_phases: int = 4
 
+    # --- online learning (fognetsimpp_tpu.learn) ------------------------
+    # Exploration rate: UCB/DUCB confidence-bonus coefficient c, or the
+    # EXP3 uniform-mixing weight gamma.  Only the INITIAL value: the live
+    # rate rides the carry (LearnState.explore, traced) so a replica fan-
+    # out can sweep exploration rates under one compile (parallel/sweep
+    # .sweep_explore).
+    learn_explore: float = 0.5
+    # Per-tick decay of the discounted-UCB statistics (gamma of arxiv
+    # 0805.3415's D-UCB); 1.0 degenerates to plain UCB accounting.
+    learn_discount: float = 0.995
+    # Latency scale (s) of the bounded reward map r = exp(-latency/scale)
+    # (learn/rewards.py): the ack latency at which a credit is worth 1/e.
+    learn_reward_scale: float = 0.25
+    # Policy.DYNAMIC normally dispatches the argmin family (ids 0-4) only;
+    # True extends the traced switch with the bandit ids 8-10 AND carries
+    # live LearnState, so a single-compile grid can mix static and learned
+    # schedulers per replica.
+    learn_in_dynamic: bool = False
+
     # --- wireless uplink loss ------------------------------------------
     # Probability a publish is lost before reaching the broker (802.11 MAC
     # retry exhaustion, emergent in INET; e.g. the committed demo run loses
@@ -415,6 +482,28 @@ class WorldSpec:
         return self.max_sends_per_tick + (0 if self.assume_static else 1)
 
     @property
+    def learn_active(self) -> bool:
+        """Whether the ``learn/`` bandit subsystem is live for this spec.
+
+        True for the learned policies themselves and for DYNAMIC grids
+        that opted the bandit ids into the traced switch.  Static under
+        jit: it gates whether the engine traces the decision bookkeeping
+        and the delayed-reward credit phase at all, so worlds running the
+        pre-existing policies stay bit-exact (and allocation-identical up
+        to the empty provenance columns).
+        """
+        if self.policy in tuple(int(p) for p in LEARNED_POLICIES):
+            return True
+        return self.policy == int(Policy.DYNAMIC) and self.learn_in_dynamic
+
+    @property
+    def learn_capacity(self) -> int:
+        """Rows of the per-task decision-provenance columns (0 when the
+        learn subsystem is off, so inert worlds pay no task-table-sized
+        memory for it)."""
+        return self.task_capacity if self.learn_active else 0
+
+    @property
     def auto_arrival_window(self) -> int:
         """Window sized from the spec's own arrival rate (VERDICT r3 #4).
 
@@ -464,6 +553,29 @@ class WorldSpec:
             assert self.send_interval_jitter == 0.0, (
                 "the closed-form multi-send spawn needs deterministic "
                 "send spacing (send_interval_jitter == 0)"
+            )
+        if self.learn_active:
+            assert self.n_fogs >= 1, (
+                "learned policies need at least one fog node (arm)"
+            )
+            assert not self.derive_acks, (
+                "learned policies credit rewards at ack time inside the "
+                "tick; derive_acks reconstructs the ack columns only "
+                "after the scan"
+            )
+            assert self.app_gen >= 2, (
+                "learned policies need the status-6 ack chain (app_gen "
+                ">= 2): the v1 broker drops TaskAcks, so no reward "
+                "signal ever reaches the learner"
+            )
+            assert 0.0 < self.learn_discount <= 1.0
+            assert self.learn_reward_scale > 0.0
+            assert self.learn_explore >= 0.0
+        if self.learn_in_dynamic:
+            assert self.policy == int(Policy.DYNAMIC), (
+                "learn_in_dynamic extends the DYNAMIC traced switch: set "
+                "policy=Policy.DYNAMIC (a static learned policy needs no "
+                "switch)"
             )
         if self.policy == int(Policy.LOCAL_FIRST):
             assert self.broker_mips > 0, (

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from .learn.bandits import LearnState, init_learn_state
 from .spec import NodeKind, Policy, Stage, WorldSpec
 
 # Sentinel for "no task": valid task ids are [0, T).
@@ -221,6 +222,8 @@ class WorldState:
     broker: BrokerView
     tasks: TaskState
     metrics: Metrics
+    learn: LearnState  # bandit-scheduler state (learn/bandits.py);
+    #   inert zero-row provenance when spec.learn_active is False
 
 
 def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
@@ -370,4 +373,5 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         broker=broker,
         tasks=tasks,
         metrics=metrics,
+        learn=init_learn_state(spec),
     )

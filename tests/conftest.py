@@ -52,6 +52,10 @@ _QUICK_FILES = {
     "test_contracts.py",
     "test_donation.py",
     "test_cli_errors.py",
+    # learn/ bandit schedulers (ISSUE 2): unit + regret-harness gates on
+    # small worlds — the in-loop-learning capability must stay inside the
+    # edit loop, not drift behind the slow tier
+    "test_learn.py",
 }
 
 

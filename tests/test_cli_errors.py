@@ -1,5 +1,6 @@
 """CLI error-path regressions: an .ini referencing an unknown scenario/
-network name must produce a one-line actionable error, not a traceback."""
+network name — or a ``--policy``/``--sweep`` naming an unknown policy —
+must produce a one-line actionable error, not a traceback."""
 from fognetsimpp_tpu.__main__ import main
 
 
@@ -22,3 +23,62 @@ def test_unknown_network_in_ini_is_clear_error(tmp_path, capsys):
     assert rc == 2
     assert "NoSuchNetwork" in captured.err
     assert "Traceback" not in captured.err
+
+
+def test_unknown_policy_name_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--policy", "warp_speed"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "unknown policy" in captured.err
+    assert "Traceback" not in captured.err
+    # the valid names are listed so the fix is obvious
+    assert "ucb" in captured.err and "min_busy" in captured.err
+
+
+def test_sweep_unknown_policy_name_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--sweep", "policies=min_busy,warp"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "unknown policy 'warp'" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_sweep_explores_without_learned_policy_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--sweep", "explores=0.1,0.5"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "explores=" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_sweep_policy_without_explores_is_clear_error(capsys):
+    """policy= (singular) selects the exploration sweep; without
+    explores= it must error, not silently run the default policy grid."""
+    rc = main(["--scenario", "smoke", "--sweep", "policy=ducb loads=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "explores=" in captured.err and "policies=" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_policy_flag_conflicts_with_sweep(capsys):
+    rc = main(["--scenario", "smoke", "--policy", "ucb",
+               "--sweep", "policies=min_busy loads=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "--policy" in captured.err and "--sweep" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_sweep_accepts_policy_names(capsys):
+    """'policies=' tokens resolve by enum name as well as by id."""
+    rc = main([
+        "--scenario", "smoke",
+        "--set", "scenario.horizon=0.2",
+        "--sweep", "policies=min_busy,random loads=0.05",
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert '"policy": 0' in captured.out
+    assert '"policy": 4' in captured.out

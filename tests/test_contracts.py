@@ -14,8 +14,9 @@ from fognetsimpp_tpu.scenarios import smoke
 
 def _worlds():
     # FIFO v3 argmin-family world (dense broker), v2 POOL LOCAL_FIRST
-    # world (compacted broker + pool phases + v2 release timer), and a
-    # coarse-dt multi-send world (spawn_multi)
+    # world (compacted broker + pool phases + v2 release timer), a
+    # coarse-dt multi-send world (spawn_multi), and a learned-policy
+    # world (compacted broker + the bandit credit phase)
     return [
         smoke.build(horizon=0.4),
         smoke.build(
@@ -26,6 +27,7 @@ def _worlds():
         smoke.build(
             horizon=0.3, dt=0.2, send_interval=0.05, max_sends_per_tick=8
         ),
+        smoke.build(horizon=0.4, policy=8),  # Policy.UCB
     ]
 
 

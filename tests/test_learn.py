@@ -1,0 +1,363 @@
+"""learn/ — online bandit schedulers: decision provenance, delayed
+credit, the regret harness, one-compile exploration sweeps, and the
+bit-exactness of every pre-existing policy around the new carry field.
+
+The heterogeneous 8-fog world: two fast fogs (8000 MIPS) among six slow
+ones (1000 MIPS), moderately loaded so queueing separates good and bad
+arms without saturating the fast pair.  All numbers are deterministic
+(fixed seed, CPU backend) — the asserted margins are wide (2x+), not
+knife-edge.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, run
+from fognetsimpp_tpu.learn import eval as learn_eval
+from fognetsimpp_tpu.scenarios import smoke
+
+# the regret world of the acceptance gate: >= 8 heterogeneous fogs
+HET = dict(
+    n_users=4,
+    n_fogs=8,
+    fog_mips=(
+        8000.0, 1000.0, 1000.0, 1000.0, 1000.0, 1000.0, 1000.0, 8000.0,
+    ),
+    send_interval=0.25,
+    horizon=20.0,  # 2000 ticks: enough for >2x margins on every gate
+    #   while keeping the quick tier's wall-clock budget in sight
+    dt=0.01,
+    learn_discount=0.9995,
+    learn_explore=0.3,
+    learn_reward_scale=0.5,
+)
+FAST_FOGS = (0, 7)
+
+_CACHE = {}
+
+
+def _statics():
+    if "statics" not in _CACHE:
+        _CACHE["statics"] = learn_eval.static_oracle(
+            smoke.build,
+            statics=(Policy.MIN_BUSY, Policy.ROUND_ROBIN, Policy.RANDOM),
+            **HET,
+        )
+    return _CACHE["statics"]
+
+
+def _ducb():
+    if "ducb" not in _CACHE:
+        _CACHE["ducb"] = learn_eval.run_policy(
+            smoke.build, int(Policy.DUCB), record_series=True, **HET
+        )
+    return _CACHE["ducb"]
+
+
+def test_regret_harness_ducb_beats_random_and_tracks_oracle():
+    """The acceptance gate: on the heterogeneous 8-fog world,
+    discounted-UCB's mean task latency beats Policy.RANDOM and lands
+    within 15% of the best static policy for that world."""
+    best, means = _statics()
+    _, final, _ = _ducb()
+    ducb_mean = learn_eval.mean_task_latency_s(final)
+    assert np.isfinite(ducb_mean)
+    assert ducb_mean < means[int(Policy.RANDOM)], (
+        f"DUCB {ducb_mean:.3f}s should beat RANDOM "
+        f"{means[int(Policy.RANDOM)]:.3f}s"
+    )
+    assert ducb_mean <= 1.15 * means[best], (
+        f"DUCB {ducb_mean:.3f}s vs best static "
+        f"({Policy(best).name}) {means[best]:.3f}s"
+    )
+
+
+def test_ducb_picks_concentrate_on_the_fast_fogs():
+    _, final, _ = _ducb()
+    picks = np.asarray(final.learn.pick_count)
+    fast = sum(picks[f] for f in FAST_FOGS)
+    assert fast > 0.6 * picks.sum(), picks
+    # every arm was explored at least once (the forced-pull bootstrap)
+    assert (picks > 0).all()
+
+
+def test_regret_curve_is_monotone_evidence_and_ends_low():
+    """learnRegret: per-tick credited-mean latency minus the oracle's
+    mean — it must end at (or below) the 15% band the mean-latency gate
+    asserts, and the pick curve must be cumulative."""
+    best, means = _statics()
+    _, _, series = _ducb()
+    curves = learn_eval.regret_curves(series, means[best])
+    r = curves["learnRegret"]
+    picks = curves["learnPicks"]
+    assert r.shape[0] == picks.shape[0]
+    assert picks.shape[1] == HET["n_fogs"]
+    # cumulative pick counts never decrease
+    assert (np.diff(picks, axis=0) >= -1e-6).all()
+    assert r[-1] <= 0.15 * means[best]
+
+
+def test_harness_emits_regret_signals_through_recorder(tmp_path):
+    from fognetsimpp_tpu.runtime.recorder import load_scalars, load_vectors
+
+    out = learn_eval.evaluate(
+        smoke.build,
+        learned=(Policy.UCB,),
+        statics=(Policy.RANDOM,),
+        outdir=str(tmp_path),
+        n_users=2,
+        n_fogs=2,
+        fog_mips=(4000.0, 500.0),
+        send_interval=0.2,
+        horizon=3.0,
+    )
+    entry = out["learned"]["ucb"]
+    vec = load_vectors(entry["paths"]["vec"])
+    assert "learnRegret" in vec and "learnPicks" in vec
+    assert np.isfinite(vec["learnRegret"]).all()
+    assert vec["learnPicks"].shape[1] == 2
+    sca = load_scalars(entry["paths"]["sca"])
+    # per-fog learnPicks scalar rows + the summarize() roll-up
+    assert all("learn_picks" in f for f in sca["modules"]["fog"])
+    assert sca["scalars"]["learn_credited"] >= 1
+
+
+def test_explore_load_grid_runs_in_one_compile():
+    """The exploration-rate x load grid of a learned policy reuses ONE
+    compiled program: explore rides the carry (LearnState.explore), load
+    rides users.send_interval — a second grid with different rates (same
+    shapes) is a pure jit-cache hit."""
+    from fognetsimpp_tpu.parallel.replicas import _run_replicated
+    from fognetsimpp_tpu.parallel.sweep import sweep_explore
+
+    kw = dict(
+        n_users=2, n_fogs=3, fog_mips=(4000.0, 500.0, 1000.0),
+        horizon=0.5,
+    )
+    base = _run_replicated._cache_size()
+    g1 = sweep_explore(
+        smoke.build, policy=int(Policy.UCB), explore_rates=[0.1, 0.7],
+        load_intervals=[0.05, 0.1], n_replicas_per_load=2, **kw
+    )
+    assert _run_replicated._cache_size() == base + 1
+    # a second grid over different RATES reuses the same program: the
+    # rate axis is carry data, not a compile axis (the load axis sizes
+    # spec capacity, so changing the load grid legitimately recompiles)
+    g2 = sweep_explore(
+        smoke.build, policy=int(Policy.UCB), explore_rates=[0.3, 0.9],
+        load_intervals=[0.05, 0.1], n_replicas_per_load=2, **kw
+    )
+    assert _run_replicated._cache_size() == base + 1, (
+        "second exploration-rate grid must be a jit-cache hit"
+    )
+    for g in (g1, g2):
+        assert len(g) == 2
+        for grid in g.values():
+            assert grid["n_scheduled"].shape == (2, 2)
+            assert "lat_mean_s" in grid and "lat_cnt" in grid
+
+
+def test_dynamic_grid_dispatches_bandit_ids():
+    """Policy.DYNAMIC + learn_in_dynamic: static and bandit ids mix in
+    one traced-switch grid, and the bandit replicas actually learn."""
+    from fognetsimpp_tpu.parallel.sweep import sweep_policies
+
+    grids = sweep_policies(
+        smoke.build,
+        policies=[int(Policy.MIN_BUSY), int(Policy.UCB), int(Policy.EXP3)],
+        load_intervals=[0.05],
+        dynamic=True,
+        n_users=2,
+        n_fogs=3,
+        fog_mips=(4000.0, 500.0, 1000.0),
+        horizon=0.5,
+    )
+    assert set(grids) == {0, int(Policy.UCB), int(Policy.EXP3)}
+    for g in grids.values():
+        assert int(g["n_scheduled"].sum()) > 0
+
+
+def test_dynamic_grid_rejects_undispatchable_policy():
+    from fognetsimpp_tpu.parallel.sweep import sweep_policies
+
+    with pytest.raises(ValueError, match="traced-dispatch"):
+        sweep_policies(
+            smoke.build, policies=[int(Policy.LOCAL_FIRST)],
+            load_intervals=[0.05], dynamic=True,
+        )
+
+
+def test_sweep_explore_rejects_static_policy():
+    from fognetsimpp_tpu.parallel.sweep import sweep_explore
+
+    with pytest.raises(ValueError, match="learned"):
+        sweep_explore(
+            smoke.build, policy=int(Policy.MIN_BUSY),
+            explore_rates=[0.1], load_intervals=[0.05],
+        )
+
+
+def test_delayed_credit_is_exactly_once_and_latency_exact():
+    """Every DONE task whose status-6 ack landed inside the horizon is
+    credited exactly once, with the exact ack latency, to the fog picked
+    at publish time; play counts equal broker scheduling decisions."""
+    spec, state, net, bounds = smoke.build(
+        n_users=3, n_fogs=4, fog_mips=(4000.0, 500.0, 1000.0, 2000.0),
+        send_interval=0.1, horizon=2.0, policy=int(Policy.UCB),
+    )
+    final, _ = run(spec, state, net, bounds)
+    from fognetsimpp_tpu import Stage
+
+    t = final.tasks
+    stage = np.asarray(t.stage)
+    ack6 = np.asarray(t.t_ack6)
+    done = stage == int(Stage.DONE)
+    landed = done & np.isfinite(ack6) & (ack6 <= float(final.t))
+    lat = ack6[landed] - np.asarray(t.t_create)[landed]
+    assert int(np.asarray(final.learn.lat_cnt)) == int(landed.sum())
+    np.testing.assert_allclose(
+        float(final.learn.lat_sum), lat.sum(), rtol=1e-5
+    )
+    credited = np.asarray(final.learn.credited)
+    np.testing.assert_array_equal(credited.astype(bool), landed)
+    # per-fog credit counts match the task table's provenance column
+    fogs = np.asarray(t.fog)[landed]
+    want = np.bincount(fogs, minlength=spec.n_fogs)
+    np.testing.assert_array_equal(
+        np.asarray(final.learn.reward_cnt).astype(int), want
+    )
+    assert int(np.asarray(final.learn.pick_count).sum()) == int(
+        np.asarray(final.metrics.n_scheduled)
+    )
+
+
+def test_checkpoint_roundtrip_carries_learn_state(tmp_path):
+    """A LearnState-carrying world round-trips bit-identically through
+    the checkpoint struct contract."""
+    from fognetsimpp_tpu.runtime import checkpoint
+
+    spec, state, net, bounds = smoke.build(
+        n_users=2, n_fogs=3, fog_mips=(4000.0, 500.0, 1000.0),
+        send_interval=0.1, horizon=1.0, policy=int(Policy.EXP3),
+    )
+    mid, _ = run(spec, state, net, bounds, n_ticks=400)
+    assert float(np.asarray(mid.learn.pick_count).sum()) > 0
+    p = str(tmp_path / "learn.npz")
+    checkpoint.save(p, spec, mid)
+    spec2, mid2 = checkpoint.load(p)
+    assert spec2.policy == spec.policy
+    for a, b in zip(jax.tree.leaves(mid), jax.tree.leaves(mid2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the restored world keeps running
+    fin, _ = run(spec2, mid2, net, bounds, n_ticks=50)
+    assert int(np.asarray(fin.tick)) == 450
+
+
+def _state_hash(state) -> bytes:
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def test_preexisting_policies_bit_exact_across_run_entries():
+    """State-hash A/B over 3 pre-existing-policy worlds: the learn carry
+    field flows through run / run_jit / run_chunked without perturbing a
+    single bit of the existing columns (and stays inert: zero learn
+    state throughout)."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    worlds = [
+        dict(policy=int(Policy.MIN_BUSY)),
+        dict(policy=int(Policy.RANDOM)),
+        dict(policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0),
+    ]
+    for kw in worlds:
+        spec, state, net, bounds = smoke.build(
+            horizon=0.4, n_users=2, n_fogs=2, send_interval=0.05, **kw
+        )
+        assert not spec.learn_active
+        assert spec.learn_capacity == 0
+        ref, _ = run(spec, state, net, bounds)
+        h_ref = _state_hash(ref)
+        assert float(np.asarray(ref.learn.pick_count).sum()) == 0.0
+        spec2, state2, net2, bounds2 = smoke.build(
+            horizon=0.4, n_users=2, n_fogs=2, send_interval=0.05, **kw
+        )
+        assert _state_hash(run_jit(spec2, state2, net2, bounds2)) == h_ref
+        spec3, state3, net3, bounds3 = smoke.build(
+            horizon=0.4, n_users=2, n_fogs=2, send_interval=0.05, **kw
+        )
+        assert (
+            _state_hash(run_chunked(spec3, state3, net3, bounds3, 170))
+            == h_ref
+        )
+
+
+def test_ucb_kernel_explores_untried_arms_first():
+    from fognetsimpp_tpu.learn.bandits import BanditArms, ucb_scores
+
+    F = 4
+    f32 = jnp.float32
+    arms = BanditArms(
+        pick_count=jnp.asarray([3.0, 0.0, 1.0, 0.0], f32),
+        reward_cnt=jnp.asarray([3.0, 0.0, 1.0, 0.0], f32),
+        reward_sum=jnp.asarray([2.9, 0.0, 0.2, 0.0], f32),
+        disc_cnt=jnp.zeros((F,), f32),
+        disc_sum=jnp.zeros((F,), f32),
+        logw=jnp.zeros((F,), f32),
+        explore=jnp.asarray(0.5, f32),
+    )
+    avail = jnp.ones((F,), bool)
+    s = np.asarray(ucb_scores(arms, avail))
+    # untried arms dominate any finite index
+    assert s[1] > s[0] and s[3] > s[0]
+    # among tried arms, the high-mean one wins
+    assert s[0] > s[2]
+
+
+def test_exp3_probs_mask_and_floor():
+    from fognetsimpp_tpu.learn.bandits import exp3_probs
+
+    logw = jnp.asarray([5.0, 0.0, 0.0, -5.0], jnp.float32)
+    avail = jnp.asarray([True, True, False, True])
+    p = np.asarray(exp3_probs(logw, avail, jnp.float32(0.2)))
+    assert p[2] == 0.0
+    np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-5)
+    # the gamma mixing floor keeps every available arm samplable
+    assert (p[[0, 1, 3]] > 0.2 / 3 * 0.9).all()
+
+
+def test_exp3_sample_stays_inside_the_support():
+    """Edge draws cannot select a zero-probability arm: u == 0.0 (jax
+    uniforms are [0,1)) must not land on an unavailable arm 0, and u
+    near 1 must not fall off a float32 cumsum that tops out below 1."""
+    from fognetsimpp_tpu.learn.bandits import exp3_probs, exp3_sample
+
+    avail = jnp.asarray([False, True, True, True])
+    p = exp3_probs(jnp.zeros((4,), jnp.float32), avail, jnp.float32(0.2))
+    arms = np.asarray(
+        exp3_sample(p, jnp.asarray([0.0, 0.5, 0.9999999], jnp.float32))
+    )
+    assert (arms != 0).all()
+    # skewed weights: the sampled arm always carries positive mass
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        logw = jnp.asarray(rng.normal(0, 10, size=6), jnp.float32)
+        av = jnp.asarray(rng.random(6) > 0.3)
+        if not bool(av.any()):
+            continue
+        pv = exp3_probs(logw, av, jnp.float32(0.05))
+        got = np.asarray(
+            exp3_sample(pv, jnp.asarray(rng.random(16), jnp.float32))
+        )
+        assert (np.asarray(pv)[got] > 0).all()
+    # no available arm at all still signals -1
+    p0 = exp3_probs(
+        jnp.zeros((3,), jnp.float32), jnp.zeros((3,), bool),
+        jnp.float32(0.2),
+    )
+    assert int(exp3_sample(p0, jnp.asarray([0.3], jnp.float32))[0]) == -1

@@ -94,3 +94,138 @@ def test_invariants_hold(seed, mips, interval):
     cur = np.asarray(final.fogs.current_task)
     for c in cur[cur >= 0]:
         assert stage[c] == int(Stage.RUNNING)
+
+
+# ----------------------------------------------------------------------
+# learn/ bandit invariants (driven at the kernel level for speed: the
+# full-engine integration lives in tests/test_learn.py)
+# ----------------------------------------------------------------------
+
+def _arms(F, explore=0.5):
+    from fognetsimpp_tpu.learn.bandits import BanditArms
+
+    f32 = jnp.float32
+    z = jnp.zeros((F,), f32)
+    return BanditArms(
+        pick_count=z, reward_cnt=z, reward_sum=z, disc_cnt=z, disc_sum=z,
+        logw=z, explore=jnp.asarray(explore, f32),
+    )
+
+
+@settings(max_examples=8, deadline=None)
+@given(
+    lat=st.lists(
+        st.sampled_from([0.02, 0.1, 0.4, 0.9, 1.5]),
+        min_size=5, max_size=5, unique=True,
+    ),
+    explore=st.floats(0.05, 0.8),
+)
+def test_ucb_pick_counts_concentrate_on_the_fastest_fog(lat, explore):
+    """Stationary heterogeneous arms: after a modest horizon the UCB
+    play counts concentrate on the lowest-latency fog."""
+    from fognetsimpp_tpu.learn.bandits import ucb_scores
+    from fognetsimpp_tpu.learn.rewards import reward_from_latency
+
+    F = len(lat)
+    arms = _arms(F, explore)
+    avail = jnp.ones((F,), bool)
+    lat_j = jnp.asarray(lat, jnp.float32)
+    for _ in range(150):
+        a = int(np.argmax(np.asarray(ucb_scores(arms, avail))))
+        r = reward_from_latency(lat_j[a], 0.5)
+        one = jnp.zeros((F,), jnp.float32).at[a].add(1.0)
+        arms = arms._replace(
+            pick_count=arms.pick_count + one,
+            reward_cnt=arms.reward_cnt + one,
+            reward_sum=arms.reward_sum + one * r,
+        )
+    picks = np.asarray(arms.pick_count)
+    best = int(np.argmin(lat))
+    assert int(np.argmax(picks)) == best
+    assert picks[best] > picks.sum() / 2
+
+
+@settings(max_examples=8, deadline=None)
+@given(
+    flips=st.lists(st.booleans(), min_size=60, max_size=60),
+    gamma=st.floats(0.05, 0.9),
+)
+def test_exp3_log_weights_stay_finite_under_adversarial_flips(flips, gamma):
+    """Adversarial reward sequences (arbitrary 0/1 flips chosen against
+    the sampler) cannot walk the EXP3 log-weights to +/-inf: the mixing
+    floor bounds each importance weight and the mean-centring pins the
+    drift."""
+    from fognetsimpp_tpu.learn.bandits import exp3_probs, exp3_sample
+    from fognetsimpp_tpu.learn.rewards import credit_batch
+    from fognetsimpp_tpu.learn.bandits import init_learn_state
+    from fognetsimpp_tpu.spec import Policy, WorldSpec
+
+    F = 3
+    spec = WorldSpec(
+        n_users=1, n_fogs=F, policy=int(Policy.EXP3), horizon=0.1
+    ).validate()
+    learn = init_learn_state(spec).replace(
+        explore=jnp.asarray(gamma, jnp.float32)
+    )
+    avail = jnp.ones((F,), bool)
+    for i, good in enumerate(flips):
+        p = exp3_probs(learn.logw, avail, learn.explore)
+        arm = int(exp3_sample(p, jnp.asarray([(i * 0.618) % 1.0]))[0])
+        # adversary: latency ~0 (reward 1) or huge (reward ~0)
+        lat = jnp.asarray([0.0 if good else 50.0], jnp.float32)
+        memb = (
+            jnp.arange(F)[:, None] == jnp.asarray([[arm]])
+        )  # (F, 1) one-hot
+        learn = credit_batch(
+            learn, jnp.asarray([True]), memb, lat,
+            jnp.asarray([float(p[arm])], jnp.float32),
+            F, spec.learn_discount, spec.learn_reward_scale,
+        )
+    logw = np.asarray(learn.logw)
+    assert np.isfinite(logw).all()
+    # mean-centred: bounded drift even after 60 adversarial credits
+    assert np.abs(logw).max() < 1e3
+    p = np.asarray(exp3_probs(learn.logw, avail, learn.explore))
+    assert np.isfinite(p).all() and abs(p.sum() - 1.0) < 1e-5
+
+
+@settings(max_examples=6, deadline=None)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    mips=st.lists(
+        st.sampled_from([500.0, 1000.0, 4000.0]), min_size=3, max_size=3
+    ),
+)
+def test_learn_state_checkpoint_roundtrips_bit_identically(
+    seed, mips, tmp_path_factory
+):
+    """A LearnState-carrying world survives checkpoint.save/load with
+    every leaf bit-identical (the struct contract covers the new carry
+    field too)."""
+    from fognetsimpp_tpu.runtime import checkpoint
+
+    spec, state0, net, bounds = _learn_world()
+    m = jnp.asarray(mips, jnp.float32)
+    state = state0.replace(
+        key=jax.random.PRNGKey(seed),
+        fogs=state0.fogs.replace(mips=m, pool_avail=m),
+    )
+    state = prime_initial_advertisements(spec, state, net)
+    mid, _ = run(spec, state, net, bounds, n_ticks=120)
+    p = str(tmp_path_factory.mktemp("ck") / "learn.npz")
+    checkpoint.save(p, spec, mid)
+    spec2, mid2 = checkpoint.load(p)
+    for a, b in zip(jax.tree.leaves(mid), jax.tree.leaves(mid2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+_LEARN_WORLD = {}
+
+
+def _learn_world():
+    if not _LEARN_WORLD:
+        _LEARN_WORLD["w"] = smoke.build(
+            horizon=0.4, send_interval=0.02, n_users=3, n_fogs=3,
+            policy=8,  # Policy.UCB
+        )
+    return _LEARN_WORLD["w"]

@@ -49,6 +49,8 @@ DEVICE_MODULE_GLOBS: Tuple[str, ...] = (
     "ops/*.py",
     "net/energy.py",
     "net/mobility.py",
+    "learn/bandits.py",
+    "learn/rewards.py",
     "parallel/tp.py",
     "state.py",
 )
