@@ -54,32 +54,16 @@ def _env_float(name: str, default: float) -> float:
     return float(os.environ.get(name, default))
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
+    """The shared bench world + its knob dict (single-chip and fleet)."""
+    from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.spec import LEARNED_POLICIES, policy_from_name
 
-    from fognetsimpp_tpu.compile_cache import enable_compile_cache
-
-    enable_compile_cache()
-
-    backend = jax.default_backend()
-    on_accel = backend not in ("cpu",)
-
-    n_users = _env_int("BENCH_USERS", 10_000 if on_accel else 1_000)
+    n_users = _env_int("BENCH_USERS", 10_000 if on_accel else cpu_users)
     n_fogs = _env_int("BENCH_FOGS", 32)
     horizon = _env_float("BENCH_HORIZON", 0.1 if on_accel else 0.05)
     interval = _env_float("BENCH_INTERVAL", 0.0025 if on_accel else 0.005)
     dt = _env_float("BENCH_DT", 5e-3)
-    n_replicas = _env_int("BENCH_REPLICAS", 1)
-    n_pipeline = _env_int("BENCH_PIPELINE", 30 if on_accel else 3)
-    n_reps = _env_int("BENCH_REPS", 3)
-
-    from fognetsimpp_tpu.core.engine import run
-    from fognetsimpp_tpu.parallel import replicate_state
-    from fognetsimpp_tpu.scenarios import smoke
-    from fognetsimpp_tpu.spec import LEARNED_POLICIES, policy_from_name
-
     policy = policy_from_name(os.environ.get("BENCH_POLICY", "min_busy"))
 
     mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
@@ -108,8 +92,6 @@ def main() -> None:
     # see WorldSpec.auto_arrival_window), BENCH_WINDOW=<int> pins it.
     win_env = os.environ.get("BENCH_WINDOW", "")
     if win_env == "auto":
-        from fognetsimpp_tpu.spec import WorldSpec  # noqa: F401
-
         spec0, *_ = smoke.build(arrival_window=None, **build_kw)
         window = spec0.auto_arrival_window
     elif win_env:
@@ -119,6 +101,36 @@ def main() -> None:
             4096, max(1024, int(1.1 * n_users * min(dt, 1e-3) / interval))
         )
     spec, state, net, bounds = smoke.build(arrival_window=window, **build_kw)
+    knobs = dict(
+        n_users=n_users, n_fogs=n_fogs, horizon=horizon,
+        interval=interval, dt=dt, policy=policy,
+    )
+    return spec, state, net, bounds, knobs
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fognetsimpp_tpu.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+
+    n_replicas = _env_int("BENCH_REPLICAS", 1)
+    n_pipeline = _env_int("BENCH_PIPELINE", 30 if on_accel else 3)
+    n_reps = _env_int("BENCH_REPS", 3)
+
+    from fognetsimpp_tpu.core.engine import run
+    from fognetsimpp_tpu.parallel import replicate_state
+
+    spec, state, net, bounds, knobs = _build_bench_world(on_accel)
+    n_users, n_fogs = knobs["n_users"], knobs["n_fogs"]
+    horizon, interval = knobs["horizon"], knobs["interval"]
+    dt, policy = knobs["dt"], knobs["policy"]
 
     # one jitted call runs the whole pipeline of independent simulations
     # (fresh key each, same compiled body) and returns one scalar — the
@@ -208,5 +220,158 @@ def main() -> None:
     )
 
 
+def ensure_mesh_devices(n: int, flip_unset: bool = False) -> None:
+    """Guarantee an ``n``-device jax platform before backend init.
+
+    One shared copy of the virtual-device provisioning dance (the
+    reviewer-flagged duplicate between ``fleet_main`` and
+    ``__graft_entry__.dryrun_multichip``): append the
+    host-platform-device-count XLA flag when absent, flip a tunneled
+    single-chip session (axon sitecustomize) to the virtual CPU
+    platform — with ``flip_unset=True`` (the dryrun's historical
+    behavior) an unset platform is flipped too; the fleet benchmark
+    leaves it alone so a real multi-chip host measures its own hardware
+    — then validate the device count.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+    import jax
+
+    platforms = jax.config.jax_platforms or ""
+    if "axon" in platforms or (flip_unset and platforms in ("", None)):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"need {n} devices, found {len(jax.devices())}; for a "
+            "virtual CPU mesh run with JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+
+
+def fleet_measurement(n_devices=None) -> dict:
+    """Measured replica-sharded multi-chip throughput (ISSUE 3).
+
+    Replaces the compile-only ``dryrun_multichip ok`` flag with real
+    metric fields: the SAME bench world runs (a) one replica on a
+    1-device mesh and (b) ``n_devices x BENCH_RPD`` replicas sharded
+    over the full mesh — both through
+    :func:`fognetsimpp_tpu.parallel.fleet.fleet_decisions` (one jitted
+    call per measurement, a pipeline of complete fleets, one scalar
+    pair fetched), so the aggregate number and the weak-scaling
+    efficiency ``aggregate / (n_devices x single-device)`` share one
+    methodology.  Correctness of the path itself is gated separately:
+    per-replica state hashes equal the vmap path
+    (``tests/test_fleet.py``).
+
+    Assumes the devices already exist (callers own the
+    ``xla_force_host_platform_device_count`` dance —
+    ``__graft_entry__.dryrun_multichip`` or the ``--fleet`` entry).
+    """
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu.compile_cache import enable_compile_cache
+    from fognetsimpp_tpu.parallel import make_mesh, replicate_state
+    from fognetsimpp_tpu.parallel.fleet import fleet_decisions
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    D = int(n_devices or len(jax.devices()))
+    rpd = _env_int("BENCH_RPD", 1)  # replicas per device (weak scaling)
+    n_pipeline = _env_int("BENCH_PIPELINE", 10 if on_accel else 2)
+    n_reps = _env_int("BENCH_REPS", 3 if on_accel else 2)
+
+    # smaller CPU default than the single-chip bench: the fleet runs the
+    # world D x rpd times per pipeline step
+    spec, state, net, bounds, knobs = _build_bench_world(
+        on_accel, cpu_users=512
+    )
+
+    def measure(n_dev: int, n_replicas: int):
+        mesh = make_mesh(n_dev)
+        batch = replicate_state(spec, state, n_replicas, seed=0)
+        keys0 = jax.random.split(jax.random.PRNGKey(0), n_pipeline)
+        t0 = time.perf_counter()
+        d, dm = fleet_decisions(spec, batch, net, bounds, keys0, mesh)
+        d, dm = int(np.asarray(d)), int(np.asarray(dm))
+        compile_s = time.perf_counter() - t0
+        walls, decs, defs = [], [], []
+        for rep in range(n_reps):
+            keys = jax.random.split(jax.random.PRNGKey(1 + rep), n_pipeline)
+            t0 = time.perf_counter()
+            d, dm = fleet_decisions(spec, batch, net, bounds, keys, mesh)
+            d, dm = int(np.asarray(d)), int(np.asarray(dm))
+            walls.append(time.perf_counter() - t0)
+            decs.append(d)
+            defs.append(dm)
+        # median by index; LOWER middle for even rep counts (the CPU
+        # default is 2 reps — upper-middle would systematically record
+        # the worse run)
+        mid = int(np.argsort(walls)[(len(walls) - 1) // 2])
+        return decs[mid], walls[mid], max(defs), compile_s
+
+    d1, w1, _, _ = measure(1, rpd)
+    dF, wF, dmF, cF = measure(D, D * rpd)
+    ds1 = d1 / w1
+    dsF = dF / wF
+    # forced virtual CPU devices share the host's cores, so the efficiency
+    # field tracks host parallelism (roughly cores/D, modulo how much of
+    # the host the 1-device baseline already used) rather than device
+    # count; a real mesh gives every device its own silicon.  Record the
+    # core count so captures stay interpretable.
+    host = {}
+    if not on_accel:
+        host = {"cpu_cores": os.cpu_count() or 1}
+    return {
+        "metric": "fleet_task_offload_decisions_per_sec",
+        "value": round(dsF, 1),
+        "unit": "decisions/s",
+        "backend": backend,
+        "n_devices": D,
+        "n_replicas": D * rpd,
+        "policy": knobs["policy"].name.lower(),
+        "n_users": knobs["n_users"],
+        "n_fogs": knobs["n_fogs"],
+        "horizon_s": knobs["horizon"],
+        "dt": knobs["dt"],
+        "n_pipeline": n_pipeline,
+        "decisions": dF,
+        "wall_s": round(wF, 4),
+        "per_device_decisions_per_sec": round(dsF / D, 1),
+        "singlechip_decisions_per_sec": round(ds1, 1),
+        "speedup_vs_singlechip": round(dsF / ds1, 3),
+        "weak_scaling_efficiency": round(dsF / (D * ds1), 4),
+        "n_deferred_max": dmF,
+        "compile_s": round(cF, 1),
+        **host,
+        "equivalence": "per-replica state-hash == vmap path; "
+        "tests/test_fleet.py",
+    }
+
+
+def fleet_main() -> None:
+    """``python bench.py --fleet`` (or ``BENCH_FLEET=1``): the multi-chip
+    headline.  Provisions BENCH_DEVICES virtual CPU devices when needed
+    (an unset platform is respected — a real multi-chip host measures
+    its own hardware), then prints the :func:`fleet_measurement` JSON
+    line."""
+    n = _env_int("BENCH_DEVICES", 8)
+    ensure_mesh_devices(n)
+    print(json.dumps(fleet_measurement(n)))
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--fleet" in sys.argv or os.environ.get("BENCH_FLEET"):
+        fleet_main()
+    else:
+        main()

@@ -62,6 +62,15 @@ def main(argv=None) -> int:
                     help="run in N-tick chunks, printing a progress line "
                     "per chunk (the Cmdenv status-line analog; excludes "
                     "--ticks)")
+    ap.add_argument("--replicas", type=int, default=None, metavar="R",
+                    help="Monte-Carlo fleet: advance R replica worlds "
+                    "(per-replica PRNG streams) sharded over the device "
+                    "mesh in one jitted scan (parallel/fleet.py); R must "
+                    "divide evenly over the mesh")
+    ap.add_argument("--mesh", type=int, default=None, metavar="D",
+                    help="devices in the replica mesh (default: all "
+                    "visible devices); implies --replicas D when "
+                    "--replicas is omitted")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (cpu/tpu)")
     ap.add_argument("--analyze", metavar="DIR", default=None,
@@ -134,6 +143,9 @@ def main(argv=None) -> int:
         if args.ticks or args.trails:
             ap.error("--sweep is incompatible with --ticks/--trails "
                      "(sweeps return counter grids, not series)")
+        if args.replicas is not None or args.mesh is not None:
+            ap.error("--sweep owns its own replica fan-out (reps=); "
+                     "--replicas/--mesh apply to single-scenario runs")
         if args.policy is not None:
             print(
                 "error: --policy conflicts with --sweep (the sweep owns "
@@ -285,6 +297,76 @@ def main(argv=None) -> int:
         # traceback
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.replicas is not None or args.mesh is not None:
+        # ---- replica-sharded fleet run (parallel/fleet.py) ------------
+        if args.progress:
+            ap.error("--replicas/--mesh and --progress are mutually "
+                     "exclusive (the fleet scan is one jitted call)")
+        if args.trails:
+            ap.error("--trails renders one world's movement; slice a "
+                     "replica out of a fleet run instead")
+        import jax
+
+        from .parallel import make_mesh, replicate_state
+        from .parallel.fleet import run_fleet, run_fleet_series
+        from .runtime.recorder import fleet_scalars, record_fleet_run
+
+        try:
+            mesh = make_mesh(args.mesh)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        n_devices = int(mesh.devices.size)
+        n_replicas = (
+            args.replicas if args.replicas is not None else n_devices
+        )
+        batch = replicate_state(
+            spec, state, n_replicas, seed=args.seed or 0
+        )
+        t0 = time.perf_counter()
+        try:
+            if args.ticks:
+                final, series = run_fleet_series(
+                    spec, batch, net, bounds, mesh
+                )
+            else:
+                final = run_fleet(spec, batch, net, bounds, mesh)
+                series = None
+        except ValueError as e:
+            # e.g. a replica count that does not divide over the mesh
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        jax.block_until_ready(final)
+        wall = time.perf_counter() - t0
+        fs = fleet_scalars(spec, final)
+        out = {
+            "scenario": cfg.lookup("scenario", "smoke"),
+            "wall_s": round(wall, 3),
+            "n_replicas": n_replicas,
+            "n_devices": n_devices,
+            "n_published_sum": int(fs["aggregate"]["n_published"]["sum"]),
+            "n_completed_sum": int(fs["aggregate"]["n_completed"]["sum"]),
+            "n_completed_minmax": [
+                int(fs["aggregate"]["n_completed"]["min"]),
+                int(fs["aggregate"]["n_completed"]["max"]),
+            ],
+        }
+        outdir = args.out or cfg.lookup("output.dir")
+        if outdir:
+            run_id = args.run_id or cfg.lookup("output.run_id", "Fleet-0")
+            out.update(record_fleet_run(
+                outdir, spec, final, series=series, run_id=run_id,
+                attrs={
+                    "argv": sys.argv[1:],
+                    "scenario": cfg.lookup("scenario", "smoke"),
+                    "n_devices": n_devices,
+                },
+                scalars=fs,  # already gathered for the summary above
+            ))
+        print(json.dumps(out))
+        return 0
+
     t0 = time.perf_counter()
     if args.progress:
         if args.ticks or args.trails:

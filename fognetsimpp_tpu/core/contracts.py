@@ -243,3 +243,30 @@ def check_step_contract(
         step = make_step(spec)
     got = jax.eval_shape(lambda s: step(s, net, bounds), state)
     assert_same_struct(state, got, what="tick carry (lax.scan endomorphism)")
+
+
+def check_fleet_contract(spec: WorldSpec, batch, net, bounds=None) -> None:
+    """The fleet carry contract (ISSUE 3): the *replica-batched* tick
+    step must also be a carry endomorphism — ``vmap(step)`` over the
+    leading replica axis preserves every leaf's shape and dtype, so the
+    sharded fleet scan (:mod:`fognetsimpp_tpu.parallel.fleet`) never
+    recompiles mid-run or silently promotes the batched carry.
+
+    ``batch`` is a replicated world from
+    :func:`fognetsimpp_tpu.parallel.replicas.replicate_state`.  A plain
+    eval_shape trace: no FLOPs, no device buffers, mesh-independent
+    (sharding never changes shapes/dtypes, so one unsharded trace
+    covers every mesh layout).
+    """
+    from ..net.mobility import default_bounds
+    from .engine import make_step
+
+    if bounds is None:
+        bounds = default_bounds()
+    step = make_step(spec)
+    got = jax.eval_shape(
+        lambda b: jax.vmap(lambda s: step(s, net, bounds))(b), batch
+    )
+    assert_same_struct(
+        batch, got, what="fleet carry (vmap(step) endomorphism)"
+    )

@@ -71,6 +71,19 @@ _ST_REJECTED = np.int8(int(Stage.REJECTED))
 _ST_LOST = np.int8(int(Stage.LOST))
 
 
+# One message for the assume_static x Bianchi-keyed-MAC conflict, shared
+# by every entry point that can hit it: WorldSpec.validate() (spec-level,
+# via spec.mac_keyed), run() (net-level belt-and-braces) and make_step()
+# (a direct caller skipping run()'s hoist used to fall silently into the
+# per-tick offered-rate path — ADVICE r5: the entries must agree).
+_STATIC_MAC_ERR = (
+    "assume_static cannot hoist a Bianchi-keyed association: "
+    "MAC contention is keyed on per-tick offered load (r5). "
+    "Disable assume_static for this world, or build the net "
+    "with mac_model='linear'."
+)
+
+
 class TickBuf(NamedTuple):
     """Per-tick message-count accumulators feeding the energy model.
 
@@ -2115,6 +2128,11 @@ def make_step(
         if spec.assume_static and static_cache is not None:
             cache = static_cache
         else:
+            if spec.assume_static and net.mac_loss_tab.shape[0] > 0:
+                # trace-time (shape is static): a direct make_step caller
+                # without a static cache must not silently diverge from
+                # run(), which rejects this combination outright
+                raise ValueError(_STATIC_MAC_ERR)
             pos, vel = step_mobility(state.nodes, bounds, t1, spec.dt)
             nodes = state.nodes.replace(pos=pos, vel=vel)
             state = state.replace(nodes=nodes)
@@ -2384,12 +2402,7 @@ def run(
     static_cache = None
     if spec.assume_static:
         if net.mac_loss_tab.shape[0] > 0:
-            raise ValueError(
-                "assume_static cannot hoist a Bianchi-keyed association: "
-                "MAC contention is keyed on per-tick offered load (r5). "
-                "Disable assume_static for this world, or build the net "
-                "with mac_model='linear'."
-            )
+            raise ValueError(_STATIC_MAC_ERR)
         # one association for the whole run (spec promise: constant
         # positions + liveness); the scan then runs zero mobility kernels
         static_cache = associate(
@@ -2442,14 +2455,26 @@ def _dealias_for_donation(state: WorldState) -> WorldState:
     itself), and XLA's Execute() rejects donating the same buffer twice.
     Copy the second and later references; unaliased states pass through
     untouched, so this never changes results.
+
+    Sharding-aware (ISSUE 3): a mesh-sharded leaf has no single
+    ``unsafe_buffer_pointer`` — its identity is the tuple of per-shard
+    buffer pointers, so two fleet-batch leaves serving the same device
+    buffers are still caught before the donating fleet entries
+    (:mod:`fognetsimpp_tpu.parallel.fleet`) hand them to Execute().
     """
     seen = set()
 
     def one(x):
         try:
             key = x.unsafe_buffer_pointer()
-        except Exception:  # sharded / numpy / non-addressable leaves
-            key = id(x)
+        except Exception:
+            try:  # sharded leaves: identity = the per-shard buffers
+                key = tuple(
+                    s.data.unsafe_buffer_pointer()
+                    for s in x.addressable_shards
+                )
+            except Exception:  # numpy / non-addressable leaves
+                key = id(x)
         if key in seen:
             return jnp.copy(x)
         seen.add(key)

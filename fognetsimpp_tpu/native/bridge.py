@@ -279,6 +279,18 @@ def delay_table(spec, state0, net, bounds=None, n_ticks=None) -> np.ndarray:
             "activity-keyed MAC + send_interval_jitter has no "
             "independent delay table (the jitter stream is engine-PRNG)"
         )
+    if keyed and spec.energy_enabled:
+        # ADVICE r5: the table's send chain assumes an always-alive user
+        # set — with batteries the engine's offered-rate rows depend on
+        # its own lifecycle trajectory, which this scan never steps, so
+        # the rows would silently diverge.  Mirror the
+        # replay_engine_world guard instead of producing wrong data.
+        raise NotImplementedError(
+            "activity-keyed MAC + energy lifecycle has no independent "
+            "delay table (offered load depends on the engine's own "
+            "alive trajectory): build the world with mac_model='linear' "
+            "and w_contention=0, as replay_engine_world requires"
+        )
     rest = spec.n_nodes - U
 
     def body(carry, tick):

@@ -7,6 +7,11 @@ The TPU-native scale-out axes this package provides instead:
   * **DP** — :func:`replicas.run_replicated`: ``vmap`` over Monte-Carlo
     world replicas, optionally sharded over a device mesh
     (:mod:`mesh`) so each chip advances its own slice of replicas.
+    :mod:`fleet` is the production composition (ISSUE 3): the sharded
+    batch under one jitted carry-donated scan, device-resident metric
+    reduction, chunked sharded series offload — the measured
+    multi-chip headline path (``bench.py --fleet`` /
+    ``MULTICHIP_r*.json``).
   * **TP** — :mod:`tp`: node-axis sharding of the scheduler's score
     matrix via ``shard_map`` with cross-shard argmin combines, for worlds
     whose fog population exceeds one chip's comfortable tile.
@@ -22,6 +27,12 @@ transports.
 """
 from .replicas import replicate_state, run_replicated, replica_counters  # noqa: F401
 from .mesh import make_mesh, replica_sharding, shard_replicas, run_sharded  # noqa: F401
+from .fleet import (  # noqa: F401
+    fleet_decisions,
+    fold_replica_keys,
+    run_fleet,
+    run_fleet_series,
+)
 from .multihost import global_mesh, initialize  # noqa: F401
 from .sweep import sweep_explore, sweep_policies  # noqa: F401
 from .taskshard import run_node_sharded, shard_state_by_node  # noqa: F401

@@ -241,6 +241,88 @@ def record_run(
     return paths
 
 
+def fleet_scalars(spec: WorldSpec, final_batch: WorldState) -> Dict:
+    """Aggregate a fleet run's metric counters across the replica axis.
+
+    ``final_batch`` is the replica-batched final state from
+    :func:`fognetsimpp_tpu.parallel.fleet.run_fleet` (its leaves may
+    still be mesh-sharded — ``np.asarray`` inside
+    :func:`~fognetsimpp_tpu.parallel.replicas.replica_counters` is the
+    single host gather).  Returns ``{"n_replicas", "per_replica",
+    "aggregate"}`` where ``aggregate`` carries sum/mean/min/max per
+    counter — the Monte-Carlo summary the reference would need N
+    process launches plus a results-merging script to produce.
+    """
+    from ..parallel.replicas import replica_counters
+
+    counters = replica_counters(final_batch)
+    n_replicas = int(next(iter(counters.values())).shape[0])
+    per_replica = {k: np.asarray(v).tolist() for k, v in counters.items()}
+    aggregate = {
+        k: {
+            "sum": float(np.sum(v)),
+            "mean": float(np.mean(v)),
+            "min": float(np.min(v)),
+            "max": float(np.max(v)),
+        }
+        for k, v in counters.items()
+    }
+    return {
+        "n_replicas": n_replicas,
+        "per_replica": per_replica,
+        "aggregate": aggregate,
+    }
+
+
+def record_fleet_run(
+    outdir: str,
+    spec: WorldSpec,
+    final_batch: WorldState,
+    series: Optional[Dict] = None,
+    run_id: str = "Fleet-0",
+    attrs: Optional[Dict] = None,
+    scalars: Optional[Dict] = None,
+) -> Dict[str, str]:
+    """Persist one finished fleet run: ``<run_id>.fleet.sca.json`` (spec +
+    replica-aggregated scalars) and, when per-tick ``series`` were
+    recorded (:func:`fognetsimpp_tpu.parallel.fleet.run_fleet_series`:
+    host arrays of shape ``(R, n_ticks, ...)``), a ``.fleet.vec.npz``
+    with one ``tick.<name>`` entry per series vector.
+
+    A fleet is R worlds, so the per-task signal extraction of
+    :func:`record_run` (single-world ``.sca``/``.vec`` twins) does not
+    apply; slice one replica out of the batch and use :func:`record_run`
+    for a full single-world record.
+
+    ``scalars``: a precomputed :func:`fleet_scalars` dict — pass it when
+    the caller already gathered the counters (the CLI does, for its JSON
+    summary) so the host gather is not repeated.
+    """
+    os.makedirs(outdir, exist_ok=True)
+    sca_path = os.path.join(outdir, f"{run_id}.fleet.sca.json")
+    sca = {
+        "run": run_id,
+        "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "attrs": attrs or {},
+        "spec": spec_to_dict(spec),
+        "fleet": (
+            scalars if scalars is not None
+            else fleet_scalars(spec, final_batch)
+        ),
+    }
+    with open(sca_path, "w") as f:
+        json.dump(_json_sanitize(sca), f, indent=1, default=str,
+                  allow_nan=False)
+    paths = {"sca": sca_path}
+    if series is not None:
+        vec_path = os.path.join(outdir, f"{run_id}.fleet.vec.npz")
+        np.savez_compressed(
+            vec_path, **{f"tick.{k}": np.asarray(v) for k, v in series.items()}
+        )
+        paths["vec"] = vec_path
+    return paths
+
+
 def load_scalars(path: str) -> Dict:
     with open(path) as f:
         return json.load(f)

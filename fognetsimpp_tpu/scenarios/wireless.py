@@ -25,6 +25,7 @@ Ladder (reference config → builder):
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -106,6 +107,12 @@ def assemble(
     U, F, A = spec.n_users, spec.n_fogs, spec.n_aps
     assert A == len(ap_names) == len(ap_pos)
     assert F == len(fog_mips) == len(fog_attach)
+    # declare the activity-keyed MAC on the spec so illegal combinations
+    # (assume_static, see WorldSpec.validate) fail at construction, not
+    # mid-run; mirrors make_net_params' own table-attachment condition
+    keyed = A > 0 and mac_model == "bianchi"
+    if keyed != spec.mac_keyed:
+        spec = dataclasses.replace(spec, mac_keyed=keyed).validate()
     N = spec.n_nodes
     cost = access_cost(spec.task_bytes)
 

@@ -416,6 +416,17 @@ class WorldSpec:
     # the combination with the energy model, and run() re-derives the
     # cache whenever the promise cannot be checked.
     assume_static: bool = False
+    # Builder declaration that the world's MAC contention is keyed on
+    # per-tick offered load (the Bianchi DCF tables of
+    # net/topology.py::make_net_params with mac_model="bianchi" and APs
+    # present).  Such an association can never be hoisted out of the
+    # scan, so validate() rejects assume_static + mac_keyed at SPEC
+    # CONSTRUCTION (ADVICE r5: previously only run() raised, at run
+    # time, and make_step() silently disagreed).  The engine still
+    # belt-and-braces checks the net's actual mac table at both
+    # entries (core/engine.py::_STATIC_MAC_ERR) in case a hand-built
+    # spec under-declares.
+    mac_keyed: bool = False
 
     # --- misc ----------------------------------------------------------
     bug_compat: BugCompat = BugCompat()
@@ -535,6 +546,13 @@ class WorldSpec:
                 "assume_static promises constant (pos, alive); the energy "
                 "model's lifecycle shutdown/restart mutates alive"
             )
+            if self.mac_keyed:
+                raise ValueError(
+                    "assume_static cannot hoist a Bianchi-keyed "
+                    "association: MAC contention is keyed on per-tick "
+                    "offered load (r5).  Disable assume_static for this "
+                    "world, or build the net with mac_model='linear'."
+                )
         assert self.max_sends_per_tick >= 1
         if self.arrival_cands_per_user is not None:
             assert self.arrival_cands_per_user >= 1

@@ -56,6 +56,10 @@ _QUICK_FILES = {
     # small worlds — the in-loop-learning capability must stay inside the
     # edit loop, not drift behind the slow tier
     "test_learn.py",
+    # fleet runner (ISSUE 3): the 8-virtual-device replica-sharded fleet
+    # vs vmap equivalence gate — the multi-chip headline's correctness
+    # contract belongs in tier-1, exactly like the donation gates above
+    "test_fleet.py",
 }
 
 

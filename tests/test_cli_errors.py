@@ -1,6 +1,8 @@
 """CLI error-path regressions: an .ini referencing an unknown scenario/
 network name — or a ``--policy``/``--sweep`` naming an unknown policy —
 must produce a one-line actionable error, not a traceback."""
+import pytest
+
 from fognetsimpp_tpu.__main__ import main
 
 
@@ -68,6 +70,34 @@ def test_policy_flag_conflicts_with_sweep(capsys):
     captured = capsys.readouterr()
     assert rc == 2
     assert "--policy" in captured.err and "--sweep" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_replicas_conflicts_with_sweep(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--replicas", "8",
+              "--sweep", "policies=min_busy loads=0.05"])
+    assert e.value.code == 2
+    assert "--replicas" in capsys.readouterr().err
+
+
+def test_fleet_replicas_not_dividing_mesh_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--set", "scenario.horizon=0.1",
+               "--replicas", "3"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "divide" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_fleet_mesh_larger_than_devices_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--set", "scenario.horizon=0.1",
+               "--mesh", "4096"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "4096" in captured.err
     assert "Traceback" not in captured.err
 
 

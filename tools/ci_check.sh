@@ -9,6 +9,11 @@
 # baseline lives at tools/simlint/baseline.json; grandfather a finding
 # with `python -m tools.simlint --update-baseline fognetsimpp_tpu` and
 # commit the (reviewable) diff.
+#
+# The quick tier includes the fleet equivalence gate (tests/test_fleet.py):
+# conftest.py forces an 8-virtual-device CPU mesh, so the replica-sharded
+# fleet runner's per-replica state-hash A/B vs the vmap path runs here
+# and in tier-1 without TPU hardware.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
