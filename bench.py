@@ -38,6 +38,14 @@ the overhead of the in-loop bandit updates (decision bookkeeping +
 delayed-reward credit phase) against the min_busy default; learned
 policies disable the derive_acks fast path (they credit at ack time
 inside the tick).
+
+Telemetry (ISSUE 4): ``BENCH_TELEMETRY=1`` runs the same world with the
+device-resident TelemetryState riding the carry (spec.telemetry) — the
+value/off-value ratio is the telemetry-on overhead BENCHMARKS.md
+quotes.  ``python bench.py --profile`` (or ``BENCH_PROFILE=<dir>``)
+wraps the timed section in ``jax.profiler.trace`` (engine phases appear
+as named scopes) and appends a per-call dispatch-latency histogram plus
+the cold-compile time to the JSON line.
 """
 from __future__ import annotations
 
@@ -66,8 +74,10 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
     dt = _env_float("BENCH_DT", 5e-3)
     policy = policy_from_name(os.environ.get("BENCH_POLICY", "min_busy"))
 
+    telemetry = os.environ.get("BENCH_TELEMETRY", "") not in ("", "0")
     mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
     build_kw = dict(
+        telemetry=telemetry,
         n_users=n_users,
         n_fogs=n_fogs,
         fog_mips=tuple(float(m) for m in (1000, 2000, 3000, 4000)),
@@ -103,7 +113,7 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
     spec, state, net, bounds = smoke.build(arrival_window=window, **build_kw)
     knobs = dict(
         n_users=n_users, n_fogs=n_fogs, horizon=horizon,
-        interval=interval, dt=dt, policy=policy,
+        interval=interval, dt=dt, policy=policy, telemetry=telemetry,
     )
     return spec, state, net, bounds, knobs
 
@@ -166,6 +176,20 @@ def main() -> None:
         d, dm = x
         return int(np.asarray(d)), int(np.asarray(dm))
 
+    # tiny jitted round trip for the --profile dispatch-latency probe
+    _dispatch_probe = jax.jit(lambda x: x + 1)
+
+    import sys
+
+    from fognetsimpp_tpu.telemetry.profile import (
+        measure_dispatch,
+        profile_trace,
+    )
+
+    prof_dir = os.environ.get("BENCH_PROFILE") or (
+        "/tmp/fns_profile" if "--profile" in sys.argv else None
+    )
+
     # compile + warm
     keys0 = jax.random.split(jax.random.PRNGKey(0), n_pipeline)
     t_c0 = time.perf_counter()
@@ -173,13 +197,16 @@ def main() -> None:
     compile_s = time.perf_counter() - t_c0
 
     walls, decs, defs = [], [], []
-    for rep in range(n_reps):
-        keys = jax.random.split(jax.random.PRNGKey(1 + rep), n_pipeline)
-        t0 = time.perf_counter()
-        d, dm = fetch(go(keys))
-        walls.append(time.perf_counter() - t0)
-        decs.append(d)
-        defs.append(dm)
+    with profile_trace(prof_dir) as prof:
+        for rep in range(n_reps):
+            keys = jax.random.split(
+                jax.random.PRNGKey(1 + rep), n_pipeline
+            )
+            t0 = time.perf_counter()
+            d, dm = fetch(go(keys))
+            walls.append(time.perf_counter() - t0)
+            decs.append(d)
+            defs.append(dm)
     # median by index (an even rep count would make np.median interpolate
     # a value not present in walls)
     mid = int(np.argsort(walls)[len(walls) // 2])
@@ -214,7 +241,29 @@ def main() -> None:
                 # every window was fully current (Metrics.n_deferred_max)
                 "n_deferred_max": max(defs),
                 "compile_s": round(compile_s, 1),
+                "telemetry": knobs["telemetry"],
                 "fidelity": "count-exact vs dt=1e-3; tests/test_coarse_dt.py",
+                # --profile extras: where the XLA trace landed plus the
+                # flat per-call dispatch+fetch cost the pipeline
+                # methodology amortises, measured as a histogram over a
+                # trivial jitted round trip
+                **(
+                    {
+                        "profile_dir": prof["dir"] if prof["active"] else None,
+                        **(
+                            {"profile_error": prof["error"]}
+                            if prof["error"] else {}
+                        ),
+                        "dispatch_latency": measure_dispatch(
+                            lambda: int(
+                                np.asarray(_dispatch_probe(jnp.int32(0)))
+                            ),
+                            n=10,
+                        ),
+                    }
+                    if prof_dir
+                    else {}
+                ),
             }
         )
     )

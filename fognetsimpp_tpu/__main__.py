@@ -55,6 +55,26 @@ def main(argv=None) -> int:
                     help="defaults to config output.run_id, else General-0")
     ap.add_argument("--ticks", action="store_true",
                     help="record per-tick series vectors")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="carry device-resident telemetry (per-fog busy "
+                    "fractions, queue depths, per-phase work counters, a "
+                    "bounded per-tick reservoir) through the scan; "
+                    "shorthand for spec.telemetry=true — adds the "
+                    "per-fog gauges to .sca.json and the OpenMetrics "
+                    "output")
+    ap.add_argument("--trace-out", metavar="JSON", default=None,
+                    help="export the run's task-lifecycle spans as "
+                    "Chrome/Perfetto trace-event JSON to this path "
+                    "(replica→pid, fog→tid; open in ui.perfetto.dev)")
+    ap.add_argument("--trace-max-tasks", type=int, metavar="N",
+                    default=100_000,
+                    help="cap on tasks per replica in the --trace-out "
+                    "export (default 100000: Perfetto chokes on "
+                    "multi-hundred-MB traces; 0 = unbounded)")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the run into "
+                    "DIR (phases show up as named scopes; open with "
+                    "TensorBoard or Perfetto)")
     ap.add_argument("--trails", metavar="SVG", default=None,
                     help="render movement/communication trails to this "
                     "SVG (the Tkenv-animation analog; implies --ticks)")
@@ -132,6 +152,8 @@ def main(argv=None) -> int:
         pre.append("spec.record_tick_series = true")
     if args.trails:
         pre.append("spec.record_trails = true")
+    if args.telemetry:
+        pre.append("spec.telemetry = true")
     cfg = Config.from_str("\n".join(pre) + "\n" + text)
 
     if args.sweep:
@@ -143,6 +165,10 @@ def main(argv=None) -> int:
         if args.ticks or args.trails:
             ap.error("--sweep is incompatible with --ticks/--trails "
                      "(sweeps return counter grids, not series)")
+        if args.telemetry or args.trace_out or args.profile:
+            ap.error("--sweep returns counter grids, not a final "
+                     "world; --telemetry/--trace-out/--profile apply "
+                     "to single-scenario runs")
         if args.replicas is not None or args.mesh is not None:
             ap.error("--sweep owns its own replica fan-out (reps=); "
                      "--replicas/--mesh apply to single-scenario runs")
@@ -324,20 +350,23 @@ def main(argv=None) -> int:
         batch = replicate_state(
             spec, state, n_replicas, seed=args.seed or 0
         )
+        from .telemetry.profile import profile_trace
+
         t0 = time.perf_counter()
         try:
-            if args.ticks:
-                final, series = run_fleet_series(
-                    spec, batch, net, bounds, mesh
-                )
-            else:
-                final = run_fleet(spec, batch, net, bounds, mesh)
-                series = None
+            with profile_trace(args.profile) as prof:
+                if args.ticks:
+                    final, series = run_fleet_series(
+                        spec, batch, net, bounds, mesh
+                    )
+                else:
+                    final = run_fleet(spec, batch, net, bounds, mesh)
+                    series = None
+                jax.block_until_ready(final)
         except ValueError as e:
             # e.g. a replica count that does not divide over the mesh
             print(f"error: {e}", file=sys.stderr)
             return 2
-        jax.block_until_ready(final)
         wall = time.perf_counter() - t0
         fs = fleet_scalars(spec, final)
         out = {
@@ -358,41 +387,55 @@ def main(argv=None) -> int:
             out.update(record_fleet_run(
                 outdir, spec, final, series=series, run_id=run_id,
                 attrs={
-                    "argv": sys.argv[1:],
+                    "argv": sys.argv[1:] if argv is None else list(argv),
                     "scenario": cfg.lookup("scenario", "smoke"),
                     "n_devices": n_devices,
                 },
                 scalars=fs,  # already gathered for the summary above
             ))
+        if args.trace_out:
+            from .telemetry.timeline import export_trace
+
+            out["trace"] = export_trace(
+                spec, final, args.trace_out,
+                max_tasks=args.trace_max_tasks or None,
+            )
+        if args.profile:
+            out["profile_dir"] = prof["dir"] if prof["active"] else None
+            if prof["error"]:
+                out["profile_error"] = prof["error"]
         print(json.dumps(out))
         return 0
 
+    from .telemetry.profile import profile_trace
+
     t0 = time.perf_counter()
-    if args.progress:
-        if args.ticks or args.trails:
-            ap.error("--progress and --ticks/--trails are mutually "
-                     "exclusive (chunked runs record via snapshots, not "
-                     "series)")
-        from .core.engine import run_chunked
-        from .runtime.signals import summarize as _sumz
+    with profile_trace(args.profile) as prof:
+        if args.progress:
+            if args.ticks or args.trails:
+                ap.error("--progress and --ticks/--trails are mutually "
+                         "exclusive (chunked runs record via snapshots, "
+                         "not series)")
+            from .core.engine import run_chunked
+            from .runtime.signals import summarize as _sumz
 
-        def _cb(s, tick):
-            m = _sumz(s)
-            print(json.dumps({
-                "tick": tick, "t": round(tick * spec.dt, 6),
-                "n_published": m["n_published"],
-                "n_completed": m["n_completed"],
-                "wall_s": round(time.perf_counter() - t0, 2),
-            }), flush=True)
+            def _cb(s, tick):
+                m = _sumz(s)
+                print(json.dumps({
+                    "tick": tick, "t": round(tick * spec.dt, 6),
+                    "n_published": m["n_published"],
+                    "n_completed": m["n_completed"],
+                    "wall_s": round(time.perf_counter() - t0, 2),
+                }), flush=True)
 
-        final = run_chunked(spec, state, net, bounds,
-                            chunk_ticks=args.progress, callback=_cb)
-        series = None
-    else:
-        final, series = run(spec, state, net, bounds)
-    import jax
+            final = run_chunked(spec, state, net, bounds,
+                                chunk_ticks=args.progress, callback=_cb)
+            series = None
+        else:
+            final, series = run(spec, state, net, bounds)
+        import jax
 
-    jax.block_until_ready(final)
+        jax.block_until_ready(final)
     wall = time.perf_counter() - t0
 
     out = {"scenario": cfg.lookup("scenario", "smoke"), "wall_s": round(wall, 3)}
@@ -402,7 +445,7 @@ def main(argv=None) -> int:
         paths = record_run(
             outdir, spec, final, series=series, run_id=run_id,
             attrs={
-                "argv": sys.argv[1:],
+                "argv": sys.argv[1:] if argv is None else list(argv),
                 "scenario": cfg.lookup("scenario", "smoke"),
             },
         )
@@ -413,6 +456,17 @@ def main(argv=None) -> int:
         out["trails"] = render_trails_svg(
             spec, final, series, args.trails, net=net
         )
+    if args.trace_out:
+        from .telemetry.timeline import export_trace
+
+        out["trace"] = export_trace(
+            spec, final, args.trace_out,
+            max_tasks=args.trace_max_tasks or None,
+        )
+    if args.profile:
+        out["profile_dir"] = prof["dir"] if prof["active"] else None
+        if prof["error"]:
+            out["profile_error"] = prof["error"]
     s = summarize(final)
     out.update(
         n_published=s["n_published"], n_completed=s["n_completed"],

@@ -176,6 +176,13 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             when=lambda sp: sp.learn_active,
         ),
         PhaseContract(
+            "_phase_telemetry",
+            lambda sp, s, n, c, b, t0, t1: E._phase_telemetry(
+                sp, s, n, c, b, t1
+            ),
+            when=lambda sp: sp.telemetry,
+        ),
+        PhaseContract(
             "_phase_local_completions",
             lambda sp, s, n, c, b, t0, t1: E._phase_local_completions(
                 sp, s, n, c, b, t1
@@ -243,6 +250,52 @@ def check_step_contract(
         step = make_step(spec)
     got = jax.eval_shape(lambda s: step(s, net, bounds), state)
     assert_same_struct(state, got, what="tick carry (lax.scan endomorphism)")
+
+
+def check_telemetry_contract(spec: WorldSpec, state) -> None:
+    """The TelemetryState carry contract (ISSUE 4).
+
+    Two halves: (a) the sizing gate — with ``spec.telemetry`` off every
+    telemetry array leaf must have zero rows (the inert-LearnState
+    discipline: untelemetered worlds pay no memory and stay bit-exact),
+    with it on the leaves carry the real per-fog / per-phase /
+    reservoir dimensions; (b) the accumulation endomorphism — one
+    eval_shape trace of the engine's ``_phase_telemetry`` must preserve
+    the whole WorldState structure, or the scan carry would mismatch /
+    silently recompile mid-run.
+    """
+    from ..telemetry.metrics import PHASES, RES_FIELDS
+
+    t = state.telem
+    F = spec.n_fogs if spec.telemetry else 0
+    P = len(PHASES) if spec.telemetry else 0
+    R = spec.telemetry_slots
+    expect = {
+        "q_len_sum": (F,), "q_len_max": (F,), "q_len_min": (F,),
+        "busy_ticks": (F,), "pool_occ_sum": (F,), "pick_hist": (F,),
+        "phase_work": (P,), "res": (R, len(RES_FIELDS)),
+        "ticks": (), "defer_sum": (),
+    }
+    for name, shape in expect.items():
+        got = tuple(getattr(t, name).shape)
+        if got != shape:
+            raise ContractError(
+                f"TelemetryState.{name}: expected shape {shape} under "
+                f"telemetry={spec.telemetry}, got {got}"
+            )
+    if spec.telemetry:
+        from . import engine as E
+
+        def trace(s):
+            buf = _zero_buf(spec)
+            return E._phase_telemetry(
+                spec, s, None, None, buf, jnp.float32(spec.dt)
+            )
+
+        out = jax.eval_shape(trace, state)
+        assert_same_struct(
+            state, out[0], what="_phase_telemetry: WorldState"
+        )
 
 
 def check_fleet_contract(spec: WorldSpec, batch, net, bounds=None) -> None:

@@ -50,8 +50,9 @@ from ..net.energy import step_energy
 from ..net.topology import LinkCache, NetParams, associate
 from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
 from ..ops.sched import scalar_winner, schedule_batch, task_uniform
-from ..spec import FogModel, Policy, Stage, WorldSpec
+from ..spec import STATIC_MAC_ERR, FogModel, Policy, Stage, WorldSpec
 from ..state import WorldState
+from ..telemetry.metrics import PHASE_INDEX, accumulate_tick, tick_activity
 
 # Stage tags as hoisted int8 scalar constants (simlint R7): the hot phases
 # previously rebuilt `jnp.int8(int(Stage.X))` per use (~15x per trace in
@@ -71,17 +72,10 @@ _ST_REJECTED = np.int8(int(Stage.REJECTED))
 _ST_LOST = np.int8(int(Stage.LOST))
 
 
-# One message for the assume_static x Bianchi-keyed-MAC conflict, shared
-# by every entry point that can hit it: WorldSpec.validate() (spec-level,
-# via spec.mac_keyed), run() (net-level belt-and-braces) and make_step()
-# (a direct caller skipping run()'s hoist used to fall silently into the
-# per-tick offered-rate path — ADVICE r5: the entries must agree).
-_STATIC_MAC_ERR = (
-    "assume_static cannot hoist a Bianchi-keyed association: "
-    "MAC contention is keyed on per-tick offered load (r5). "
-    "Disable assume_static for this world, or build the net "
-    "with mac_model='linear'."
-)
+# The assume_static x Bianchi-keyed-MAC conflict message: defined ONCE
+# in spec.py (WorldSpec.validate() raises it too) so the entry points
+# can never drift apart (ADVICE r5: the entries must agree).
+_STATIC_MAC_ERR = STATIC_MAC_ERR
 
 
 class TickBuf(NamedTuple):
@@ -2003,6 +1997,27 @@ def _phase_learn_credit(
     return state.replace(learn=learn), buf
 
 
+def _phase_telemetry(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+    phase_work: Optional[dict] = None,
+) -> Tuple[WorldState, TickBuf]:
+    """Plane-1 telemetry accumulation (telemetry/metrics.py).
+
+    Folds this tick's end-of-tick fog/learn/metrics snapshot — plus the
+    per-phase work deltas the step bracketed around each phase call —
+    into the carry-resident :class:`TelemetryState`.  Statically gated:
+    worlds with ``spec.telemetry`` off trace none of this and stay
+    bit-exact (tests/test_telemetry.py).  Pure carry endomorphism, so
+    it rides the scan and the fleet's replica ``vmap`` unchanged.
+    """
+    telem = accumulate_tick(
+        spec, state.telem, state.fogs, state.learn, state.metrics,
+        state.tick, t1, phase_work,
+    )
+    return state.replace(telem=telem), buf
+
+
 def _phase_periodic_adverts(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     t0: jax.Array, t1: jax.Array,
@@ -2123,6 +2138,34 @@ def make_step(
             )
         )
 
+        # phase harness: every phase call runs under a jax.named_scope
+        # (XLA profiles attribute cost per phase — telemetry plane 3)
+        # and, when spec.telemetry, is bracketed by the metrics-activity
+        # scalar so its work delta lands in TelemetryState.phase_work.
+        # The thunk reads the CURRENT state/buf bindings at call time;
+        # _ph rebinds them from the phase's return.
+        telem_on = spec.telemetry
+        ph_work: dict = {}
+
+        def _ph(name, thunk):
+            nonlocal state, buf
+            m0 = tick_activity(state.metrics, buf) if telem_on else None
+            with jax.named_scope("phase_" + name):
+                out = thunk()
+            extra = None
+            if isinstance(out, tuple):
+                if len(out) == 3:
+                    state, buf, extra = out
+                else:
+                    state, buf = out
+            else:
+                state = out
+            if telem_on:
+                i = PHASE_INDEX[name]
+                d = tick_activity(state.metrics, buf) - m0
+                ph_work[i] = ph_work[i] + d if i in ph_work else d
+            return extra
+
         # 1. mobility (positions at end-of-tick; delays in this tick use them)
         # 2. connectivity / association snapshot for this tick
         if spec.assume_static and static_cache is not None:
@@ -2133,23 +2176,24 @@ def make_step(
                 # without a static cache must not silently diverge from
                 # run(), which rejects this combination outright
                 raise ValueError(_STATIC_MAC_ERR)
-            pos, vel = step_mobility(state.nodes, bounds, t1, spec.dt)
-            nodes = state.nodes.replace(pos=pos, vel=vel)
-            state = state.replace(nodes=nodes)
-            # Bianchi worlds key MAC contention on each cell's OFFERED
-            # LOAD (DCF contends among stations with queued frames, not
-            # associated-but-idle ones — VERDICT r4 item 2), solved to
-            # an effective contender count inside associate()
-            offered = None
-            if net.mac_loss_tab.shape[0] > 0:
-                offered = offered_rate_vector(
-                    spec, state.nodes.alive[: spec.n_users],
-                    state.users, t0,
+            with jax.named_scope("phase_mobility_association"):
+                pos, vel = step_mobility(state.nodes, bounds, t1, spec.dt)
+                nodes = state.nodes.replace(pos=pos, vel=vel)
+                state = state.replace(nodes=nodes)
+                # Bianchi worlds key MAC contention on each cell's OFFERED
+                # LOAD (DCF contends among stations with queued frames, not
+                # associated-but-idle ones — VERDICT r4 item 2), solved to
+                # an effective contender count inside associate()
+                offered = None
+                if net.mac_loss_tab.shape[0] > 0:
+                    offered = offered_rate_vector(
+                        spec, state.nodes.alive[: spec.n_users],
+                        state.users, t0,
+                    )
+                cache = associate(
+                    net, state.nodes.pos, state.nodes.alive,
+                    broker=spec.broker_index, offered_rate=offered,
                 )
-            cache = associate(
-                net, state.nodes.pos, state.nodes.alive,
-                broker=spec.broker_index, offered_rate=offered,
-            )
         if spec.wired_queue_enabled:
             # DropTailQueue backpressure (wireless5.ini:72-73): last
             # tick's egress backlog serializes ahead of new messages.
@@ -2166,36 +2210,36 @@ def make_step(
 
         # 3-7. protocol phases
         if spec.connect_gating:
-            state, buf = _phase_connect(spec, state, net, cache, buf, t0, t1)
-        state = _phase_adverts(state, t1)
+            _ph("connect", lambda: _phase_connect(
+                spec, state, net, cache, buf, t0, t1))
+        _ph("adverts", lambda: _phase_adverts(state, t1))
         if spec.adv_periodic and spec.fog_model != int(FogModel.POOL):
-            state = _phase_periodic_adverts(spec, state, net, cache, t0, t1)
+            _ph("adverts", lambda: _phase_periodic_adverts(
+                spec, state, net, cache, t0, t1))
         if spec.max_sends_per_tick > 1:
-            state, buf = _phase_spawn_multi(
-                spec, state, net, cache, buf, t0, t1
-            )
+            _ph("spawn", lambda: _phase_spawn_multi(
+                spec, state, net, cache, buf, t0, t1))
         else:
-            state, buf = _phase_spawn(spec, state, net, cache, buf, t0, t1)
+            _ph("spawn", lambda: _phase_spawn(
+                spec, state, net, cache, buf, t0, t1))
         v2_local = (
             spec.policy == int(Policy.LOCAL_FIRST) and spec.v2_local_broker
         )
         if v2_local:  # shared-timer fires that precede every arrival
-            state, buf = _phase_v2_release(
-                spec, state, net, cache, buf, t1, before_broker=True
-            )
+            _ph("v2_release_pre", lambda: _phase_v2_release(
+                spec, state, net, cache, buf, t1, before_broker=True))
         v2_resched = None
         if _broker_dense_ok(spec):
-            state, buf = _phase_broker_dense(spec, state, net, cache, buf, t1)
+            _ph("broker", lambda: _phase_broker_dense(
+                spec, state, net, cache, buf, t1))
         else:
-            state, buf, v2_resched = _phase_broker(
-                spec, state, net, cache, buf, t1
-            )
+            v2_resched = _ph("broker", lambda: _phase_broker(
+                spec, state, net, cache, buf, t1))
         if v2_local:  # fires this tick's decisions did not cancel
             rs, pre = (None, None) if v2_resched is None else v2_resched
-            state, buf = _phase_v2_release(
+            _ph("v2_release_post", lambda: _phase_v2_release(
                 spec, state, net, cache, buf, t1, before_broker=False,
-                resched_t=rs, prerefunded=pre,
-            )
+                resched_t=rs, prerefunded=pre))
         if spec.n_fogs > 0:  # a fog-less world exercises only the
             # "no compute resource available" branch (BrokerBaseApp3.cc:306)
             if spec.fog_model == int(FogModel.POOL):
@@ -2212,29 +2256,30 @@ def make_step(
                         jnp.floor(t0 / spec.adv_interval) + 1.0
                     ) * spec.adv_interval
                     t_a = jnp.minimum(t_fire, t1)
-                    state, buf = _phase_pool_completions(
-                        spec, state, net, cache, buf, t_a
-                    )
-                    state, buf = _phase_pool_arrivals(
-                        spec, state, net, cache, buf, t_a
-                    )
-                    state = _phase_periodic_adverts(
-                        spec, state, net, cache, t0, t1
-                    )
-                state, buf = _phase_pool_completions(
-                    spec, state, net, cache, buf, t1
-                )
-                state, buf = _phase_pool_arrivals(spec, state, net, cache, buf, t1)
+                    _ph("pool_completions", lambda: _phase_pool_completions(
+                        spec, state, net, cache, buf, t_a))
+                    _ph("pool_arrivals", lambda: _phase_pool_arrivals(
+                        spec, state, net, cache, buf, t_a))
+                    _ph("adverts", lambda: _phase_periodic_adverts(
+                        spec, state, net, cache, t0, t1))
+                _ph("pool_completions", lambda: _phase_pool_completions(
+                    spec, state, net, cache, buf, t1))
+                _ph("pool_arrivals", lambda: _phase_pool_arrivals(
+                    spec, state, net, cache, buf, t1))
             else:
                 for _ in range(spec.completions_per_tick):
-                    state, buf = _phase_completions(spec, state, net, cache, buf, t1)
-                state, buf = _phase_fog_arrivals(spec, state, net, cache, buf, t1)
+                    _ph("completions", lambda: _phase_completions(
+                        spec, state, net, cache, buf, t1))
+                _ph("fog_arrivals", lambda: _phase_fog_arrivals(
+                    spec, state, net, cache, buf, t1))
         if spec.policy == int(Policy.LOCAL_FIRST) and not spec.v2_local_broker:
-            state, buf = _phase_local_completions(spec, state, net, cache, buf, t1)
+            _ph("local_completions", lambda: _phase_local_completions(
+                spec, state, net, cache, buf, t1))
         if spec.learn_active:
             # delayed-reward credit: after completions/arrivals so a
             # status-6 ack that lands inside this tick credits this tick
-            state, buf = _phase_learn_credit(spec, state, net, cache, buf, t1)
+            _ph("learn_credit", lambda: _phase_learn_credit(
+                spec, state, net, cache, buf, t1))
 
         # 7b. flat per-node views of this tick's message counts, feeding
         # the cumulative per-module counters, the DropTail queues and the
@@ -2301,14 +2346,24 @@ def make_step(
                     jnp.zeros((1 + n_rest,), bool),
                 ]
             )
-            energy, alive = step_energy(
-                spec, state.nodes.energy, state.nodes.energy_capacity,
-                state.nodes.has_energy, state.nodes.alive, t1,
-                tx_all, rx_all, computing,
-            )
+            with jax.named_scope("phase_energy"):
+                energy, alive = step_energy(
+                    spec, state.nodes.energy, state.nodes.energy_capacity,
+                    state.nodes.has_energy, state.nodes.alive, t1,
+                    tx_all, rx_all, computing,
+                )
             state = state.replace(
                 nodes=state.nodes.replace(energy=energy, alive=alive)
             )
+
+        # 9. plane-1 telemetry accumulation (after every phase booked
+        # its work; before the tick counter advances so the reservoir
+        # slot is keyed on THIS tick's index)
+        if telem_on:
+            with jax.named_scope("phase_telemetry"):
+                state, buf = _phase_telemetry(
+                    spec, state, net, cache, buf, t1, ph_work
+                )
 
         state = state.replace(
             t=t1,

@@ -174,6 +174,25 @@ def fleet_decisions(
     return _fleet_pipeline(spec, R, batch, net, bounds, keys)
 
 
+def fleet_busy_fractions(
+    spec: WorldSpec, final_batch: WorldState
+) -> Optional[np.ndarray]:
+    """Replica-mean per-fog busy fraction of a finished fleet run.
+
+    The fleet analog of :func:`telemetry.metrics.busy_fractions`: each
+    replica carried its own device-resident ``TelemetryState``; this is
+    the single host gather averaging the (R, F) busy-tick counters over
+    the replica axis.  ``None`` when ``spec.telemetry`` was off.
+    """
+    if not spec.telemetry:
+        return None
+    busy = np.asarray(final_batch.telem.busy_ticks, np.float64)  # (R, F)
+    ticks = np.maximum(
+        np.asarray(final_batch.telem.ticks, np.float64), 1.0
+    )  # (R,)
+    return (busy / ticks[:, None]).mean(axis=0)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2,))
 def _fleet_series_chunk(
     spec: WorldSpec, n_ticks: int, batch: WorldState,

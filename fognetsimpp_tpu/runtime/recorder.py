@@ -95,6 +95,14 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
     learn_picks = (
         np.asarray(final.learn.pick_count) if spec.learn_active else None
     )
+    # plane-1 telemetry rows (telemetry/metrics.py): the per-fog busy
+    # fraction comes from busy_fractions() — the SAME call the
+    # OpenMetrics exposition uses, so .sca.json and the scrape output
+    # can never drift (the ISSUE 4 acceptance gate)
+    from ..telemetry.metrics import telemetry_summary
+
+    telem = telemetry_summary(spec, final)
+    busy_frac = telem["busy_frac"] if telem is not None else None
     # stack-level rows (r2 missing #4): per-node message counters — the
     # "packets sent"/"packets received" and per-NIC traffic rows of the
     # reference's ~1.5k-scalar .sca — plus per-AP association occupancy.
@@ -140,6 +148,16 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
             **(
                 {"learn_picks": float(learn_picks[f])}
                 if learn_picks is not None
+                else {}
+            ),
+            # device-resident telemetry rows (spec.telemetry)
+            **(
+                {
+                    "busy_frac": float(busy_frac[f]),
+                    "q_len_mean": float(telem["q_len_mean"][f]),
+                    "q_len_peak": int(telem["q_len_max"][f]),
+                }
+                if telem is not None
                 else {}
             ),
         }
@@ -224,6 +242,14 @@ def record_run(
             vectors[k] = np.asarray(v)
     np.savez_compressed(vec_path, **vectors)
     paths = {"sca": sca_path, "vec": vec_path}
+    # OpenMetrics text exposition (telemetry plane 3): always written —
+    # run counters are available on every run; the per-fog telemetry
+    # gauges join in when spec.telemetry was on
+    from ..telemetry.openmetrics import write_openmetrics
+
+    paths["om"] = write_openmetrics(
+        os.path.join(outdir, f"{run_id}.om.txt"), spec, final
+    )
     if scave:
         from .scave import NETWORK_NAMES, export_scave
 
@@ -314,6 +340,21 @@ def record_fleet_run(
         json.dump(_json_sanitize(sca), f, indent=1, default=str,
                   allow_nan=False)
     paths = {"sca": sca_path}
+    # replica-aggregated OpenMetrics exposition (telemetry plane 3)
+    from ..parallel.fleet import fleet_busy_fractions
+    from ..telemetry.openmetrics import render_fleet_openmetrics
+
+    # .fleet.-namespaced like the other fleet artifacts, so a
+    # single-world record_run into the same outdir/run_id never
+    # overwrites it
+    om_path = os.path.join(outdir, f"{run_id}.fleet.om.txt")
+    with open(om_path, "w") as f:
+        f.write(
+            render_fleet_openmetrics(
+                sca["fleet"], fleet_busy_fractions(spec, final_batch)
+            )
+        )
+    paths["om"] = om_path
     if series is not None:
         vec_path = os.path.join(outdir, f"{run_id}.fleet.vec.npz")
         np.savez_compressed(

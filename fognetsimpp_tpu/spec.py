@@ -28,6 +28,19 @@ import enum
 from typing import Optional, Tuple
 
 
+#: One message for the assume_static x Bianchi-keyed-MAC conflict,
+#: shared by every entry point that can hit it: WorldSpec.validate()
+#: (spec-level, via spec.mac_keyed), engine.run() (net-level
+#: belt-and-braces) and engine.make_step() — the entries must agree
+#: (ADVICE r5), so the text lives in exactly one place.
+STATIC_MAC_ERR = (
+    "assume_static cannot hoist a Bianchi-keyed association: "
+    "MAC contention is keyed on per-tick offered load (r5). "
+    "Disable assume_static for this world, or build the net "
+    "with mac_model='linear'."
+)
+
+
 class NodeKind(enum.IntEnum):
     """Role of a simulated node.
 
@@ -428,6 +441,22 @@ class WorldSpec:
     # spec under-declares.
     mac_keyed: bool = False
 
+    # --- telemetry (fognetsimpp_tpu.telemetry) --------------------------
+    # Plane-1 observability gate: carry a TelemetryState pytree in the
+    # scan (per-fog queue-depth min/max/sum, busy fractions, pool
+    # occupancy, bandit pick histogram, per-phase work counters, and a
+    # bounded strided reservoir of per-tick rows), accumulated entirely
+    # on device.  Off (the default) keeps every telemetry leaf zero-row
+    # and the run bit-exact vs the untelemetered engine
+    # (tests/test_telemetry.py state-hash A/B, the inert-LearnState
+    # discipline of PR 2).
+    telemetry: bool = False
+    # Reservoir rows for the whole horizon (strided sampling: row k
+    # holds tick k * ceil(n_ticks / rows)); bounds device memory at any
+    # horizon, the run_fleet_series discipline without per-chunk host
+    # offload.
+    telemetry_reservoir: int = 256
+
     # --- misc ----------------------------------------------------------
     bug_compat: BugCompat = BugCompat()
     record_tick_series: bool = False  # emit per-tick vectors from the scan
@@ -514,6 +543,26 @@ class WorldSpec:
         memory for it)."""
         return self.task_capacity if self.learn_active else 0
 
+    # --- telemetry sizing (zero-row when the plane is off) -------------
+    @property
+    def telemetry_fogs(self) -> int:
+        """Rows of the per-fog telemetry accumulators."""
+        return self.n_fogs if self.telemetry else 0
+
+    @property
+    def telemetry_phases(self) -> int:
+        """Rows of the per-phase work-counter vector."""
+        from .telemetry.metrics import PHASES
+
+        return len(PHASES) if self.telemetry else 0
+
+    @property
+    def telemetry_slots(self) -> int:
+        """Rows of the strided per-tick reservoir."""
+        if not self.telemetry:
+            return 0
+        return max(1, min(self.telemetry_reservoir, self.n_ticks))
+
     @property
     def auto_arrival_window(self) -> int:
         """Window sized from the spec's own arrival rate (VERDICT r3 #4).
@@ -541,18 +590,17 @@ class WorldSpec:
         )
         if self.arrival_window is not None:
             assert self.arrival_window > 0
+        assert self.telemetry_reservoir >= 1, (
+            "telemetry_reservoir sizes the per-tick sample reservoir "
+            "(>= 1 row)"
+        )
         if self.assume_static:
             assert not self.energy_enabled, (
                 "assume_static promises constant (pos, alive); the energy "
                 "model's lifecycle shutdown/restart mutates alive"
             )
             if self.mac_keyed:
-                raise ValueError(
-                    "assume_static cannot hoist a Bianchi-keyed "
-                    "association: MAC contention is keyed on per-tick "
-                    "offered load (r5).  Disable assume_static for this "
-                    "world, or build the net with mac_model='linear'."
-                )
+                raise ValueError(STATIC_MAC_ERR)
         assert self.max_sends_per_tick >= 1
         if self.arrival_cands_per_user is not None:
             assert self.arrival_cands_per_user >= 1

@@ -20,6 +20,7 @@ from flax import struct
 
 from .learn.bandits import LearnState, init_learn_state
 from .spec import NodeKind, Policy, Stage, WorldSpec
+from .telemetry.metrics import TelemetryState, init_telemetry_state
 
 # Sentinel for "no task": valid task ids are [0, T).
 NO_TASK = -1
@@ -224,6 +225,8 @@ class WorldState:
     metrics: Metrics
     learn: LearnState  # bandit-scheduler state (learn/bandits.py);
     #   inert zero-row provenance when spec.learn_active is False
+    telem: TelemetryState  # device-resident observability accumulators
+    #   (telemetry/metrics.py); zero-row when spec.telemetry is off
 
 
 def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
@@ -374,4 +377,5 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         tasks=tasks,
         metrics=metrics,
         learn=init_learn_state(spec),
+        telem=init_telemetry_state(spec),
     )

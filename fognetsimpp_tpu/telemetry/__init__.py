@@ -1,0 +1,41 @@
+"""Three-plane observability layer (the reference's GUI/@statistic analog).
+
+The reference ships its observability through OMNeT++'s Tkenv animation
+and ``@statistic`` signals; this reproduction records end-of-run
+artifacts (``runtime/recorder.py``) but — before this package — nothing
+observed what happens *inside* the jitted tick loop.  Three planes:
+
+* **Plane 1 — device-resident metrics** (:mod:`.metrics`): a
+  fixed-shape :class:`~fognetsimpp_tpu.telemetry.metrics.TelemetryState`
+  pytree riding the scan carry next to ``LearnState`` (zero-row when
+  ``spec.telemetry`` is off), accumulating per-tick/per-fog queue
+  depths, busy fractions, pool occupancy, bandit pick histograms and
+  per-phase work counters entirely on device, plus a bounded strided
+  reservoir of per-tick series rows — device memory stays bounded and
+  dispatch stays flat (the ``bench.py`` one-scalar-fetch rule).
+* **Plane 2 — task-lifecycle tracing** (:mod:`.timeline`): a post-run
+  exporter reconstructing each task's lifecycle spans (publish → broker
+  → fog queue → service → ack) from the task-table absolute-time
+  columns into Chrome/Perfetto trace-event JSON — the headless analog
+  of the reference's Tkenv animation, sibling to ``runtime/trails.py``.
+* **Plane 3 — host/compiler profiling** (:mod:`.profile`,
+  :mod:`.openmetrics`): ``jax.named_scope`` annotations on every engine
+  phase (XLA profiles attribute cost to ``phase_broker`` vs
+  ``phase_fog_arrivals``), ``bench.py --profile`` wrapping
+  ``jax.profiler.trace`` with dispatch-latency histograms, and
+  OpenMetrics text exposition of run scalars wired through
+  ``runtime/recorder.py`` and the fleet runner's replica-aggregated
+  recording.
+
+Only :mod:`.metrics` is imported here: the exporter modules import
+``state``/``recorder`` and would otherwise cycle with ``state.py``'s
+``TelemetryState`` import.
+"""
+from .metrics import (  # noqa: F401
+    PHASES,
+    RES_FIELDS,
+    TelemetryState,
+    busy_fractions,
+    init_telemetry_state,
+    telemetry_summary,
+)

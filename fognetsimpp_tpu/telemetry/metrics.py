@@ -1,0 +1,254 @@
+"""Plane 1: device-resident per-phase/per-fog metrics on the scan carry.
+
+:class:`TelemetryState` is a small fixed-shape pytree carried inside
+:class:`~fognetsimpp_tpu.state.WorldState` next to ``LearnState``: when
+``spec.telemetry`` is off every array leaf has zero rows (and the two
+scalar counters are never written), so inert worlds pay no memory and
+stay bit-exact — the same gate discipline as the PR 2 inert-LearnState
+contract (``tests/test_telemetry.py`` A/Bs it).
+
+Everything accumulates ON DEVICE inside the jitted tick loop — the
+engine's ``_phase_telemetry`` calls :func:`accumulate_tick` once per
+tick — and is fetched once, after the run, by
+:func:`telemetry_summary` / ``runtime/recorder.py``.  The per-tick
+reservoir is a strided sample of the run (``spec.telemetry_slots``
+rows for the whole horizon), so device memory stays bounded no matter
+the horizon, the ``run_fleet_series`` discipline without the per-chunk
+host offload.
+
+Per-phase "work done" counters: the engine brackets every phase call
+with :func:`metrics_activity` (the sum of all ``Metrics`` counters, a
+monotone per-tick activity measure) and credits the delta to that
+phase's :data:`PHASES` slot — so a regression in, say, credit
+assignment shows up as a shifted ``phase_work`` profile instead of only
+a moved benchmark number.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..spec import FogModel, WorldSpec
+
+#: Engine phase slots for the ``phase_work`` counter vector, in tick
+#: execution order.  Indices are static; phases a spec never traces
+#: simply keep a zero slot.
+PHASES = (
+    "connect",
+    "adverts",
+    "spawn",
+    "v2_release_pre",
+    "broker",
+    "v2_release_post",
+    "pool_completions",
+    "pool_arrivals",
+    "completions",
+    "fog_arrivals",
+    "local_completions",
+    "learn_credit",
+)
+PHASE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(PHASES)}
+
+#: Columns of one reservoir row (all f32).
+RES_FIELDS = ("t", "q_len_total", "n_busy", "n_deferred", "n_completed")
+
+
+@struct.dataclass
+class TelemetryState:
+    """Carry-resident telemetry accumulators (one per world / replica).
+
+    Array leaves are sized ``spec.telemetry_fogs`` /
+    ``spec.telemetry_phases`` / ``spec.telemetry_slots`` — the real
+    dimensions when ``spec.telemetry`` is on, zero rows otherwise.
+    """
+
+    ticks: jax.Array  # () i32 ticks accumulated (stays 0 when inert)
+    defer_sum: jax.Array  # () i32 sum of the per-tick deferred gauge
+    q_len_sum: jax.Array  # (Fm,) f32 per-fog queue-depth sum over ticks
+    q_len_max: jax.Array  # (Fm,) i32 per-fog queue-depth running max
+    q_len_min: jax.Array  # (Fm,) i32 per-fog queue-depth running min
+    busy_ticks: jax.Array  # (Fm,) i32 ticks the fog server was busy
+    pool_occ_sum: jax.Array  # (Fm,) f32 POOL-model occupancy-fraction sum
+    pick_hist: jax.Array  # (Fm,) f32 bandit pick histogram (a live copy
+    #   of LearnState.pick_count; zeros when the learn subsystem is off)
+    phase_work: jax.Array  # (Pm,) i32 per-phase work-done counters
+    res: jax.Array  # (Rm, len(RES_FIELDS)) f32 strided per-tick rows
+
+
+def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
+    """The t=0 telemetry state for ``spec`` (zero-row when off)."""
+    Fm, Pm, Rm = (
+        spec.telemetry_fogs, spec.telemetry_phases, spec.telemetry_slots
+    )
+    f32, i32 = jnp.float32, jnp.int32
+    return TelemetryState(
+        ticks=jnp.zeros((), i32),
+        defer_sum=jnp.zeros((), i32),
+        q_len_sum=jnp.zeros((Fm,), f32),
+        q_len_max=jnp.zeros((Fm,), i32),
+        q_len_min=jnp.full((Fm,), spec.queue_capacity, i32),
+        busy_ticks=jnp.zeros((Fm,), i32),
+        pool_occ_sum=jnp.zeros((Fm,), f32),
+        pick_hist=jnp.zeros((Fm,), f32),
+        phase_work=jnp.zeros((Pm,), i32),
+        res=jnp.zeros((Rm, len(RES_FIELDS)), f32),
+    )
+
+
+def metrics_activity(metrics) -> jax.Array:
+    """Sum of every ``Metrics`` counter: the phase-work bracket scalar.
+
+    Within one tick every counter is non-decreasing (the ``n_deferred``
+    gauge resets before the phases run), so the delta across a phase
+    call is that phase's booked activity.
+    """
+    vals = [
+        getattr(metrics, f.name) for f in dataclasses.fields(metrics)
+    ]
+    return jnp.sum(jnp.stack(vals))
+
+
+def tick_activity(metrics, buf) -> jax.Array:
+    """Activity bracket over Metrics counters AND the tick's message
+    buffers (``engine.TickBuf``): phases whose work is pure message
+    movement (fog arrivals queueing tasks, ack relays) book no Metrics
+    counter, but every one of them books tx/rx — so the combined sum is
+    the monotone-within-a-tick measure the per-phase work counters
+    bracket."""
+    s = metrics_activity(metrics)
+    for leaf in buf:
+        s = s + jnp.sum(leaf)
+    return s
+
+
+def accumulate_tick(
+    spec: WorldSpec,
+    telem: TelemetryState,
+    fogs,
+    learn,
+    metrics,
+    tick: jax.Array,
+    t1: jax.Array,
+    phase_work: Optional[Dict[int, jax.Array]] = None,
+) -> TelemetryState:
+    """Fold one finished tick into the telemetry accumulators.
+
+    Pure function of its arguments (``fogs``/``learn``/``metrics`` ride
+    in as args, never by closure — simlint R3) and an endomorphism over
+    :class:`TelemetryState`, so it is scan-carry safe and ``vmap``s over
+    the fleet's replica axis unchanged.  Only traced when
+    ``spec.telemetry`` is on.
+    """
+    from ..ops.queues import NO_TASK
+
+    f32, i32 = jnp.float32, jnp.int32
+    q = fogs.q_len.astype(i32)
+    if spec.fog_model == int(FogModel.POOL):
+        occ = jnp.clip(
+            (fogs.mips - fogs.pool_avail)
+            / jnp.maximum(fogs.mips, 1e-9),
+            0.0,
+            1.0,
+        )
+        busy = fogs.pool_avail < fogs.mips
+    else:
+        busy = fogs.current_task != NO_TASK
+        occ = busy.astype(f32)
+    telem = telem.replace(
+        ticks=telem.ticks + 1,
+        defer_sum=telem.defer_sum + metrics.n_deferred,
+        q_len_sum=telem.q_len_sum + q.astype(f32),
+        q_len_max=jnp.maximum(telem.q_len_max, q),
+        q_len_min=jnp.minimum(telem.q_len_min, q),
+        busy_ticks=telem.busy_ticks + busy.astype(i32),
+        pool_occ_sum=telem.pool_occ_sum + occ,
+    )
+    if spec.learn_active:
+        telem = telem.replace(pick_hist=learn.pick_count)
+    if phase_work:
+        idxs = np.asarray(sorted(phase_work), np.int32)
+        vals = jnp.stack(
+            [phase_work[int(i)] for i in idxs]
+        ).astype(i32)
+        telem = telem.replace(
+            phase_work=telem.phase_work.at[idxs].add(vals)
+        )
+    R = telem.res.shape[0]
+    if R > 0:
+        stride = max(1, -(-spec.n_ticks // R))
+        slot = (tick // stride).astype(i32)
+        write = (tick % stride) == 0
+        row = jnp.stack(
+            [
+                t1.astype(f32),
+                jnp.sum(q).astype(f32),
+                jnp.sum(busy.astype(i32)).astype(f32),
+                metrics.n_deferred.astype(f32),
+                metrics.n_completed.astype(f32),
+            ]
+        )
+        telem = telem.replace(
+            res=telem.res.at[jnp.where(write, slot, R)].set(
+                row, mode="drop"
+            )
+        )
+    return telem
+
+
+# ----------------------------------------------------------------------
+# host-side readers (post-run; one fetch each)
+# ----------------------------------------------------------------------
+
+def busy_fractions(spec: WorldSpec, final) -> Optional[np.ndarray]:
+    """Per-fog busy fraction (ticks busy / ticks observed) as a host
+    array, or ``None`` when ``spec.telemetry`` was off.
+
+    The single source of truth for the value: ``recorder
+    .per_module_scalars`` (the ``.sca.json`` fog rows) and the
+    OpenMetrics exposition both call this, so the two outputs agree
+    exactly, not merely to tolerance.
+    """
+    if not spec.telemetry:
+        return None
+    ticks = max(int(np.asarray(final.telem.ticks)), 1)
+    return np.asarray(final.telem.busy_ticks, np.float64) / ticks
+
+
+def telemetry_summary(spec: WorldSpec, final) -> Optional[Dict]:
+    """Host-side roll-up of a finished telemetry-on run.
+
+    Returns ``None`` when ``spec.telemetry`` was off; otherwise a dict
+    of per-fog vectors (busy fraction, queue-depth mean/min/max, pool
+    occupancy, pick histogram), the named per-phase work counters, and
+    the reservoir as ``{field: (Rm,) array}``.
+    """
+    if not spec.telemetry:
+        return None
+    t = final.telem
+    ticks = max(int(np.asarray(t.ticks)), 1)
+    res = np.asarray(t.res, np.float64)
+    Rm = res.shape[0]
+    stride = max(1, -(-spec.n_ticks // Rm)) if Rm else 1
+    n_rows = min(Rm, -(-ticks // stride))
+    return {
+        "ticks": ticks,
+        "defer_sum": int(np.asarray(t.defer_sum)),
+        "busy_frac": busy_fractions(spec, final),
+        "q_len_mean": np.asarray(t.q_len_sum, np.float64) / ticks,
+        "q_len_max": np.asarray(t.q_len_max, np.int64),
+        "q_len_min": np.asarray(t.q_len_min, np.int64),
+        "pool_occ_mean": np.asarray(t.pool_occ_sum, np.float64) / ticks,
+        "pick_hist": np.asarray(t.pick_hist, np.float64),
+        "phase_work": {
+            name: int(np.asarray(t.phase_work[i]))
+            for i, name in enumerate(PHASES)
+        },
+        "reservoir": {
+            f: res[:n_rows, i] for i, f in enumerate(RES_FIELDS)
+        },
+    }

@@ -1,0 +1,136 @@
+"""Plane 3 (exposition): run scalars as OpenMetrics text.
+
+The reference's scalars live in a binary-ish ``.sca`` only its own
+Scave tooling reads; production observability wants the scrape format
+everything else speaks.  :func:`render_openmetrics` turns a finished
+run into OpenMetrics text exposition (one ``# TYPE`` line + samples per
+family, ``# EOF`` terminator): every ``Metrics`` counter and signal
+roll-up from :func:`runtime.signals.summarize`, plus — when
+``spec.telemetry`` is on — per-fog gauges (busy fraction, queue-depth
+mean/max, pool occupancy, bandit picks) straight from the
+device-resident :class:`~fognetsimpp_tpu.telemetry.metrics
+.TelemetryState`.
+
+The per-fog busy fraction is read from
+:func:`telemetry.metrics.telemetry_summary`'s ``busy_frac`` entry (one
+:func:`~fognetsimpp_tpu.telemetry.metrics.busy_fractions` computation)
+— the SAME source the recorder's ``.sca.json`` fog rows use — so the
+two outputs agree exactly (the acceptance gate asserts 1e-6).  Non-finite values are skipped, never
+emitted: OpenMetrics has no NaN/Infinity sample syntax worth relying
+on, the same RFC-pitfall discipline as ``recorder._json_sanitize``.
+
+``tools/check_openmetrics.py`` is the matching ~20-line format linter
+(CI runs it on the smoke scenario's output).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..spec import WorldSpec
+from ..state import WorldState
+
+_PREFIX = "fns"
+
+
+def _sample(lines: List[str], name: str, value, labels: str = "") -> None:
+    v = float(value)
+    if not math.isfinite(v):
+        return
+    # integral values render without a trailing .0 (stable goldens)
+    sv = str(int(v)) if v == int(v) and abs(v) < 2**53 else repr(v)
+    lines.append(f"{_PREFIX}_{name}{labels} {sv}")
+
+
+def _family(lines: List[str], name: str, kind: str = "gauge") -> None:
+    lines.append(f"# TYPE {_PREFIX}_{name} {kind}")
+
+
+def render_openmetrics(
+    spec: WorldSpec,
+    final: WorldState,
+    attrs: Optional[Dict] = None,
+) -> str:
+    """OpenMetrics text for one finished run (terminated by ``# EOF``)."""
+    from ..runtime.signals import summarize
+    from .metrics import telemetry_summary
+
+    lines: List[str] = []
+    for k, v in summarize(final).items():
+        if isinstance(v, float) and not math.isfinite(v):
+            continue
+        _family(lines, k)
+        _sample(lines, k, v)
+    summ = telemetry_summary(spec, final)
+    if summ is not None:
+        per_fog = {
+            "fog_busy_fraction": summ["busy_frac"],
+            "fog_q_len_mean": summ["q_len_mean"],
+            "fog_q_len_max": summ["q_len_max"],
+            "fog_pool_occ_mean": summ["pool_occ_mean"],
+            "fog_picks": summ["pick_hist"],
+        }
+        for name, vec in per_fog.items():
+            _family(lines, name)
+            for f in range(spec.n_fogs):
+                _sample(lines, name, vec[f], labels=f'{{fog="{f}"}}')
+        _family(lines, "phase_work")
+        for phase, n in summ["phase_work"].items():
+            _sample(
+                lines, "phase_work", n, labels=f'{{phase="{phase}"}}'
+            )
+        _family(lines, "telemetry_ticks")
+        _sample(lines, "telemetry_ticks", summ["ticks"])
+        _family(lines, "deferred_sum")
+        _sample(lines, "deferred_sum", summ["defer_sum"])
+    for k, v in (attrs or {}).items():
+        if isinstance(v, (int, float)) and math.isfinite(float(v)):
+            _family(lines, f"run_{k}")
+            _sample(lines, f"run_{k}", v)
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def render_fleet_openmetrics(
+    fleet_scalars: Dict,
+    busy_frac: Optional[np.ndarray] = None,
+) -> str:
+    """OpenMetrics text for a fleet run's replica-aggregated scalars.
+
+    ``fleet_scalars`` is the dict from ``recorder.fleet_scalars``;
+    ``busy_frac`` is the optional replica-mean per-fog busy fraction
+    (``parallel.fleet.fleet_busy_fractions``).
+    """
+    lines: List[str] = []
+    _family(lines, "fleet_replicas")
+    _sample(lines, "fleet_replicas", fleet_scalars["n_replicas"])
+    for k, agg in fleet_scalars["aggregate"].items():
+        _family(lines, f"fleet_{k}")
+        for stat in ("sum", "mean", "min", "max"):
+            _sample(
+                lines, f"fleet_{k}", agg[stat],
+                labels=f'{{stat="{stat}"}}',
+            )
+    if busy_frac is not None:
+        _family(lines, "fleet_fog_busy_fraction")
+        for f in range(len(busy_frac)):
+            _sample(
+                lines, "fleet_fog_busy_fraction", busy_frac[f],
+                labels=f'{{fog="{f}"}}',
+            )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def write_openmetrics(
+    path: str,
+    spec: WorldSpec,
+    final: WorldState,
+    attrs: Optional[Dict] = None,
+) -> str:
+    """Render and write; returns ``path``."""
+    with open(path, "w") as f:
+        f.write(render_openmetrics(spec, final, attrs=attrs))
+    return path

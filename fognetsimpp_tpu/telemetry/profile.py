@@ -1,0 +1,108 @@
+"""Plane 3 (host/compiler): profiler wiring + dispatch-latency stats.
+
+Three small tools, all host-side:
+
+* :func:`profile_trace` — a context manager around
+  ``jax.profiler.trace`` (XLA/TensorBoard trace capture).  With the
+  ``jax.named_scope`` annotations the engine puts on every phase, the
+  captured trace attributes device time to ``phase_broker`` vs
+  ``phase_fog_arrivals`` etc. instead of one opaque scan body.  Profiler
+  start failures (unsupported backend, already-active session) degrade
+  to a no-op with a note — profiling must never take down a run.
+* :func:`measure_dispatch` — times repeated calls of an already-warm
+  jitted callable (including the value fetch, i.e. the real round trip
+  the tunnel charges) and returns a latency histogram: the per-chunk
+  dispatch cost ``BENCHMARKS.md``'s methodology section talks about,
+  measured instead of asserted.
+* :func:`measure_compile` — wall-clock of ``jax.jit(fn).lower(...)
+  .compile()``: the cold-compile number a driver capture reports.
+
+``bench.py --profile`` composes all three into the benchmark JSON.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+@contextlib.contextmanager
+def profile_trace(outdir: Optional[str]):
+    """Wrap a block in ``jax.profiler.trace(outdir)`` when possible.
+
+    Yields a dict with ``{"active": bool, "dir": str|None, "error":
+    str|None}`` so callers can report what actually happened.
+    """
+    info = {"active": False, "dir": outdir, "error": None}
+    if not outdir:
+        yield info
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(outdir)
+        info["active"] = True
+    except Exception as e:  # unsupported backend / nested session
+        info["error"] = f"{type(e).__name__}: {e}"
+        yield info
+        return
+    try:
+        yield info
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            info["error"] = f"{type(e).__name__}: {e}"
+
+
+def latency_histogram(
+    samples_s: Sequence[float],
+    edges_ms: Sequence[float] = (1.0, 5.0, 20.0, 50.0, 100.0, 250.0),
+) -> Dict:
+    """Summary + bucket counts (ms) for a list of wall-time samples."""
+    ms = sorted(s * 1e3 for s in samples_s)
+    n = len(ms)
+    if n == 0:
+        return {"n": 0}
+    q = lambda p: ms[min(n - 1, int(p * n))]
+    buckets: Dict[str, int] = {}
+    lo = 0.0
+    for e in edges_ms:
+        buckets[f"le_{e:g}ms"] = sum(1 for m in ms if lo < m <= e)
+        lo = e
+    buckets["gt"] = sum(1 for m in ms if m > edges_ms[-1])
+    return {
+        "n": n,
+        "p50_ms": round(q(0.50), 3),
+        "p90_ms": round(q(0.90), 3),
+        "max_ms": round(ms[-1], 3),
+        "buckets": buckets,
+    }
+
+
+def measure_dispatch(
+    call: Callable[[], object], n: int = 10, warmup: int = 1
+) -> Dict:
+    """Latency histogram over ``n`` calls of a warm jitted callable.
+
+    ``call`` must synchronize (fetch a value) so each sample covers the
+    full dispatch + fetch round trip — the flat per-call cost the
+    bench methodology pipelines around.
+    """
+    for _ in range(warmup):
+        call()
+    samples: List[float] = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        call()
+        samples.append(time.perf_counter() - t0)
+    return latency_histogram(samples)
+
+
+def measure_compile(fn: Callable, *args, **kwargs) -> float:
+    """Seconds to lower + compile ``fn`` for the given arguments."""
+    import jax
+
+    t0 = time.perf_counter()
+    jax.jit(fn).lower(*args, **kwargs).compile()
+    return time.perf_counter() - t0

@@ -1,0 +1,211 @@
+"""Plane 2: task-lifecycle tracing to Chrome/Perfetto trace-event JSON.
+
+The task table already holds every lifecycle timestamp as an absolute
+event time (``state.TaskState``: ``t_create`` → ``t_at_broker`` →
+``t_at_fog`` → ``t_q_enter`` → ``t_service_start`` → ``t_complete`` →
+``t_ack6``), masked exactly like :mod:`fognetsimpp_tpu.runtime.signals`
+does.  This exporter reconstructs those columns into the trace-event
+JSON format (the ``chrome://tracing`` / Perfetto schema), so a whole
+simulated run is inspectable as a zoomable timeline — the headless
+analog of the reference's Tkenv animation, sibling to
+``runtime/trails.py``'s SVG snapshot.
+
+Mapping: **replica → pid, fog → tid**.  Each replica is one "process";
+inside it every fog node is a "thread" carrying, per task it served, a
+``task`` span (fog arrival → completion) with nested ``queued`` and
+``service`` child spans; one extra ``broker`` thread (tid = n_fogs)
+carries the ``publish`` uplink spans and instant markers for terminal
+failures (lost / dropped / rejected / no-resource).  Timestamps are
+simulated microseconds (the trace-event unit), durations clamped ≥ 0,
+and only finite columns are emitted — the output round-trips through
+strict ``json.loads`` with no ``NaN``/``Infinity`` tokens (the same
+RFC 8259 pitfall ``recorder.spec_to_dict`` already handles).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..spec import Stage, WorldSpec
+from ..state import WorldState
+
+#: Terminal stages that never reach a fog: shown as instant markers.
+_FAIL_STAGES = {
+    int(Stage.LOST): "lost",
+    int(Stage.DROPPED): "dropped",
+    int(Stage.REJECTED): "rejected",
+    int(Stage.NO_RESOURCE): "no_resource",
+}
+
+
+def _us(t: np.ndarray) -> np.ndarray:
+    """Seconds → microseconds, as float64 (trace-event ts unit)."""
+    return np.asarray(t, np.float64) * 1e6
+
+
+def _span(name, pid, tid, ts, dur, args=None) -> Dict:
+    ev = {
+        "name": name,
+        "ph": "X",
+        "pid": int(pid),
+        "tid": int(tid),
+        "ts": float(ts),
+        "dur": float(max(dur, 0.0)),
+        "cat": "task",
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _replica_events(
+    spec: WorldSpec, tasks_np: Dict[str, np.ndarray], pid: int,
+    max_tasks: Optional[int] = None,
+) -> List[Dict]:
+    F, S = spec.n_fogs, spec.max_sends_per_user
+    stage = tasks_np["stage"].astype(np.int64)
+    fog = tasks_np["fog"].astype(np.int64)
+    used = stage != int(Stage.UNUSED)
+    ids = np.nonzero(used)[0]
+    if max_tasks is not None:
+        ids = ids[:max_tasks]
+    events: List[Dict] = []
+    broker_tid = F
+    t_create = _us(tasks_np["t_create"])
+    t_at_broker = _us(tasks_np["t_at_broker"])
+    t_at_fog = _us(tasks_np["t_at_fog"])
+    t_q_enter = _us(tasks_np["t_q_enter"])
+    t_service = _us(tasks_np["t_service_start"])
+    t_complete = _us(tasks_np["t_complete"])
+    t_ack6 = _us(tasks_np["t_ack6"])
+    mips = np.asarray(tasks_np["mips_req"], np.float64)
+    for i in ids:
+        i = int(i)
+        user = i // S
+        args = {"task": i, "user": user, "mips_req": float(mips[i])}
+        st = int(stage[i])
+        if np.isfinite(t_create[i]) and np.isfinite(t_at_broker[i]):
+            events.append(
+                _span(
+                    "publish", pid, broker_tid, t_create[i],
+                    t_at_broker[i] - t_create[i], args,
+                )
+            )
+        if st in _FAIL_STAGES and np.isfinite(t_create[i]):
+            events.append(
+                {
+                    "name": _FAIL_STAGES[st],
+                    "ph": "i",
+                    "s": "t",
+                    "pid": pid,
+                    "tid": int(broker_tid),
+                    "ts": float(t_create[i]),
+                    "cat": "task",
+                    "args": args,
+                }
+            )
+        f = int(fog[i])
+        if f < 0 or f >= F:
+            continue
+        if np.isfinite(t_at_fog[i]) and np.isfinite(t_complete[i]):
+            events.append(
+                _span(
+                    f"task{i}", pid, f, t_at_fog[i],
+                    t_complete[i] - t_at_fog[i], args,
+                )
+            )
+        if np.isfinite(t_q_enter[i]) and np.isfinite(t_service[i]):
+            events.append(
+                _span(
+                    "queued", pid, f, t_q_enter[i],
+                    t_service[i] - t_q_enter[i],
+                )
+            )
+        if np.isfinite(t_service[i]) and np.isfinite(t_complete[i]):
+            events.append(
+                _span(
+                    "service", pid, f, t_service[i],
+                    t_complete[i] - t_service[i],
+                )
+            )
+        if np.isfinite(t_complete[i]) and np.isfinite(t_ack6[i]):
+            events.append(
+                _span(
+                    "ack", pid, broker_tid, t_complete[i],
+                    t_ack6[i] - t_complete[i], args,
+                )
+            )
+    # lane names: one metadata event per thread (Perfetto track labels)
+    for f in range(F):
+        events.append(
+            {
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": f, "args": {"name": f"fog{f}"},
+            }
+        )
+    events.append(
+        {
+            "name": "thread_name", "ph": "M", "pid": pid,
+            "tid": int(broker_tid), "args": {"name": "broker"},
+        }
+    )
+    events.append(
+        {
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": f"replica{pid}"},
+        }
+    )
+    return events
+
+
+def build_trace(
+    spec: WorldSpec, final: WorldState, max_tasks: Optional[int] = None
+) -> Dict:
+    """The trace-event dict for a finished run (single world or a
+    replica batch: a leading replica axis on the task columns becomes
+    one pid per replica)."""
+    cols = {
+        k: np.asarray(getattr(final.tasks, k))
+        for k in (
+            "stage", "fog", "mips_req", "t_create", "t_at_broker",
+            "t_at_fog", "t_q_enter", "t_service_start", "t_complete",
+            "t_ack6",
+        )
+    }
+    batched = cols["stage"].ndim == 2
+    n_rep = cols["stage"].shape[0] if batched else 1
+    events: List[Dict] = []
+    for r in range(n_rep):
+        rep_cols = (
+            {k: v[r] for k, v in cols.items()} if batched else cols
+        )
+        events.extend(
+            _replica_events(spec, rep_cols, pid=r, max_tasks=max_tasks)
+        )
+    # metadata first, then spans by (ts, -dur): a parent span sorts
+    # before its children, and Perfetto/golden checks see monotone ts
+    events.sort(
+        key=lambda e: (
+            0 if e["ph"] == "M" else 1,
+            e.get("ts", 0.0),
+            -e.get("dur", 0.0),
+        )
+    )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_trace(
+    spec: WorldSpec,
+    final: WorldState,
+    path: str,
+    max_tasks: Optional[int] = None,
+) -> str:
+    """Write the Perfetto trace JSON for ``final`` to ``path``."""
+    trace = build_trace(spec, final, max_tasks=max_tasks)
+    # compact separators: pretty-printing roughly doubles the very
+    # traces the --trace-max-tasks cap exists to keep loadable
+    with open(path, "w") as f:
+        json.dump(trace, f, separators=(",", ":"), allow_nan=False)
+    return path

@@ -60,6 +60,10 @@ _QUICK_FILES = {
     # vs vmap equivalence gate — the multi-chip headline's correctness
     # contract belongs in tier-1, exactly like the donation gates above
     "test_fleet.py",
+    # telemetry/ (ISSUE 4): the inert-TelemetryState bit-exactness gate,
+    # the Perfetto golden and the OpenMetrics/.sca.json agreement — all
+    # small worlds, and exactly the checks an engine edit must not break
+    "test_telemetry.py",
 }
 
 

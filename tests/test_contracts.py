@@ -15,8 +15,9 @@ from fognetsimpp_tpu.scenarios import smoke
 def _worlds():
     # FIFO v3 argmin-family world (dense broker), v2 POOL LOCAL_FIRST
     # world (compacted broker + pool phases + v2 release timer), a
-    # coarse-dt multi-send world (spawn_multi), and a learned-policy
-    # world (compacted broker + the bandit credit phase)
+    # coarse-dt multi-send world (spawn_multi), a learned-policy world
+    # (compacted broker + the bandit credit phase), and a telemetry
+    # world (plane-1 accumulation phase, ISSUE 4)
     return [
         smoke.build(horizon=0.4),
         smoke.build(
@@ -28,6 +29,7 @@ def _worlds():
             horizon=0.3, dt=0.2, send_interval=0.05, max_sends_per_tick=8
         ),
         smoke.build(horizon=0.4, policy=8),  # Policy.UCB
+        smoke.build(horizon=0.4, telemetry=True),
     ]
 
 

@@ -1,0 +1,356 @@
+"""telemetry/ — the three-plane observability layer (ISSUE 4).
+
+Plane 1 gates: the zero-row TelemetryState is inert (state-hash A/B
+across run entries, and telemetry ON perturbs not a single non-telem
+bit), and the device-resident accumulators agree with host-side ground
+truth.  Plane 2: the Perfetto exporter against a committed golden.
+Plane 3: OpenMetrics exposition matching the recorder's ``.sca.json``
+to 1e-6 (exactly, in fact — one shared computation).
+"""
+import dataclasses
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, run
+from fognetsimpp_tpu.scenarios import smoke
+
+GOLDEN = Path(__file__).parent / "data" / "telemetry_smoke_trace.json"
+
+SMALL = dict(n_users=2, n_fogs=2, send_interval=0.05, horizon=0.4)
+
+
+def _state_hash(state) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+# ----------------------------------------------------------------------
+# Plane 1: inert gate + accumulators
+# ----------------------------------------------------------------------
+
+WORLDS = [
+    dict(policy=int(Policy.MIN_BUSY)),  # dense broker path
+    dict(policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0),  # compacted
+    dict(policy=int(Policy.UCB)),  # learned (learn + telem carry fields)
+]
+
+
+def test_telemetry_off_bit_exact_across_run_entries():
+    """The PR 2 inert-LearnState discipline, replayed for telemetry:
+    with spec.telemetry off (the default) every telemetry leaf has zero
+    rows, stays zero, and run / run_jit / run_chunked produce
+    bit-identical final states."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    for kw in WORLDS:
+        spec, state, net, bounds = _build(**kw)
+        assert not spec.telemetry
+        assert spec.telemetry_fogs == 0 and spec.telemetry_slots == 0
+        ref, _ = run(spec, state, net, bounds)
+        assert ref.telem.q_len_sum.shape == (0,)
+        assert ref.telem.res.shape[0] == 0
+        assert int(np.asarray(ref.telem.ticks)) == 0
+        h_ref = _state_hash(ref)
+        spec2, state2, net2, bounds2 = _build(**kw)
+        assert _state_hash(run_jit(spec2, state2, net2, bounds2)) == h_ref
+        spec3, state3, net3, bounds3 = _build(**kw)
+        assert (
+            _state_hash(run_chunked(spec3, state3, net3, bounds3, 170))
+            == h_ref
+        )
+
+
+def test_telemetry_on_never_perturbs_the_simulation():
+    """Telemetry ON is read-only: every non-telem leaf of the final
+    state is bit-equal to the telemetry-off run of the same world."""
+    for kw in WORLDS:
+        spec_off, s_off, net, bounds = _build(**kw)
+        ref, _ = run(spec_off, s_off, net, bounds)
+        spec_on, s_on, net2, bounds2 = _build(telemetry=True, **kw)
+        assert spec_on.telemetry_fogs == spec_on.n_fogs
+        got, _ = run(spec_on, s_on, net2, bounds2)
+        for f in dataclasses.fields(ref):
+            if f.name == "telem":
+                continue
+            for a, b in zip(
+                jax.tree.leaves(getattr(ref, f.name)),
+                jax.tree.leaves(getattr(got, f.name)),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=f.name
+                )
+
+
+def test_accumulators_match_host_ground_truth():
+    """Busy fraction / queue stats / pick histogram from the carry
+    agree with what the final state itself implies."""
+    from fognetsimpp_tpu.telemetry.metrics import telemetry_summary
+
+    spec, state, net, bounds = _build(
+        telemetry=True, policy=int(Policy.UCB), horizon=1.0
+    )
+    final, _ = run(spec, state, net, bounds)
+    summ = telemetry_summary(spec, final)
+    assert summ["ticks"] == spec.n_ticks
+    # pick histogram is the live copy of the learner's pick counts
+    np.testing.assert_allclose(
+        summ["pick_hist"], np.asarray(final.learn.pick_count)
+    )
+    # queue-depth bounds: min <= mean <= max, max within capacity
+    assert (summ["q_len_min"] <= summ["q_len_max"]).all()
+    assert (summ["q_len_mean"] <= summ["q_len_max"] + 1e-9).all()
+    assert (summ["q_len_max"] <= spec.queue_capacity).all()
+    assert ((summ["busy_frac"] >= 0) & (summ["busy_frac"] <= 1)).all()
+    # phase work: the broker phase booked at least every decision, and
+    # phases this spec never traces booked nothing
+    m = final.metrics
+    assert summ["phase_work"]["broker"] >= int(np.asarray(m.n_scheduled))
+    assert summ["phase_work"]["pool_arrivals"] == 0
+    assert summ["phase_work"]["v2_release_pre"] == 0
+
+
+def test_reservoir_is_bounded_and_monotone():
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_reservoir=16, horizon=1.0
+    )
+    assert spec.telemetry_slots == 16
+    assert spec.n_ticks > 16  # genuinely strided
+    final, _ = run(spec, state, net, bounds)
+    from fognetsimpp_tpu.telemetry.metrics import telemetry_summary
+
+    res = telemetry_summary(spec, final)["reservoir"]
+    t = res["t"]
+    assert len(t) == 16
+    assert (np.diff(t) > 0).all()  # strided sample times increase
+    assert (np.diff(res["n_completed"]) >= 0).all()  # cumulative
+
+
+def test_fleet_carries_telemetry_identically_to_vmap():
+    """The telemetry carry rides the replica-sharded fleet scan
+    bit-identically to the plain vmap path (8-virtual-device mesh)."""
+    from fognetsimpp_tpu.parallel import make_mesh, replicate_state
+    from fognetsimpp_tpu.parallel.fleet import (
+        fleet_busy_fractions,
+        run_fleet,
+    )
+    from fognetsimpp_tpu.parallel.replicas import run_replicated
+
+    spec, state, net, bounds = _build(telemetry=True, horizon=0.2)
+    batch = replicate_state(spec, state, 8, seed=3)
+    ref = run_replicated(spec, batch, net, bounds)
+    got = run_fleet(
+        spec, batch, net, bounds, make_mesh(8), donate=False
+    )
+    for a, b in zip(jax.tree.leaves(ref.telem), jax.tree.leaves(got.telem)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    bf = fleet_busy_fractions(spec, got)
+    assert bf.shape == (spec.n_fogs,)
+    assert ((bf >= 0) & (bf <= 1)).all()
+
+
+def test_telemetry_contract_and_phase_registry():
+    from fognetsimpp_tpu.core.contracts import (
+        PHASE_CONTRACTS,
+        check_step_contract,
+        check_telemetry_contract,
+    )
+
+    assert any(pc.name == "_phase_telemetry" for pc in PHASE_CONTRACTS)
+    spec, state, net, bounds = _build(telemetry=True)
+    check_telemetry_contract(spec, state)
+    check_step_contract(spec, state, net, bounds)
+    spec0, state0, _, _ = _build()
+    check_telemetry_contract(spec0, state0)
+
+
+# ----------------------------------------------------------------------
+# Plane 2: Perfetto exporter
+# ----------------------------------------------------------------------
+
+def _golden_world():
+    return smoke.build(
+        n_users=2, n_fogs=2, fog_mips=(4000.0, 2000.0),
+        send_interval=0.05, horizon=0.4, telemetry=True,
+    )
+
+
+def _no_nonfinite(name):
+    raise AssertionError(f"non-RFC-8259 token in trace JSON: {name}")
+
+
+def test_perfetto_trace_matches_committed_golden(tmp_path):
+    from fognetsimpp_tpu.telemetry.timeline import export_trace
+
+    spec, state, net, bounds = _golden_world()
+    final, _ = run(spec, state, net, bounds)
+    p = export_trace(spec, final, str(tmp_path / "trace.json"))
+    # strict round trip: NaN/Infinity tokens are a parse failure here
+    got = json.loads(open(p).read(), parse_constant=_no_nonfinite)
+    want = json.loads(GOLDEN.read_text(), parse_constant=_no_nonfinite)
+    ge, we = got["traceEvents"], want["traceEvents"]
+    assert len(ge) == len(we)
+    for g, w in zip(ge, we):
+        assert (g["name"], g["ph"], g["pid"], g.get("tid")) == (
+            w["name"], w["ph"], w["pid"], w.get("tid")
+        )
+        if g["ph"] == "X":
+            assert g["ts"] == pytest.approx(w["ts"], rel=1e-6)
+            assert g["dur"] == pytest.approx(w["dur"], rel=1e-6)
+
+
+def test_perfetto_trace_structure():
+    """pid/tid mapping (replica→pid, fog→tid), monotone ts, span
+    nesting (queued/service inside the per-fog task span), durations
+    finite and non-negative."""
+    from fognetsimpp_tpu.telemetry.timeline import build_trace
+
+    spec, state, net, bounds = _golden_world()
+    final, _ = run(spec, state, net, bounds)
+    trace = build_trace(spec, final)
+    ev = trace["traceEvents"]
+    spans = [e for e in ev if e["ph"] == "X"]
+    assert spans, "no spans exported"
+    assert all(e["pid"] == 0 for e in ev)  # single world: one replica
+    # fog lanes 0..F-1 plus the broker lane F
+    tids = {e["tid"] for e in spans}
+    assert tids <= set(range(spec.n_fogs + 1))
+    # spans sorted by ts (metadata first)
+    ts = [e["ts"] for e in spans]
+    assert ts == sorted(ts)
+    assert all(np.isfinite(e["dur"]) and e["dur"] >= 0 for e in spans)
+    # nesting: every queued/service child lies inside its fog's
+    # enclosing task span
+    tasks = {}
+    for e in spans:
+        if e["name"].startswith("task"):
+            tasks.setdefault(e["tid"], []).append(e)
+    checked = 0
+    for e in spans:
+        if e["name"] in ("queued", "service"):
+            parents = tasks.get(e["tid"], [])
+            assert any(
+                p["ts"] - 1e-6 <= e["ts"]
+                and e["ts"] + e["dur"] <= p["ts"] + p["dur"] + 1e-6
+                for p in parents
+            ), e
+            checked += 1
+    assert checked > 0
+
+
+def test_perfetto_trace_maps_replicas_to_pids():
+    from fognetsimpp_tpu.parallel import replicate_state
+    from fognetsimpp_tpu.parallel.replicas import run_replicated
+    from fognetsimpp_tpu.telemetry.timeline import build_trace
+
+    spec, state, net, bounds = _build(telemetry=True, horizon=0.2)
+    batch = replicate_state(spec, state, 2, seed=1)
+    final = run_replicated(spec, batch, net, bounds)
+    ev = build_trace(spec, final)["traceEvents"]
+    assert {e["pid"] for e in ev} == {0, 1}
+
+
+# ----------------------------------------------------------------------
+# Plane 3: OpenMetrics exposition
+# ----------------------------------------------------------------------
+
+def test_openmetrics_busy_fraction_matches_sca_json(tmp_path):
+    import re
+
+    from fognetsimpp_tpu.runtime.recorder import load_scalars, record_run
+
+    spec, state, net, bounds = _build(telemetry=True, horizon=1.0)
+    final, _ = run(spec, state, net, bounds)
+    paths = record_run(str(tmp_path), spec, final, scave=False)
+    sca = load_scalars(paths["sca"])
+    text = open(paths["om"]).read()
+    for f in range(spec.n_fogs):
+        m = re.search(
+            rf'^fns_fog_busy_fraction\{{fog="{f}"\}} (\S+)$',
+            text, re.M,
+        )
+        assert m, f"fog {f} busy fraction missing from OpenMetrics"
+        om_val = float(m.group(1))
+        sca_val = sca["modules"]["fog"][f]["busy_frac"]
+        assert abs(om_val - sca_val) <= 1e-6
+    # format lint: the ~20-line checker the CI smoke step runs
+    from tools.check_openmetrics import check
+
+    assert check(paths["om"]) == 0
+
+
+def test_openmetrics_text_is_wellformed_without_telemetry(tmp_path):
+    from fognetsimpp_tpu.runtime.recorder import record_run
+    from tools.check_openmetrics import check
+
+    spec, state, net, bounds = _build()
+    final, _ = run(spec, state, net, bounds)
+    paths = record_run(str(tmp_path), spec, final, scave=False)
+    text = open(paths["om"]).read()
+    assert text.endswith("# EOF\n")
+    assert "fns_fog_busy_fraction" not in text  # plane 1 was off
+    assert check(paths["om"]) == 0
+
+
+def test_fleet_openmetrics_written(tmp_path):
+    from fognetsimpp_tpu.parallel import make_mesh, replicate_state
+    from fognetsimpp_tpu.parallel.fleet import run_fleet
+    from fognetsimpp_tpu.runtime.recorder import record_fleet_run
+    from tools.check_openmetrics import check
+
+    spec, state, net, bounds = _build(telemetry=True, horizon=0.2)
+    batch = replicate_state(spec, state, 8, seed=0)
+    final = run_fleet(spec, batch, net, bounds, make_mesh(8))
+    paths = record_fleet_run(str(tmp_path), spec, final)
+    text = open(paths["om"]).read()
+    assert "fns_fleet_fog_busy_fraction" in text
+    assert check(paths["om"]) == 0
+
+
+def test_cli_telemetry_flags(tmp_path, capsys):
+    """--telemetry --trace-out end to end through the launcher."""
+    from fognetsimpp_tpu.__main__ import main
+
+    trace = str(tmp_path / "t.json")
+    rc = main([
+        "--scenario", "smoke", "--telemetry",
+        "--set", "spec.horizon=0.3",
+        "--trace-out", trace, "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["trace"] == trace
+    d = json.loads(open(trace).read(), parse_constant=_no_nonfinite)
+    assert d["traceEvents"]
+    sca = json.load(open(out["sca"]))
+    assert "busy_frac" in sca["modules"]["fog"][0]
+
+
+def test_profile_helpers_are_safe():
+    """profile_trace degrades to a no-op on failure; the dispatch
+    histogram measures a warm jitted round trip."""
+    from fognetsimpp_tpu.telemetry.profile import (
+        measure_dispatch,
+        profile_trace,
+    )
+
+    with profile_trace(None) as info:
+        assert info["active"] is False
+    f = jax.jit(lambda x: x + 1)
+    hist = measure_dispatch(lambda: int(np.asarray(f(0))), n=4)
+    assert hist["n"] == 4
+    assert hist["p50_ms"] >= 0
+    assert sum(hist["buckets"].values()) == 4

@@ -1,0 +1,34 @@
+"""OpenMetrics text-format lint (~20 lines): `python tools/check_openmetrics.py FILE`.
+
+Checks the subset the telemetry exposition emits: every line is either a
+`# TYPE <name> <kind>` / `# EOF` comment or a `<name>[{labels}] <value>`
+sample with a finite decimal value, and the file ends with `# EOF`.
+"""
+import math
+import re
+import sys
+
+SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*")*\})? -?[0-9][0-9.eE+-]*$'
+)
+TYPE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* [a-z]+$")
+
+
+def check(path: str) -> int:
+    lines = open(path).read().splitlines()
+    for i, ln in enumerate(lines, 1):
+        if ln == "# EOF" or TYPE.match(ln):
+            continue
+        m = SAMPLE.match(ln)
+        if not m or not math.isfinite(float(ln.rsplit(" ", 1)[1])):
+            print(f"{path}:{i}: bad OpenMetrics line: {ln!r}")
+            return 1
+    if not lines or lines[-1] != "# EOF":
+        print(f"{path}: missing trailing '# EOF'")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(max(check(p) for p in sys.argv[1:]) if sys.argv[1:] else 2)

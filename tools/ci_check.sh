@@ -20,6 +20,15 @@ cd "$(dirname "$0")/.."
 echo "== simlint =="
 python -m tools.simlint fognetsimpp_tpu
 
+echo "== telemetry smoke (trace export + OpenMetrics lint) =="
+TELEM_OUT="$(mktemp -d)"
+JAX_PLATFORMS=cpu python -m fognetsimpp_tpu --scenario smoke \
+    --set spec.horizon=0.5 --telemetry \
+    --trace-out "${TELEM_OUT}/trace.json" --out "${TELEM_OUT}" > /dev/null
+python -c "import json, sys; json.load(open(sys.argv[1]))" "${TELEM_OUT}/trace.json"
+python tools/check_openmetrics.py "${TELEM_OUT}"/General-0.om.txt
+rm -rf "${TELEM_OUT}"
+
 MARKER="quick"
 if [[ "${1:-}" == "--full" ]]; then
     MARKER="not slow or slow"

@@ -25,3 +25,13 @@ def sweep(batch, weights: jax.Array):
     def apply(x):
         return x * lut                  # R3b: derived-array capture
     return apply(batch)
+
+
+def make_accumulator(net: jax.Array, bounds: jax.Array):
+    # the telemetry metrics-accumulation shape: a per-tick helper that
+    # closes over the world's net/bounds arrays instead of taking them
+    # as arguments — baked into the jaxpr, retraced per world
+    @jax.jit
+    def accumulate(telem, q_len):
+        return telem + q_len * net[0] + bounds[0]   # R3b: net capture
+    return accumulate

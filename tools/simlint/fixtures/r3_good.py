@@ -15,3 +15,10 @@ def make_runner(table: jax.Array):
     def inner(x, tab):
         return x + tab                  # array passed as an argument
     return functools.partial(inner, tab=table)
+
+
+@jax.jit
+def accumulate(telem, q_len, net: jax.Array, bounds: jax.Array):
+    # the telemetry metrics-accumulation discipline: net/bounds ride
+    # through the jit boundary as arguments, never by closure
+    return telem + q_len * net[0] + bounds[0]
