@@ -75,9 +75,14 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
     policy = policy_from_name(os.environ.get("BENCH_POLICY", "min_busy"))
 
     telemetry = os.environ.get("BENCH_TELEMETRY", "") not in ("", "0")
+    # BENCH_FUSED=0 forces the unfused per-phase reference engine — the
+    # A/B knob for the r6 fused slot-window front-end (interleave 0/1
+    # runs for the off/on comparison, the BENCH_TELEMETRY methodology)
+    fused = os.environ.get("BENCH_FUSED", "1") not in ("0",)
     mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
     build_kw = dict(
         telemetry=telemetry,
+        fused_slots=fused,
         n_users=n_users,
         n_fogs=n_fogs,
         fog_mips=tuple(float(m) for m in (1000, 2000, 3000, 4000)),
@@ -114,6 +119,7 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
     knobs = dict(
         n_users=n_users, n_fogs=n_fogs, horizon=horizon,
         interval=interval, dt=dt, policy=policy, telemetry=telemetry,
+        fused=fused,
     )
     return spec, state, net, bounds, knobs
 

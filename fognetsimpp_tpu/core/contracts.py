@@ -97,6 +97,22 @@ def _contracts() -> Tuple[PhaseContract, ...]:
     from . import engine as E
 
     fifo = lambda sp: sp.n_fogs > 0 and sp.fog_model == int(FogModel.FIFO)
+
+    def fused_call(phase, with_t0):
+        """Contract-trace a phase in fused (register-view) mode: build
+        the view pack, run the phase, flush the write set — so the whole
+        deferred-scatter dataflow is covered by the eval_shape trace,
+        not just the classic per-phase path."""
+
+        def call(sp, s, n, c, b, t0, t1):
+            v = E._task_views(sp, s.tasks)
+            args = (sp, s, n, c, b) + ((t0, t1) if with_t0 else (t1,))
+            s2, b2, v2 = phase(*args, views=v)
+            s2 = s2.replace(tasks=E._flush_task_views(sp, s2.tasks, v2))
+            return s2, b2
+
+        return call
+
     return (
         PhaseContract(
             "_phase_connect",
@@ -187,6 +203,35 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             lambda sp, s, n, c, b, t0, t1: E._phase_local_completions(
                 sp, s, n, c, b, t1
             ),
+        ),
+        # ---- fused per-user slot-window front-end (spec.fused_slots) --
+        # The same phase functions, traced in register-view mode with
+        # the write-set flush included: the tick's fused dataflow is
+        # contract-covered end to end (tests/test_contracts.py).
+        PhaseContract(
+            "_phase_spawn",
+            fused_call(E._phase_spawn, with_t0=True),
+            when=lambda sp: E._fused_ok(sp) and sp.max_sends_per_tick == 1,
+        ),
+        PhaseContract(
+            "_phase_spawn_multi",
+            fused_call(E._phase_spawn_multi, with_t0=True),
+            when=lambda sp: E._fused_ok(sp) and sp.max_sends_per_tick > 1,
+        ),
+        PhaseContract(
+            "_phase_broker_dense",
+            fused_call(E._phase_broker_dense, with_t0=False),
+            when=E._fused_ok,
+        ),
+        PhaseContract(
+            "_phase_completions",
+            fused_call(E._phase_completions, with_t0=False),
+            when=E._fused_ok,
+        ),
+        PhaseContract(
+            "_phase_fog_arrivals",
+            fused_call(E._phase_fog_arrivals, with_t0=False),
+            when=E._fused_ok,
         ),
         PhaseContract(
             "_phase_periodic_adverts",

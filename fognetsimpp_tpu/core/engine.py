@@ -48,7 +48,14 @@ from ..learn.rewards import credit_batch
 from ..net.mobility import MobilityBounds, step_mobility
 from ..net.energy import step_energy
 from ..net.topology import LinkCache, NetParams, associate
-from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
+from ..ops.queues import (
+    NO_TASK,
+    batched_enqueue,
+    batched_pop,
+    enqueue_scatter,
+    plan_arrivals,
+    row_lexmin,
+)
 from ..ops.sched import scalar_winner, schedule_batch, task_uniform
 from ..spec import STATIC_MAC_ERR, FogModel, Policy, Stage, WorldSpec
 from ..state import WorldState
@@ -279,7 +286,8 @@ def offered_rate_vector(spec: WorldSpec, alive_u, users, t0) -> jax.Array:
 def _phase_connect(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
+    views: Optional[dict] = None,
+):
     """MQTT connect handshake: Connect → broker registration → Connack.
 
     Users: ``processStart`` sends MqttMsgConnect at the app start time
@@ -320,31 +328,56 @@ def _phase_connect(
     acked_subs = jnp.where(acked, n_subs, 0)
     up_msgs = pending.astype(jnp.int32) + acked_subs
     down_msgs = acked.astype(jnp.int32) * (1 + n_subs)
-    # one stacked reduction for all the scalar sums of this phase
-    sums = jnp.sum(
-        jnp.stack(
-            [down_msgs, up_msgs, acked.astype(jnp.int32), acked_subs]
-        ),
-        axis=1,
-    )
     buf = buf._replace(
         tx_u=buf.tx_u + up_msgs,
         rx_u=buf.rx_u + down_msgs,
-        tx_b=buf.tx_b + sums[0],
-        rx_b=buf.rx_b + sums[1],
     )
-    metrics = state.metrics.replace(
-        n_connected=state.metrics.n_connected + sums[2],
-        n_subscribed=state.metrics.n_subscribed + sums[3],
+    metrics = state.metrics
+    defer_counts = views is not None and views.get(
+        "defer_host_counts", False
     )
-    return state.replace(users=users, broker=b, metrics=metrics), buf
+    if defer_counts:
+        # fused telemetry-off tick: the four scalar sums join the
+        # flush's one merged U-wide reduction (exact integer rows)
+        views["def_u"] = list(views.get("def_u", ()))
+        views["def_u"] += [
+            (down_msgs, (("tx_b", 1),)),
+            (up_msgs, (("rx_b", 1),)),
+            (acked.astype(jnp.int32), (("n_connected", 1),)),
+            (acked_subs, (("n_subscribed", 1),)),
+        ]
+    else:
+        # one stacked reduction for all the scalar sums of this phase
+        sums = jnp.sum(
+            jnp.stack(
+                [down_msgs, up_msgs, acked.astype(jnp.int32), acked_subs]
+            ),
+            axis=1,
+        )
+        buf = buf._replace(
+            tx_b=buf.tx_b + sums[0],
+            rx_b=buf.rx_b + sums[1],
+        )
+        metrics = metrics.replace(
+            n_connected=metrics.n_connected + sums[2],
+            n_subscribed=metrics.n_subscribed + sums[3],
+        )
+    state = state.replace(users=users, broker=b, metrics=metrics)
+    if views is not None:
+        return state, buf, views
+    return state, buf
 
 
-def _phase_adverts(state: WorldState, t1: jax.Array) -> WorldState:
+def _phase_adverts(
+    state: WorldState, t1: jax.Array,
+    buf: Optional[TickBuf] = None, views: Optional[dict] = None,
+):
     """Deliver in-flight MIPS advertisements whose arrival time has passed.
 
     Mirrors the broker's AdvertiseMIPS branch updating ``brokers[j]``
-    (``BrokerBaseApp3.cc:123-136``) — latest-wins overwrite.
+    (``BrokerBaseApp3.cc:123-136``) — latest-wins overwrite.  In fused
+    telemetry-off mode (``views`` + ``buf`` passed) the advert counter
+    joins the flush's merged F-wide reduction.
     """
     b = state.broker
     arrived = b.adv_arrive_t <= t1
@@ -353,17 +386,29 @@ def _phase_adverts(state: WorldState, t1: jax.Array) -> WorldState:
         view_busy=jnp.where(arrived, b.adv_val_busy, b.view_busy),
         adv_arrive_t=jnp.where(arrived, jnp.inf, b.adv_arrive_t),
     )
-    metrics = state.metrics.replace(
-        n_adverts=state.metrics.n_adverts
-        + jnp.sum(arrived.astype(jnp.int32))
+    metrics = state.metrics
+    defer_counts = views is not None and views.get(
+        "defer_host_counts", False
     )
-    return state.replace(broker=broker, metrics=metrics)
+    if defer_counts:
+        views = dict(views)
+        views["def_f"] = list(views.get("def_f", ()))
+        views["def_f"].append((arrived, (("n_adverts", 1),)))
+    else:
+        metrics = metrics.replace(
+            n_adverts=metrics.n_adverts + jnp.sum(arrived.astype(jnp.int32))
+        )
+    state = state.replace(broker=broker, metrics=metrics)
+    if views is not None:
+        return state, buf, views
+    return state
 
 
 def _phase_spawn(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
+    views: Optional[dict] = None,
+):
     """Users whose send timer fired publish one task (mqttApp2.cc:353-409).
 
     Task slot ``u * max_sends + send_count[u]`` is claimed; MIPSRequired ~
@@ -492,17 +537,33 @@ def _phase_spawn(
         jnp.arange(S, dtype=jnp.int32)[None, :] == users.send_count[:, None]
     )
 
-    def put(col, val_u):
-        return jnp.where(sel, val_u[:, None], col.reshape(U, S)).reshape(T)
+    if views is not None:
+        # fused front-end: same selects, written into the threaded
+        # (U, S) register views instead of the task table
+        views = dict(views)
 
-    tasks = tasks.replace(
-        stage=put(tasks.stage, stage_new),
-        mips_req=put(tasks.mips_req, mips_req),
-        t_create=put(tasks.t_create, t_create),
-        t_at_broker=put(
-            tasks.t_at_broker, jnp.where(lost, jnp.inf, t_arrive)
-        ),
-    )
+        def put2(col2, val_u):
+            return jnp.where(sel, val_u[:, None], col2)
+
+        views["stage2"] = put2(views["stage2"], stage_new)
+        views["mips2"] = put2(views["mips2"], mips_req)
+        views["t_create2"] = put2(views["t_create2"], t_create)
+        views["t_at_broker2"] = put2(
+            views["t_at_broker2"], jnp.where(lost, jnp.inf, t_arrive)
+        )
+    else:
+
+        def put(col, val_u):
+            return jnp.where(sel, val_u[:, None], col.reshape(U, S)).reshape(T)
+
+        tasks = tasks.replace(
+            stage=put(tasks.stage, stage_new),
+            mips_req=put(tasks.mips_req, mips_req),
+            t_create=put(tasks.t_create, t_create),
+            t_at_broker=put(
+                tasks.t_at_broker, jnp.where(lost, jnp.inf, t_arrive)
+            ),
+        )
     interval = users.send_interval
     if spec.send_interval_jitter > 0:
         interval = interval * jax.random.uniform(
@@ -513,22 +574,39 @@ def _phase_spawn(
         next_send=jnp.where(due, t_create + interval, users.next_send),
         send_count=jnp.where(due, users.send_count + 1, users.send_count),
     )
-    sums = jnp.sum(
-        jnp.stack([due.astype(jnp.int32), (due & lost).astype(jnp.int32)]),
-        axis=1,
+    metrics = state.metrics
+    defer_counts = views is not None and views.get(
+        "defer_host_counts", False
     )
-    metrics = state.metrics.replace(
-        n_published=state.metrics.n_published + sums[0],
-        n_lost=state.metrics.n_lost + sums[1],
-    )
+    if defer_counts:
+        views["def_u"] = list(views.get("def_u", ()))
+        views["def_u"] += [
+            (due.astype(jnp.int32), (("n_published", 1),)),
+            ((due & lost).astype(jnp.int32), (("n_lost", 1),)),
+        ]
+    else:
+        sums = jnp.sum(
+            jnp.stack(
+                [due.astype(jnp.int32), (due & lost).astype(jnp.int32)]
+            ),
+            axis=1,
+        )
+        metrics = metrics.replace(
+            n_published=metrics.n_published + sums[0],
+            n_lost=metrics.n_lost + sums[1],
+        )
     buf = buf._replace(tx_u=buf.tx_u + due.astype(jnp.int32))
-    return state.replace(users=users, tasks=tasks, metrics=metrics, key=key), buf
+    state = state.replace(users=users, tasks=tasks, metrics=metrics, key=key)
+    if views is not None:
+        return state, buf, views
+    return state, buf
 
 
 def _phase_spawn_multi(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
+    views: Optional[dict] = None,
+):
     """Closed-form multi-send spawn: up to ``spec.max_sends_per_tick``
     publishes per user per tick, each with its exact event time.
 
@@ -643,25 +721,42 @@ def _phase_spawn_multi(
     if warm_lost2 is not None:
         lost2 = lost2 | (warm_lost2 & net.is_wireless[:U, None])
 
-    st2 = tasks.stage.reshape(U, S)
     stage_new = jnp.where(
         lost2, _ST_LOST, _ST_PUB_INFLIGHT
     )
-    tasks = tasks.replace(
-        stage=jnp.where(due2, stage_new, st2).reshape(T),
-        mips_req=jnp.where(
-            due2, mips2, tasks.mips_req.reshape(U, S)
-        ).reshape(T),
-        t_create=jnp.where(
-            due2, fire, tasks.t_create.reshape(U, S)
-        ).reshape(T),
-        t_at_broker=jnp.where(
-            due2,
-            jnp.where(lost2, jnp.inf, t_arrive),
-            tasks.t_at_broker.reshape(U, S),
-        ).reshape(T),
-    )
-    n_fired = jnp.sum(due2, axis=1, dtype=i32)  # (U,)
+    if views is not None:
+        views = dict(views)
+        views["stage2"] = jnp.where(due2, stage_new, views["stage2"])
+        views["mips2"] = jnp.where(due2, mips2, views["mips2"])
+        views["t_create2"] = jnp.where(due2, fire, views["t_create2"])
+        views["t_at_broker2"] = jnp.where(
+            due2, jnp.where(lost2, jnp.inf, t_arrive),
+            views["t_at_broker2"],
+        )
+    else:
+        st2 = tasks.stage.reshape(U, S)
+        tasks = tasks.replace(
+            stage=jnp.where(due2, stage_new, st2).reshape(T),
+            mips_req=jnp.where(
+                due2, mips2, tasks.mips_req.reshape(U, S)
+            ).reshape(T),
+            t_create=jnp.where(
+                due2, fire, tasks.t_create.reshape(U, S)
+            ).reshape(T),
+            t_at_broker=jnp.where(
+                due2,
+                jnp.where(lost2, jnp.inf, t_arrive),
+                tasks.t_at_broker.reshape(U, S),
+            ).reshape(T),
+        )
+    if views is not None:
+        # one stacked (2, U, S) reduce for the fired/lost per-user
+        # counts (exact integers, same values as the standalone forms)
+        nl = jnp.sum(jnp.stack([due2, due2 & lost2]).astype(i32), axis=2)
+        n_fired, lost_u = nl[0], nl[1]
+    else:
+        n_fired = jnp.sum(due2, axis=1, dtype=i32)  # (U,)
+        lost_u = None
     users = users.replace(
         next_send=jnp.where(
             n_fired > 0,
@@ -670,18 +765,29 @@ def _phase_spawn_multi(
         ),
         send_count=users.send_count + n_fired,
     )
-    sums = jnp.sum(
-        jnp.stack(
-            [n_fired, jnp.sum(due2 & lost2, axis=1, dtype=i32)]
-        ),
-        axis=1,
+    metrics = state.metrics
+    defer_counts = views is not None and views.get(
+        "defer_host_counts", False
     )
-    metrics = state.metrics.replace(
-        n_published=state.metrics.n_published + sums[0],
-        n_lost=state.metrics.n_lost + sums[1],
-    )
+    if defer_counts:
+        views["def_u"] = list(views.get("def_u", ()))
+        views["def_u"] += [
+            (n_fired, (("n_published", 1),)),
+            (lost_u, (("n_lost", 1),)),
+        ]
+    else:
+        if lost_u is None:
+            lost_u = jnp.sum(due2 & lost2, axis=1, dtype=i32)
+        sums = jnp.sum(jnp.stack([n_fired, lost_u]), axis=1)
+        metrics = metrics.replace(
+            n_published=metrics.n_published + sums[0],
+            n_lost=metrics.n_lost + sums[1],
+        )
     buf = buf._replace(tx_u=buf.tx_u + n_fired)
-    return state.replace(users=users, tasks=tasks, metrics=metrics, key=key), buf
+    state = state.replace(users=users, tasks=tasks, metrics=metrics, key=key)
+    if views is not None:
+        return state, buf, views
+    return state, buf
 
 
 def _phase_v2_release(
@@ -812,10 +918,170 @@ def _broker_dense_ok(spec: WorldSpec) -> bool:
     ) and spec.bug_compat.mips0_divisor
 
 
+def _fused_ok(spec: WorldSpec) -> bool:
+    """Static gate for the fused per-user slot-window front-end (r6).
+
+    ``spec.fused_slots`` threads the hot task-table columns through
+    spawn -> broker -> completions -> fog-arrivals as ``(U, S)``
+    register views plus a shared deferred-scatter write set
+    (:func:`_task_views` / :func:`_flush_task_views`), flushed ONCE per
+    tick.  It applies exactly where every participating phase is already
+    elementwise over the per-user view: the dense-broker policy family
+    (:func:`_broker_dense_ok`) on FIFO fogs with the two-stage arrival
+    front-end.  The sequential-pool policies (LOCAL_FIRST / v2 broker),
+    the POOL fog model and the learned policies keep the classic
+    per-phase path — their broker is compacted, not dense, so there is
+    no (U, S) pipeline to fuse.
+    """
+    return (
+        spec.fused_slots
+        and spec.n_fogs > 0
+        and spec.fog_model == int(FogModel.FIFO)
+        and spec.two_stage_arrivals
+        and _broker_dense_ok(spec)
+        and not spec.learn_active
+        and spec.policy != int(Policy.LOCAL_FIRST)
+        and _fused_mips_exact(spec)
+    )
+
+
+def _fused_mips_exact(spec: WorldSpec) -> bool:
+    """Whether the tail's per-fog busy-MIPS sum is guaranteed an exact
+    f32 integer under the fused path.
+
+    The fused tail folds that sum into one merged (C, W) row reduction;
+    exact-integer rows make the merge provably bit-identical to the
+    unfused standalone reduce on EVERY backend (beyond 2^24 a different
+    reduction tiling could round differently).  Bound: at most
+    ``min(window, U*R)`` candidates can land on one fog in a tick, each
+    contributing at most ``mips_required_max``.  Specs beyond the bound
+    (e.g. a 1M-user auto-window world with 900-MIPS tasks) simply keep
+    the unfused reference path.
+    """
+    mips_max = (
+        spec.fixed_mips_required
+        if spec.fixed_mips_required is not None
+        else spec.mips_required_max
+    )
+    R = min(spec.arrival_cands, spec.max_sends_per_user)
+    width = min(spec.window, spec.n_users * R)
+    return width * max(int(mips_max), 1) < 2 ** 24
+
+
+def _fused_skip_compact(spec: WorldSpec) -> bool:
+    """Whether the fused arrival front-end may skip the K-window
+    compaction and run the shared tail directly on the ``(U*R,)``
+    candidate list.
+
+    Legal only when the window can never overflow (``K >= T`` — the
+    regime where :func:`_rot_and_defer` returns ``rot=None``, so the
+    packed window order is plain ascending candidate order and the
+    candidate list preserves every relative-order tie-break).  The
+    exact-integer busy-MIPS bound that makes the tail's reduction
+    independent of the reduction shape is already part of
+    :func:`_fused_ok` (via :func:`_fused_mips_exact`: with K >= T the
+    bound width IS ``U*R``), so only the window condition lives here.
+    """
+    return spec.window >= spec.task_capacity
+
+
+def _task_views(spec: WorldSpec, tasks) -> dict:
+    """Build the fused front-end's register-view pack from the task table.
+
+    ``(U, S)`` views of the columns the fused phases read AND write
+    elementwise, plus ``scat`` — the shared deferred-scatter write set
+    (column name -> list of ``(idx, vals)`` T-space contributions, all
+    pairwise disjoint by construction) — and ``pending_promote``, the
+    one completions RUNNING entry a later completion pass may still
+    retire (see :func:`_phase_completions`).  :func:`_flush_task_views`
+    folds the whole pack back with one write per column.
+    """
+    U, S = spec.n_users, spec.max_sends_per_user
+    v = {
+        "stage2": tasks.stage.reshape(U, S),
+        "fog2": tasks.fog.reshape(U, S),
+        "mips2": tasks.mips_req.reshape(U, S),
+        "t_create2": tasks.t_create.reshape(U, S),
+        "t_at_broker2": tasks.t_at_broker.reshape(U, S),
+        "t_at_fog2": tasks.t_at_fog.reshape(U, S),
+        "t_q_enter2": tasks.t_q_enter.reshape(U, S),
+        "scat": {},
+        "pending_promote": None,
+        # deferred host-facing counters (telemetry-off ticks only; with
+        # telemetry on they stay eager so the per-phase work brackets
+        # book identically to the unfused pipeline).  def_u / def_f are
+        # (row, ((target, scale), ...)) entries whose row sums ride ONE
+        # merged flush reduction per width (U-wide and F-wide); targets
+        # name Metrics fields or the scalar TickBuf counters.
+        "defer_host_counts": False,
+        "rx_u": [],
+        "def_u": [],
+        "def_f": [],
+    }
+    if not spec.derive_acks:
+        v["t_ack4_fwd2"] = tasks.t_ack4_fwd.reshape(U, S)
+        v["t_ack4_queued2"] = tasks.t_ack4_queued.reshape(U, S)
+    return v
+
+
+def _defer_scatter(v: dict, col: str, idx: jax.Array, vals: jax.Array) -> None:
+    """Append one deferred task-table scatter to the shared write set.
+
+    Contributors guarantee their index sets are disjoint from every
+    earlier entry on the same column (sentinel ``T`` rows aside), so the
+    flush may concatenate them into ONE ``.at[idx].set`` per column.
+    """
+    v["scat"].setdefault(col, []).append((idx, vals))
+
+
+def _flush_task_views(spec: WorldSpec, tasks, v: dict):
+    """Fold the fused front-end's write set back into the task table.
+
+    One dense column write per threaded view plus one concatenated
+    scatter per deferred column — the per-phase scatter chains of the
+    unfused path collapse to a single ``.at[idx].set`` each (the r5
+    "scatter merge" extended across phase boundaries).  Bit-exact: the
+    dense views carry exactly the per-phase select results, and every
+    scatter group is pairwise disjoint, so flush order cannot differ
+    from the sequential per-phase writes.
+    """
+    T = spec.task_capacity
+    rep = dict(
+        stage=v["stage2"].reshape(T),
+        fog=v["fog2"].reshape(T),
+        mips_req=v["mips2"].reshape(T),
+        t_create=v["t_create2"].reshape(T),
+        t_at_broker=v["t_at_broker2"].reshape(T),
+        t_at_fog=v["t_at_fog2"].reshape(T),
+    )
+    rep["t_q_enter"] = v["t_q_enter2"].reshape(T)
+    ack4 = v.get("t_ack4_fwd2")
+    if ack4 is not None:
+        rep["t_ack4_fwd"] = ack4.reshape(T)
+        rep["t_ack4_queued"] = v["t_ack4_queued2"].reshape(T)
+    tasks = tasks.replace(**rep)
+    scat = dict(v["scat"])
+    if v["pending_promote"] is not None:
+        p_idx = v["pending_promote"]
+        scat.setdefault("stage", []).append(
+            (p_idx, jnp.full(p_idx.shape, _ST_RUNNING))
+        )
+    for col, entries in scat.items():
+        if len(entries) == 1:
+            idxs, vals = entries[0]
+        else:
+            idxs = jnp.concatenate([e[0] for e in entries])
+            vals = jnp.concatenate([e[1] for e in entries])
+        tasks = tasks.replace(
+            **{col: getattr(tasks, col).at[idxs].set(vals, mode="drop")}
+        )
+    return tasks
+
+
 def _phase_broker_dense(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    buf: TickBuf, t1: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
+    buf: TickBuf, t1: jax.Array, views: Optional[dict] = None,
+):
     """Elementwise broker phase over the ``(U, S)`` task-table view.
 
     Semantics identical to :func:`_phase_broker` (same formulas, same
@@ -826,36 +1092,49 @@ def _phase_broker_dense(
     ms/tick at the 10k-user bench shape; this runs at HBM bandwidth).
     Unlike the compacted path there is no K-window: every matured publish
     decides this tick (strictly closer to the event-driven execution).
+
+    ``views`` (the fused front-end, :func:`_fused_ok`): read the (U, S)
+    columns from the threaded register pack instead of the task table
+    and write the decisions back into it — identical arithmetic, zero
+    task-table materialisation until the per-tick flush.
     """
     tasks, b = state.tasks, state.broker
     U, S, F = spec.n_users, spec.max_sends_per_user, spec.n_fogs
     T = spec.task_capacity
     i32 = jnp.int32
-    st2 = tasks.stage.reshape(U, S)
-    tab2 = tasks.t_at_broker.reshape(U, S)
+    if views is not None:
+        st2 = views["stage2"]
+        tab2 = views["t_at_broker2"]
+    else:
+        st2 = tasks.stage.reshape(U, S)
+        tab2 = tasks.t_at_broker.reshape(U, S)
     mask2 = (st2 == _ST_PUB_INFLIGHT) & (tab2 <= t1)
-    cnt_u = jnp.sum(mask2, axis=1, dtype=i32)  # (U,) decided per user
 
     metrics = state.metrics
     users = state.users
     n_del = jnp.zeros((), i32)
-    if spec.fanout_enabled:
-        per_topic = jnp.sum(
-            jnp.where(
-                users.pub_topic[None, :]
-                == jnp.arange(spec.n_topics, dtype=i32)[:, None],
-                cnt_u[None, :].astype(jnp.float32),
-                0.0,
-            ),
-            axis=1,
-        )
-        deliveries = (users.sub_mask.astype(jnp.float32) @ per_topic).astype(
-            i32
-        )
-        n_del = jnp.sum(deliveries)
-        users = users.replace(n_delivered=users.n_delivered + deliveries)
-        metrics = metrics.replace(n_fanout=metrics.n_fanout + n_del)
-        buf = buf._replace(rx_u=buf.rx_u + deliveries)
+    if views is None:
+        cnt_u = jnp.sum(mask2, axis=1, dtype=i32)  # (U,) decided per user
+        if spec.fanout_enabled:
+            per_topic = jnp.sum(
+                jnp.where(
+                    users.pub_topic[None, :]
+                    == jnp.arange(spec.n_topics, dtype=i32)[:, None],
+                    cnt_u[None, :].astype(jnp.float32),
+                    0.0,
+                ),
+                axis=1,
+            )
+            deliveries = (
+                users.sub_mask.astype(jnp.float32) @ per_topic
+            ).astype(i32)
+            n_del = jnp.sum(deliveries)
+            users = users.replace(n_delivered=users.n_delivered + deliveries)
+            metrics = metrics.replace(n_fanout=metrics.n_fanout + n_del)
+            buf = buf._replace(rx_u=buf.rx_u + deliveries)
+    # fused mode: cnt_u / the fan-out topic sums / the decision counters
+    # all come from ONE two-stage merged reduction after the partition
+    # (below) — same integers, three fewer standalone reduces
 
     # key split kept for PRNG-stream alignment with the compacted path
     key, _ = jax.random.split(state.key)
@@ -875,7 +1154,11 @@ def _phase_broker_dense(
     choice_ok = choice_s >= 0
     if spec.policy == int(Policy.MAX_MIPS) and F > 0:
         win_mips = b.view_mips[jnp.clip(choice_s, 0, F - 1)]
-        guard2 = mask2 & choice_ok & ~(tasks.mips_req.reshape(U, S) < win_mips)
+        mips2 = (
+            views["mips2"] if views is not None
+            else tasks.mips_req.reshape(U, S)
+        )
+        guard2 = mask2 & choice_ok & ~(mips2 < win_mips)
     else:
         guard2 = jnp.zeros((U, S), bool)
 
@@ -894,25 +1177,80 @@ def _phase_broker_dense(
     )
     d_bf_c = cache.d2b[U + jnp.clip(choice_s, 0, F - 1)] if F > 0 else 0.0
     d_bu = cache.d2b[:U]
-    tasks = tasks.replace(
-        stage=jnp.where(mask2, new_stage2, st2).reshape(T),
-        fog=jnp.where(
-            sched2, choice_s, tasks.fog.reshape(U, S)
-        ).reshape(T),
-        t_at_fog=jnp.where(
-            sched2, tab2 + d_bf_c, tasks.t_at_fog.reshape(U, S)
-        ).reshape(T),
-    )
-    if not spec.derive_acks:  # else reconstructed post-run (run())
+    if views is not None:
+        views = dict(views)
+        views["stage2"] = jnp.where(mask2, new_stage2, st2)
+        views["fog2"] = jnp.where(sched2, choice_s, views["fog2"])
+        views["t_at_fog2"] = jnp.where(
+            sched2, tab2 + d_bf_c, views["t_at_fog2"]
+        )
+        if not spec.derive_acks:
+            views["t_ack4_fwd2"] = jnp.where(
+                mask2, tab2 + d_bu[:, None], views["t_ack4_fwd2"]
+            )
+    else:
         tasks = tasks.replace(
-            t_ack4_fwd=jnp.where(
-                mask2, tab2 + d_bu[:, None], tasks.t_ack4_fwd.reshape(U, S)
+            stage=jnp.where(mask2, new_stage2, st2).reshape(T),
+            fog=jnp.where(
+                sched2, choice_s, tasks.fog.reshape(U, S)
+            ).reshape(T),
+            t_at_fog=jnp.where(
+                sched2, tab2 + d_bf_c, tasks.t_at_fog.reshape(U, S)
             ).reshape(T),
         )
-    sums = jnp.sum(
-        jnp.stack([sched2, no_res2, rejected2, mask2]).astype(i32),
-        axis=(1, 2),
-    )
+        if not spec.derive_acks:  # else reconstructed post-run (run())
+            tasks = tasks.replace(
+                t_ack4_fwd=jnp.where(
+                    mask2, tab2 + d_bu[:, None],
+                    tasks.t_ack4_fwd.reshape(U, S),
+                ).reshape(T),
+            )
+    if views is not None:
+        # two-stage merged reduction: per-user partials over the send
+        # axis feed both the scalar decision counters and the fan-out
+        # topic sums (all exact f32 integers -> bit-identical to the
+        # unfused standalone reduces)
+        part = jnp.sum(
+            jnp.stack([sched2, no_res2, rejected2, mask2]).astype(i32),
+            axis=2,
+        )  # (4, U)
+        cnt_u = part[3]
+        if spec.fanout_enabled:
+            f32 = jnp.float32
+            topicrows = jnp.where(
+                users.pub_topic[None, :]
+                == jnp.arange(spec.n_topics, dtype=i32)[:, None],
+                cnt_u[None, :].astype(f32),
+                0.0,
+            )
+            merged = jnp.sum(
+                jnp.concatenate([part.astype(f32), topicrows]), axis=1
+            )
+            sums = merged[:4].astype(i32)
+            per_topic = merged[4:]
+            deliveries = (
+                users.sub_mask.astype(f32) @ per_topic
+            ).astype(i32)
+            users = users.replace(n_delivered=users.n_delivered + deliveries)
+            buf = buf._replace(rx_u=buf.rx_u + deliveries)
+            defer_fanout = views.get("defer_host_counts", False)
+            if defer_fanout:
+                # the fan-out total joins the flush's merged reduction
+                views["def_u"] = list(views.get("def_u", ()))
+                views["def_u"].append(
+                    (deliveries, (("n_fanout", 1), ("tx_b", 1)))
+                )
+                n_del = jnp.zeros((), i32)  # tx_b add lands at flush
+            else:
+                n_del = jnp.sum(deliveries)
+                metrics = metrics.replace(n_fanout=metrics.n_fanout + n_del)
+        else:
+            sums = jnp.sum(part, axis=1)
+    else:
+        sums = jnp.sum(
+            jnp.stack([sched2, no_res2, rejected2, mask2]).astype(i32),
+            axis=(1, 2),
+        )
     metrics = metrics.replace(
         n_scheduled=metrics.n_scheduled + sums[0],
         n_no_resource=metrics.n_no_resource + sums[1],
@@ -923,10 +1261,10 @@ def _phase_broker_dense(
         rx_b=buf.rx_b + sums[3],
         rx_u=buf.rx_u + cnt_u,
     )
-    return (
-        state.replace(tasks=tasks, users=users, metrics=metrics, key=key),
-        buf,
-    )
+    state = state.replace(tasks=tasks, users=users, metrics=metrics, key=key)
+    if views is not None:
+        return state, buf, views
+    return state, buf
 
 
 def _phase_broker(
@@ -1255,16 +1593,25 @@ def _phase_broker(
 
 def _phase_completions(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    buf: TickBuf, t1: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
+    buf: TickBuf, t1: jax.Array, views: Optional[dict] = None,
+):
     """FIFO fogs whose in-service task finished release it (releaseResource,
     ``ComputeBrokerApp3.cc:224-256``): status-6 ack relayed to the client
     (taskTime signal), busyTime decremented by the task's service time, FIFO
     head promoted (queueTime signal), next release scheduled exactly at
     ``busy_until + svc``, and a fresh advertisement put in flight.
+
+    ``views`` (fused front-end): task-table reads come from the threaded
+    column views and every task write joins the shared deferred-scatter
+    set instead of landing as its own kernel.  One sequencing hazard:
+    the promoted head's RUNNING entry may be retired by the NEXT
+    completion pass completing that same task within the tick — so the
+    entry parks in ``views["pending_promote"]`` and the next pass (or
+    the flush) resolves it, keeping the merged scatter groups disjoint.
     """
     tasks, fogs, b = state.tasks, state.fogs, state.broker
     F, U = spec.n_fogs, spec.n_users
+    T = spec.task_capacity
     i32 = jnp.int32
     fog_alive = state.nodes.alive[U : U + F]
 
@@ -1272,27 +1619,52 @@ def _phase_completions(
     done_task = jnp.where(comp, fogs.current_task, spec.task_capacity)
     t_done = fogs.busy_until  # exact completion times per fog
 
+    if views is not None:
+        views = dict(views)
+        views["scat"] = {k: list(xs) for k, xs in views["scat"].items()}
+        if views["pending_promote"] is not None:
+            # the previous pass's promoted head completes THIS pass ->
+            # its RUNNING entry is superseded by this pass's DONE write
+            # (sequential order); retire it so the merged stage scatter
+            # stays conflict-free
+            _defer_scatter(
+                views, "stage",
+                jnp.where(comp, T, views["pending_promote"]),
+                jnp.full((F,), _ST_RUNNING),
+            )
+            views["pending_promote"] = None
+
     # ack6 path: fog -> broker -> client (relay, BrokerBaseApp3.cc:164-175)
     user_of = jnp.clip(done_task, 0, spec.task_capacity - 1) // spec.max_sends_per_user
     d_fb = cache.d2b[U : U + F]
     d_bu = cache.d2b[user_of]
     t_ack6 = t_done + d_fb + d_bu
 
+    mips_flat = views["mips2"].reshape(T) if views is not None else tasks.mips_req
     svc_done = _svc_time(
-        spec, tasks.mips_req[jnp.clip(done_task, 0, spec.task_capacity - 1)], fogs.mips
+        spec, mips_flat[jnp.clip(done_task, 0, spec.task_capacity - 1)], fogs.mips
     )
 
-    tasks = tasks.replace(
-        t_complete=tasks.t_complete.at[done_task].set(
-            jnp.where(comp, t_done, 0), mode="drop"
-        ),
-    )
-    if not spec.derive_acks:
+    if views is not None:
+        _defer_scatter(
+            views, "t_complete", done_task, jnp.where(comp, t_done, 0)
+        )
+        if not spec.derive_acks:
+            _defer_scatter(
+                views, "t_ack6", done_task, jnp.where(comp, t_ack6, 0)
+            )
+    else:
         tasks = tasks.replace(
-            t_ack6=tasks.t_ack6.at[done_task].set(
-                jnp.where(comp, t_ack6, 0), mode="drop"
+            t_complete=tasks.t_complete.at[done_task].set(
+                jnp.where(comp, t_done, 0), mode="drop"
             ),
         )
+        if not spec.derive_acks:
+            tasks = tasks.replace(
+                t_ack6=tasks.t_ack6.at[done_task].set(
+                    jnp.where(comp, t_ack6, 0), mode="drop"
+                ),
+            )
     # busyTime -= currentTask.requiredTime (== its tskTime, set at accept:
     # ComputeBrokerApp3.cc:296,232)
     busy_time = jnp.where(comp, fogs.busy_time - svc_done, fogs.busy_time)
@@ -1301,35 +1673,51 @@ def _phase_completions(
     head, q_head, q_len = batched_pop(fogs.queue, fogs.q_head, fogs.q_len, comp)
     promoted = comp & (head != NO_TASK)
     head_c = jnp.clip(head, 0, spec.task_capacity - 1)
-    svc_new = _svc_time(spec, tasks.mips_req[head_c], fogs.mips)
-    # ONE stage scatter for completed + promoted rows (disjoint index
-    # sets; two separate scatters cost ~25 us each on the v5e)
-    scat_stage = jnp.concatenate(
-        [done_task, jnp.where(promoted, head, spec.task_capacity)]
-    )
-    stage_vals = jnp.concatenate(
-        [
-            jnp.full((F,), _ST_DONE),
-            jnp.full((F,), _ST_RUNNING),
-        ]
-    )
-    tasks = tasks.replace(
-        stage=tasks.stage.at[scat_stage].set(stage_vals, mode="drop"),
-        t_service_start=tasks.t_service_start.at[
-            jnp.where(promoted, head, spec.task_capacity)
-        ].set(jnp.where(comp, t_done, 0), mode="drop"),
-    )
-    if not spec.derive_acks:
-        tasks = tasks.replace(
-            queue_time_ms=tasks.queue_time_ms.at[
-                jnp.where(promoted, head, spec.task_capacity)
-            ].set(
-                jnp.where(
-                    promoted, (t_done - tasks.t_q_enter[head_c]) * 1e3, 0
-                ),
-                mode="drop",
-            ),
+    svc_new = _svc_time(spec, mips_flat[head_c], fogs.mips)
+    if views is not None:
+        # stage: DONE entries join the merged scatter now; the promoted
+        # RUNNING entry parks as pending (see docstring)
+        _defer_scatter(views, "stage", done_task, jnp.full((F,), _ST_DONE))
+        views["pending_promote"] = jnp.where(promoted, head, T)
+        _defer_scatter(
+            views, "t_service_start",
+            jnp.where(promoted, head, T), jnp.where(comp, t_done, 0),
         )
+        if not spec.derive_acks:
+            _defer_scatter(
+                views, "queue_time_ms",
+                jnp.where(promoted, head, T),
+                jnp.where(promoted, (t_done - tasks.t_q_enter[head_c]) * 1e3, 0),
+            )
+    else:
+        # ONE stage scatter for completed + promoted rows (disjoint index
+        # sets; two separate scatters cost ~25 us each on the v5e)
+        scat_stage = jnp.concatenate(
+            [done_task, jnp.where(promoted, head, spec.task_capacity)]
+        )
+        stage_vals = jnp.concatenate(
+            [
+                jnp.full((F,), _ST_DONE),
+                jnp.full((F,), _ST_RUNNING),
+            ]
+        )
+        tasks = tasks.replace(
+            stage=tasks.stage.at[scat_stage].set(stage_vals, mode="drop"),
+            t_service_start=tasks.t_service_start.at[
+                jnp.where(promoted, head, spec.task_capacity)
+            ].set(jnp.where(comp, t_done, 0), mode="drop"),
+        )
+        if not spec.derive_acks:
+            tasks = tasks.replace(
+                queue_time_ms=tasks.queue_time_ms.at[
+                    jnp.where(promoted, head, spec.task_capacity)
+                ].set(
+                    jnp.where(
+                        promoted, (t_done - tasks.t_q_enter[head_c]) * 1e3, 0
+                    ),
+                    mode="drop",
+                ),
+            )
     fogs = fogs.replace(
         busy_time=busy_time,
         current_task=jnp.where(comp, jnp.where(promoted, head, NO_TASK), fogs.current_task),
@@ -1350,27 +1738,54 @@ def _phase_completions(
             adv_val_busy=jnp.where(comp, busy_time, b.adv_val_busy),
             adv_arrive_t=jnp.where(comp, t_done + d_fb, b.adv_arrive_t),
         )
-    n_comp = jnp.sum(comp.astype(i32))
-    metrics = state.metrics.replace(n_completed=state.metrics.n_completed + n_comp)
-    # fog sends ack6 (+ advert); broker relays to the user
-    n_adv = n_comp if spec.adv_on_completion else 0
-    buf = buf._replace(
-        tx_f=buf.tx_f
-        + comp.astype(i32) * (2 if spec.adv_on_completion else 1),
-        tx_b=buf.tx_b + n_comp,
-        rx_b=buf.rx_b + n_comp + n_adv,
-        rx_u=buf.rx_u.at[user_of].add(comp.astype(i32), mode="drop"),
+    defer_counts = views is not None and views.get(
+        "defer_host_counts", False
     )
-    return (
-        state.replace(tasks=tasks, fogs=fogs, broker=b, metrics=metrics),
-        buf,
-    )
+    if defer_counts:
+        # telemetry-off fused tick: the scalar completion count, the
+        # broker relay counters and the per-user ack scatter-add all
+        # fold into the flush's single merged pass (int adds commute,
+        # so the deferred totals are bit-identical to the eager ones)
+        views["def_f"] = list(views.get("def_f", ()))
+        views["def_f"].append((
+            comp,
+            (
+                ("n_completed", 1),
+                ("tx_b", 1),
+                ("rx_b", 2 if spec.adv_on_completion else 1),
+            ),
+        ))
+        views["rx_u"] = list(views.get("rx_u", ()))
+        views["rx_u"].append((user_of, comp.astype(i32)))
+        metrics = state.metrics
+        buf = buf._replace(
+            tx_f=buf.tx_f
+            + comp.astype(i32) * (2 if spec.adv_on_completion else 1),
+        )
+    else:
+        n_comp = jnp.sum(comp.astype(i32))
+        metrics = state.metrics.replace(
+            n_completed=state.metrics.n_completed + n_comp
+        )
+        # fog sends ack6 (+ advert); broker relays to the user
+        n_adv = n_comp if spec.adv_on_completion else 0
+        buf = buf._replace(
+            tx_f=buf.tx_f
+            + comp.astype(i32) * (2 if spec.adv_on_completion else 1),
+            tx_b=buf.tx_b + n_comp,
+            rx_b=buf.rx_b + n_comp + n_adv,
+            rx_u=buf.rx_u.at[user_of].add(comp.astype(i32), mode="drop"),
+        )
+    state = state.replace(tasks=tasks, fogs=fogs, broker=b, metrics=metrics)
+    if views is not None:
+        return state, buf, views
+    return state, buf
 
 
 def _phase_fog_arrivals(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    buf: TickBuf, t1: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
+    buf: TickBuf, t1: jax.Array, views: Optional[dict] = None,
+):
     """Tasks reaching their FIFO fog node are assigned or queued
     (``ComputeBrokerApp3.cc:269-320``).
 
@@ -1387,7 +1802,10 @@ def _phase_fog_arrivals(
     assignment, queueing and ack bookkeeping either way.
     """
     if spec.two_stage_arrivals:
-        return _fog_arrivals_front_two_stage(spec, state, net, cache, buf, t1)
+        return _fog_arrivals_front_two_stage(
+            spec, state, net, cache, buf, t1, views
+        )
+    assert views is None  # the fused gate requires two_stage_arrivals
     return _fog_arrivals_front_full(spec, state, net, cache, buf, t1)
 
 
@@ -1480,8 +1898,8 @@ def _fog_arrivals_front_full(
 
 def _fog_arrivals_front_two_stage(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    buf: TickBuf, t1: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
+    buf: TickBuf, t1: jax.Array, views: Optional[dict] = None,
+):
     """Per-user candidate front-end (r5).
 
     At ``dt <= send_interval`` at most one task per user matures at its
@@ -1508,24 +1926,50 @@ def _fog_arrivals_front_two_stage(
     f32 = jnp.float32
     fog_alive = state.nodes.alive[U : U + F]
 
-    st2 = tasks.stage.reshape(U, S)
-    taf2 = tasks.t_at_fog.reshape(U, S)
-    fog2 = tasks.fog.reshape(U, S)
-    mip2 = tasks.mips_req.reshape(U, S)
+    if views is not None:
+        st2 = views["stage2"]
+        taf2 = views["t_at_fog2"]
+        fog2 = views["fog2"]
+        mip2 = views["mips2"]
+    else:
+        st2 = tasks.stage.reshape(U, S)
+        taf2 = tasks.t_at_fog.reshape(U, S)
+        fog2 = tasks.fog.reshape(U, S)
+        mip2 = tasks.mips_req.reshape(U, S)
     kk = jnp.arange(S, dtype=i32)[None, :]
 
     m = (st2 == _ST_TASK_INFLIGHT) & (taf2 <= t1)
     # R earliest matured slots per user; argmin returns the FIRST min, so
-    # time ties break by slot id exactly like the classic selection
+    # time ties break by slot id exactly like the classic selection.
+    # Fused mode halves the reductions per pass: (min, argmin) collapse
+    # into one variadic lex-min reduce (ops/queues.row_lexmin — same
+    # first-occurrence tie-break) and the two one-hot row sums into one
+    # stacked sum (a one-hot sum IS its single element, and fog ids are
+    # exact in f32, so both merges are bit-identical).
     cks, cts, cfs, cms, cvs = [], [], [], [], []
     for _ in range(R):
         key = jnp.where(m, taf2, jnp.inf)
-        ck = jnp.argmin(key, axis=1).astype(i32)  # (U,)
-        ct = jnp.min(key, axis=1)
-        cv = jnp.isfinite(ct)
-        sel = m & (kk == ck[:, None])
-        cf = jnp.sum(jnp.where(sel, fog2, 0), axis=1)  # one-hot: exact
-        cm = jnp.sum(jnp.where(sel, mip2, 0.0), axis=1)
+        if views is not None:
+            ct, ck = row_lexmin(key)  # (U,), (U,) in ONE reduce
+            cv = jnp.isfinite(ct)
+            sel = m & (kk == ck[:, None])
+            cfm = jnp.sum(
+                jnp.where(
+                    sel[:, None, :],
+                    jnp.stack([fog2.astype(f32), mip2], axis=1),
+                    0.0,
+                ),
+                axis=2,
+            )  # (U, 2)
+            cf = cfm[:, 0].astype(i32)
+            cm = cfm[:, 1]
+        else:
+            ck = jnp.argmin(key, axis=1).astype(i32)  # (U,)
+            ct = jnp.min(key, axis=1)
+            cv = jnp.isfinite(ct)
+            sel = m & (kk == ck[:, None])
+            cf = jnp.sum(jnp.where(sel, fog2, 0), axis=1)  # one-hot: exact
+            cm = jnp.sum(jnp.where(sel, mip2, 0.0), axis=1)
         cks.append(ck); cts.append(ct); cfs.append(cf)
         cms.append(cm); cvs.append(cv)
         m = m & ~sel
@@ -1543,6 +1987,8 @@ def _fog_arrivals_front_two_stage(
     # ---- saturated-fog fast drop on the candidate list ----------------
     n_fast = jnp.zeros((), i32)
     n_fast_f = jnp.zeros((F,), i32)
+    fast_defer = None
+    defer_fast = views is not None and _fused_skip_compact(spec)
     if F > 0:
         droppy = (  # (F,) fog can only tail-drop a live arrival
             (fogs.q_len >= spec.queue_capacity)
@@ -1557,22 +2003,28 @@ def _fog_arrivals_front_two_stage(
         memb_f = memb.astype(f32)
         droppy_c = droppy.astype(f32) @ memb_f > 0.5  # (UR,)
         fast_drop = cand_v & droppy_c
-        # per-fog tail-drop count + busyTime add: one (F, UR) @ (UR, 2)
-        rhs = jnp.stack(
-            [
-                fast_drop.astype(f32),
-                jnp.where(fast_drop, cand_m, 0.0),
-            ],
-            axis=1,
-        )  # (UR, 2)
-        sums = memb_f @ rhs  # (F, 2) f32 exact (counts < 2^24)
-        n_fast_f = sums[:, 0].astype(i32)
-        svc_fast_f = sums[:, 1] / jnp.maximum(fogs.mips, 1e-9)
-        fogs = fogs.replace(
-            busy_time=fogs.busy_time + svc_fast_f,
-            q_drops=fogs.q_drops + n_fast_f,
-        )
-        n_fast = jnp.sum(n_fast_f)
+        if defer_fast:
+            # fused no-window mode: the tail's merged reduction runs at
+            # the candidate width, so the fast-drop count/MIPS sums ride
+            # it instead of paying their own (F, UR) @ (UR, 2) GEMM here
+            fast_defer = (memb & fast_drop[None, :], fast_drop)
+        else:
+            # per-fog tail-drop count + busyTime add: one (F, UR) @ (UR, 2)
+            rhs = jnp.stack(
+                [
+                    fast_drop.astype(f32),
+                    jnp.where(fast_drop, cand_m, 0.0),
+                ],
+                axis=1,
+            )  # (UR, 2)
+            sums = memb_f @ rhs  # (F, 2) f32 exact (counts < 2^24)
+            n_fast_f = sums[:, 0].astype(i32)
+            svc_fast_f = sums[:, 1] / jnp.maximum(fogs.mips, 1e-9)
+            fogs = fogs.replace(
+                busy_time=fogs.busy_time + svc_fast_f,
+                q_drops=fogs.q_drops + n_fast_f,
+            )
+            n_fast = jnp.sum(n_fast_f)
         # stage -> DROPPED densely over the (U, S) view (no T-scatter)
         fast2 = fast_drop.reshape(U, R)
         sel_fast = jnp.zeros((U, S), bool)
@@ -1580,11 +2032,12 @@ def _fog_arrivals_front_two_stage(
             sel_fast = sel_fast | (
                 (kk == cks[r][:, None]) & fast2[:, r : r + 1]
             )
-        tasks = tasks.replace(
-            stage=jnp.where(
-                sel_fast, _ST_DROPPED, st2
-            ).reshape(T)
-        )
+        st2 = jnp.where(sel_fast, _ST_DROPPED, st2)
+        if views is not None:
+            views = dict(views)
+            views["stage2"] = st2
+        else:
+            tasks = tasks.replace(stage=st2.reshape(T))
         cand_v = cand_v & ~fast_drop
 
     # ---- K-window compaction over the candidate list ------------------
@@ -1593,18 +2046,48 @@ def _fog_arrivals_front_two_stage(
             n_deferred=state.metrics.n_deferred + n_left
         )
     )
-    rot, state = _rot_and_defer(spec, state, cand_v, K)
-    idx_c, idxc_c, valid = _compact(cand_v, K, UR, rot)
-    fog_g = cand_f[idxc_c]
-    t_af_g = cand_t[idxc_c]
-    mips_g = cand_m[idxc_c]
-    user_g = cand_u[idxc_c]
-    slot_g = cand_slot[idxc_c]
-    idx = jnp.where(valid, slot_g, T)  # T-space scatter targets
-    idxc = jnp.minimum(idx, T - 1)
+    if views is not None and _fused_skip_compact(spec):
+        # fused no-window mode: with K >= T the window can never
+        # overflow and the packed selection order is plain ascending
+        # candidate order, so the candidate list IS the window — the
+        # whole _compact machinery (two cumsums, first-True argmaxes,
+        # the (K, C) row gather) drops out of the tick.  Padding rows
+        # keep ``idx = T`` (drop-mode scatters) and every tail
+        # reduction is order/shape-independent (integer sums, mins, and
+        # the exact-integer busy-MIPS sum of _fused_skip_compact's
+        # bound), so results are bit-identical to the compacted path.
+        idx = jnp.where(cand_v, cand_slot, T)
+        idxc = jnp.minimum(idx, T - 1)
+        valid = cand_v
+        fog_g, t_af_g, mips_g, user_g = cand_f, cand_t, cand_m, cand_u
+        dense_wb = cks  # per-pass slot indices: window row (u, r) owns
+        #   slot cks[r][u], so the tail writes back densely, no scatter
+    else:
+        dense_wb = None
+        rot, state = _rot_and_defer(spec, state, cand_v, K)
+        idx_c, idxc_c, valid = _compact(cand_v, K, UR, rot)
+        if views is not None:
+            # one stacked gather per dtype family instead of five
+            # (K,)-from-(UR,) gathers; gathers are exact, so this is
+            # bit-identical to the per-column form
+            fg = jnp.stack([cand_t, cand_m], axis=1)[idxc_c]  # (K, 2)
+            ig = jnp.stack(
+                [cand_f, cand_u, cand_slot], axis=1
+            )[idxc_c]  # (K, 3)
+            t_af_g, mips_g = fg[:, 0], fg[:, 1]
+            fog_g, user_g, slot_g = ig[:, 0], ig[:, 1], ig[:, 2]
+        else:
+            fog_g = cand_f[idxc_c]
+            t_af_g = cand_t[idxc_c]
+            mips_g = cand_m[idxc_c]
+            user_g = cand_u[idxc_c]
+            slot_g = cand_slot[idxc_c]
+        idx = jnp.where(valid, slot_g, T)  # T-space scatter targets
+        idxc = jnp.minimum(idx, T - 1)
     return _fog_arrivals_tail(
         spec, state, cache, buf, tasks, fogs,
         idx, idxc, valid, fog_g, t_af_g, mips_g, user_g, n_fast, n_fast_f,
+        views=views, fast_defer=fast_defer, dense_wb=dense_wb,
     )
 
 
@@ -1613,36 +2096,82 @@ def _fog_arrivals_tail(
     tasks, fogs, idx: jax.Array, idxc: jax.Array, valid: jax.Array,
     fog_g: jax.Array, t_af_g: jax.Array, mips_g: jax.Array,
     user_g: jax.Array, n_fast: jax.Array, n_fast_f: jax.Array,
-) -> Tuple[WorldState, TickBuf]:
-    """Shared assignment/queueing tail over the compacted K-window."""
-    T, F, K = spec.task_capacity, spec.n_fogs, spec.window
+    views: Optional[dict] = None,
+    fast_defer: Optional[Tuple[jax.Array, jax.Array]] = None,
+    dense_wb: Optional[list] = None,
+):
+    """Shared assignment/queueing tail over the compacted K-window (or,
+    in the fused no-window mode, directly over the candidate list —
+    ``idx.shape[0]`` is the buffer width either way).
+
+    ``fast_defer``: fused no-window mode only — the front's fast-drop
+    ``(membership, drop-mask)`` pair, whose per-fog count/MIPS sums ride
+    this tail's one merged reduction instead of their own GEMM."""
+    T, F = spec.task_capacity, spec.n_fogs
+    W = idx.shape[0]  # window width (spec.window, or U*R when fused)
     U = spec.n_users
     i32 = jnp.int32
     fog_alive = state.nodes.alive[U : U + F]
     fog_gc = jnp.clip(fog_g, 0, F - 1)
 
-    dead_dst = valid & ~fog_alive[fog_gc]  # packets to a dead node are lost
+    idle = fogs.current_task == NO_TASK
+    if views is not None:
+        # one stacked (F, 2) gather for the two per-fog predicates the
+        # window needs (0/1 integers — exact), instead of two gathers
+        ai = jnp.stack(
+            [fog_alive.astype(i32), idle.astype(i32)], axis=1
+        )[fog_gc]
+        alive_g = ai[:, 0] != 0
+        idle_g = ai[:, 1] != 0
+    else:
+        alive_g = fog_alive[fog_gc]
+        idle_g = None  # gathered at use (the unfused reference path)
+    dead_dst = valid & ~alive_g  # packets to a dead node are lost
     arr = valid & ~dead_dst
 
-    svc_g = _svc_time(spec, mips_g, fogs.mips[fog_gc])
-    per_fog_arr = _per_fog(arr, fog_g, F)  # (F, K) membership
-    add_busy = jnp.sum(
-        jnp.where(per_fog_arr, svc_g[None, :], 0.0), axis=1
+    per_fog_arr = _per_fog(arr, fog_g, F)  # (F, W) membership
+    # busyTime += this window's service estimates, as (Σ MIPSRequired) /
+    # MIPS per fog — the same formulation as the fast-drop path's
+    # ``svc_fast_f`` (r6): MIPSRequired values are integers, so the f32
+    # sum is EXACT (and reduction-order/shape independent) below 2^24,
+    # which is what lets the fused no-window mode reduce over the
+    # candidate list instead of the packed window bit-identically.  In
+    # fused mode the sum rides the tail's one merged reduction below.
+    if views is None:
+        mips_sum = jnp.sum(
+            jnp.where(per_fog_arr, mips_g[None, :], 0.0), axis=1
+        )
+
+    plan = plan_arrivals(
+        arr, fog_g, t_af_g, F, idle, per_fog=per_fog_arr,
+        fused=views is not None,
     )
 
-    idle = fogs.current_task == NO_TASK
-    plan = plan_arrivals(arr, fog_g, t_af_g, F, idle, per_fog=per_fog_arr)
-
     # --- immediate assignment on idle fogs ---
-    a_pos = plan.assign_task  # (F,) position in the K-buffer or NO_TASK
+    a_pos = plan.assign_task  # (F,) position in the window buffer or NO_TASK
     assigned = a_pos != NO_TASK
-    a_posc = jnp.clip(a_pos, 0, K - 1)
+    a_posc = jnp.clip(a_pos, 0, W - 1)
     a_task = jnp.where(assigned, idx[a_posc], NO_TASK)  # global task id
     a_taskc = jnp.clip(a_task, 0, T - 1)
     # service starts when the task arrives — or when the server actually
-    # became free, if that was later within this same tick (free_since fix)
-    t_start = jnp.maximum(tasks.t_at_fog[a_taskc], fogs.free_since)
-    svc_a = _svc_time(spec, tasks.mips_req[a_taskc], fogs.mips)
+    # became free, if that was later within this same tick (free_since fix).
+    # Fused mode reads the threaded views: the broker wrote t_at_fog THIS
+    # tick and the write has not been flushed to the table yet.  In the
+    # no-window mode the assigned head's (arrival time, MIPS) are
+    # already window columns, so ONE stacked (W, 2) gather at the
+    # assigned position replaces the two T-space gathers (the window
+    # columns were read from the same views — identical values).
+    if dense_wb is not None:
+        tm = jnp.stack([t_af_g, mips_g], axis=1)[a_posc]  # (F, 2)
+        taf_a, mips_a = tm[:, 0], tm[:, 1]
+    elif views is not None:
+        taf_a = views["t_at_fog2"].reshape(T)[a_taskc]
+        mips_a = views["mips2"].reshape(T)[a_taskc]
+    else:
+        taf_a = tasks.t_at_fog[a_taskc]
+        mips_a = tasks.mips_req[a_taskc]
+    t_start = jnp.maximum(taf_a, fogs.free_since)
+    svc_a = _svc_time(spec, mips_a, fogs.mips)
     d_fb = cache.d2b[U : U + F]
     d_bu_a = cache.d2b[a_taskc // spec.max_sends_per_user]
     t_ack5 = t_start + d_fb + d_bu_a
@@ -1651,36 +2180,65 @@ def _fog_arrivals_tail(
     # and the window's stage_k write below already maps assigned_row ->
     # RUNNING — the r1-r4 double write was a redundant ~25 us scatter)
     scat_a = jnp.where(assigned, a_task, T)
-    tasks = tasks.replace(
-        t_service_start=tasks.t_service_start.at[scat_a].set(
-            jnp.where(assigned, t_start, 0), mode="drop"
-        ),
-    )
-    if not spec.derive_acks:
+    if views is not None:
+        views = dict(views)
+        views["scat"] = {k: list(xs) for k, xs in views["scat"].items()}
+        _defer_scatter(
+            views, "t_service_start", scat_a, jnp.where(assigned, t_start, 0)
+        )
+        if not spec.derive_acks:
+            _defer_scatter(
+                views, "t_ack5", scat_a, jnp.where(assigned, t_ack5, 0)
+            )
+    else:
         tasks = tasks.replace(
-            t_ack5=tasks.t_ack5.at[scat_a].set(
-                jnp.where(assigned, t_ack5, 0), mode="drop"
+            t_service_start=tasks.t_service_start.at[scat_a].set(
+                jnp.where(assigned, t_start, 0), mode="drop"
             ),
         )
+        if not spec.derive_acks:
+            tasks = tasks.replace(
+                t_ack5=tasks.t_ack5.at[scat_a].set(
+                    jnp.where(assigned, t_ack5, 0), mode="drop"
+                ),
+            )
     fogs = fogs.replace(
         current_task=jnp.where(assigned, a_task, fogs.current_task),
         busy_until=jnp.where(assigned, t_start + svc_a, fogs.busy_until),
-        busy_time=fogs.busy_time + add_busy,
     )
 
     # --- queue the rest (rank shifts by 1 where the head got assigned) ---
-    got_head = assigned[fog_gc] & idle[fog_gc]
+    if views is not None:
+        # stacked (F, 2) gather for the assignment predicates (exact)
+        aa = jnp.stack([assigned.astype(i32), a_task], axis=1)[fog_gc]
+        assigned_g = aa[:, 0] != 0
+        a_task_g = aa[:, 1]
+        got_head = assigned_g & idle_g
+    else:
+        assigned_g = assigned[fog_gc]
+        a_task_g = a_task[fog_gc]
+        got_head = assigned_g & idle[fog_gc]
     eff_rank = jnp.where(arr, plan.rank - got_head.astype(i32), -1)
-    to_queue = arr & (eff_rank >= 0) & (idx != a_task[fog_gc])
-    queue, q_len, enq_ok, dropped = batched_enqueue(
-        fogs.queue, fogs.q_head, fogs.q_len, to_queue, fog_g, eff_rank, idx
-    )
+    to_queue = arr & (eff_rank >= 0) & (idx != a_task_g)
+    if views is not None:
+        # scatter half only: added/dropped counts join the merged
+        # reduction below (same integers as batched_enqueue's)
+        queue, enq_ok = enqueue_scatter(
+            fogs.queue, fogs.q_head, fogs.q_len, to_queue, fog_g,
+            eff_rank, idx, stacked=True,
+        )
+        q_len = dropped = None  # from the merged reduction
+    else:
+        queue, q_len, enq_ok, dropped = batched_enqueue(
+            fogs.queue, fogs.q_head, fogs.q_len, to_queue, fog_g,
+            eff_rank, idx,
+        )
     d_bu_q = cache.d2b[user_g]
     d_fb_q = d_fb[fog_gc]
     # no gather needed for the keep-stage case: every valid row was
     # TASK_INFLIGHT by mask construction; the assigned head gets its
     # RUNNING stage HERE (assigned_row branch) — this is its only write
-    assigned_row = arr & (idx == a_task[fog_gc])
+    assigned_row = arr & (idx == a_task_g)
     stage_k = jnp.where(
         enq_ok,
         _ST_QUEUED,
@@ -1694,43 +2252,153 @@ def _fog_arrivals_tail(
             ),
         ),
     )
-    tasks = tasks.replace(
-        stage=tasks.stage.at[idx].set(stage_k, mode="drop"),
-        t_q_enter=tasks.t_q_enter.at[idx].set(
-            jnp.where(enq_ok, t_af_g, jnp.inf), mode="drop"
-        ),
-    )
-    if not spec.derive_acks:
-        tasks = tasks.replace(
-            t_ack4_queued=tasks.t_ack4_queued.at[idx].set(
+    if views is not None and dense_wb is not None:
+        # fused no-window mode: window row (u, r) owns slot
+        # dense_wb[r][u] of the (U, S) view, so the window's column
+        # writes map back as R masked selects — the whole T-space
+        # scatter chain of the window disappears.  Same rows (idx !=
+        # sentinel ⟺ valid), same values as the scatter form.
+        R_wb = len(dense_wb)
+        Uw = spec.n_users
+        kk_wb = jnp.arange(spec.max_sends_per_user, dtype=i32)[None, :]
+        stage_k2 = stage_k.reshape(Uw, R_wb)
+        valid2 = valid.reshape(Uw, R_wb)
+        tqv2 = jnp.where(enq_ok, t_af_g, jnp.inf).reshape(Uw, R_wb)
+        if not spec.derive_acks:
+            a4v2 = jnp.where(
+                enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf
+            ).reshape(Uw, R_wb)
+        for r, ckr in enumerate(dense_wb):
+            wsel = (kk_wb == ckr[:, None]) & valid2[:, r : r + 1]
+            views["stage2"] = jnp.where(
+                wsel, stage_k2[:, r : r + 1], views["stage2"]
+            )
+            views["t_q_enter2"] = jnp.where(
+                wsel, tqv2[:, r : r + 1], views["t_q_enter2"]
+            )
+            if not spec.derive_acks:
+                views["t_ack4_queued2"] = jnp.where(
+                    wsel, a4v2[:, r : r + 1], views["t_ack4_queued2"]
+                )
+    elif views is not None:
+        _defer_scatter(views, "stage", idx, stage_k)
+        _defer_scatter(
+            views, "t_q_enter", idx, jnp.where(enq_ok, t_af_g, jnp.inf)
+        )
+        if not spec.derive_acks:
+            _defer_scatter(
+                views, "t_ack4_queued", idx,
                 jnp.where(enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf),
-                mode="drop",
+            )
+    else:
+        tasks = tasks.replace(
+            stage=tasks.stage.at[idx].set(stage_k, mode="drop"),
+            t_q_enter=tasks.t_q_enter.at[idx].set(
+                jnp.where(enq_ok, t_af_g, jnp.inf), mode="drop"
             ),
         )
-    fogs = fogs.replace(queue=queue, q_len=q_len, q_drops=fogs.q_drops + dropped)
+        if not spec.derive_acks:
+            tasks = tasks.replace(
+                t_ack4_queued=tasks.t_ack4_queued.at[idx].set(
+                    jnp.where(enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf),
+                    mode="drop",
+                ),
+            )
     # every live arrival is a fog rx + one ack (assigned/queued) relayed
     # through the broker to the user
-    acked = (assigned[fog_gc] & (idx == a_task[fog_gc])) | enq_ok
-    sums = jnp.sum(
-        jnp.stack([to_queue & ~enq_ok, dead_dst, acked]).astype(i32), axis=1
+    acked = (assigned_g & (idx == a_task_g)) | enq_ok
+    f32 = jnp.float32
+    if views is not None:
+        # THE merged tail reduction: every per-fog and scalar sum of the
+        # phase — the scalar counters, the busy-MIPS sum, the arrival
+        # counts, the enqueue added/dropped counts, and (no-window mode)
+        # the front's deferred fast-drop sums — rides ONE (C, W) f32 row
+        # reduction.  Rows reduce independently and every count is an
+        # exact f32 integer, so each slice is bit-identical to its
+        # standalone reduce in the unfused path.
+        scalar_rows = [to_queue & ~enq_ok, dead_dst, acked]
+        if fast_defer is not None:
+            fast_memb, fast_drop = fast_defer
+            scalar_rows.append(fast_drop)
+        groups = [r.astype(f32)[None, :] for r in scalar_rows] + [
+            jnp.where(per_fog_arr, mips_g[None, :], 0.0),
+            per_fog_arr.astype(f32),
+            (per_fog_arr & enq_ok[None, :]).astype(f32),
+            (per_fog_arr & (to_queue & ~enq_ok)[None, :]).astype(f32),
+        ]
+        if fast_defer is not None:
+            groups += [
+                fast_memb.astype(f32),
+                jnp.where(fast_memb, mips_g[None, :], 0.0),
+            ]
+        red = jnp.sum(jnp.concatenate(groups, axis=0), axis=1)
+        s0 = len(scalar_rows)
+        sums = red[:3].astype(i32)
+        mips_sum = red[s0 : s0 + F]
+        counts = red[s0 + F : s0 + 2 * F].astype(i32)
+        added = red[s0 + 2 * F : s0 + 3 * F].astype(i32)
+        dropped = red[s0 + 3 * F : s0 + 4 * F].astype(i32)
+        if fast_defer is not None:
+            n_fast = red[3].astype(i32)
+            n_fast_f = red[s0 + 4 * F : s0 + 5 * F].astype(i32)
+            svc_fast_f = red[s0 + 5 * F :] / jnp.maximum(fogs.mips, 1e-9)
+        q_len = fogs.q_len + added
+        arr_per_fog = counts + n_fast_f
+    else:
+        sums = jnp.sum(
+            jnp.stack([to_queue & ~enq_ok, dead_dst, acked]).astype(i32),
+            axis=1,
+        )
+        # fast-dropped arrivals still reached (and were answered by) the
+        # fog exactly like a compacted enqueue-failure would have been
+        arr_per_fog = jnp.sum(per_fog_arr, axis=1, dtype=i32) + n_fast_f
+    add_busy = mips_sum / jnp.maximum(fogs.mips, 1e-9)
+    if fast_defer is not None:
+        # deferred fast-drop bookkeeping lands here, in the SAME order
+        # the unfused path applies it (fast-drop add, then window add —
+        # f32 addition order preserved bit-for-bit)
+        busy_time = fogs.busy_time + svc_fast_f + add_busy
+        q_drops = fogs.q_drops + n_fast_f + dropped
+    else:  # front already applied any fast-drop sums
+        busy_time = fogs.busy_time + add_busy
+        q_drops = fogs.q_drops + dropped
+    fogs = fogs.replace(
+        queue=queue, q_len=q_len, q_drops=q_drops, busy_time=busy_time,
     )
     metrics = state.metrics.replace(
         n_dropped=state.metrics.n_dropped + sums[0] + sums[1] + n_fast
     )
-    # fast-dropped arrivals still reached (and were answered by) the fog
-    # exactly like a compacted enqueue-failure would have been counted
-    arr_per_fog = jnp.sum(per_fog_arr, axis=1, dtype=i32) + n_fast_f
     buf = buf._replace(
         tx_f=buf.tx_f + arr_per_fog,
         rx_f=buf.rx_f + arr_per_fog,
         tx_b=buf.tx_b + sums[2],
         rx_b=buf.rx_b + sums[2],
-        rx_u=buf.rx_u.at[user_g].add(acked.astype(i32), mode="drop"),
     )
-    return (
-        state.replace(tasks=tasks, fogs=fogs, metrics=metrics),
-        buf,
+    defer_counts = views is not None and views.get(
+        "defer_host_counts", False
     )
+    if views is not None and dense_wb is not None:
+        # no-window mode: window rows are user-major (u, r), so the
+        # per-user ack counts are a row sum — no scatter at all
+        buf = buf._replace(
+            rx_u=buf.rx_u + jnp.sum(
+                acked.reshape(spec.n_users, len(dense_wb)), axis=1,
+                dtype=i32,
+            )
+        )
+    elif defer_counts:
+        # telemetry-off fused tick: the per-user ack scatter-add joins
+        # the flush's one merged rx_u scatter (int adds commute)
+        views["rx_u"] = list(views.get("rx_u", ()))
+        views["rx_u"].append((user_g, acked.astype(i32)))
+    else:
+        buf = buf._replace(
+            rx_u=buf.rx_u.at[user_g].add(acked.astype(i32), mode="drop")
+        )
+    state = state.replace(tasks=tasks, fogs=fogs, metrics=metrics)
+    if views is not None:
+        return state, buf, views
+    return state, buf
 
 
 # ----------------------------------------------------------------------
@@ -2208,20 +2876,40 @@ def make_step(
                 d2b=cache.d2b + qdelay + qdelay[spec.broker_index]
             )
 
+        # fused per-user slot-window front-end (spec.fused_slots, r6):
+        # spawn/broker/completions/arrivals thread the hot task-table
+        # columns as (U, S) register views plus a shared deferred-
+        # scatter write set; the table is written ONCE, after the last
+        # contributing phase.  Metrics/TickBuf/fog updates stay eager
+        # and per-phase (so the _ph work brackets book identically to
+        # the unfused pipeline) EXCEPT on telemetry-off ticks, where
+        # the scalar counter sums ride two merged flush reductions.
+        fused = _fused_ok(spec)
+        fv = _task_views(spec, state.tasks) if fused else None
+        if fused:
+            fv["defer_host_counts"] = not telem_on
+
         # 3-7. protocol phases
         if spec.connect_gating:
-            _ph("connect", lambda: _phase_connect(
-                spec, state, net, cache, buf, t0, t1))
-        _ph("adverts", lambda: _phase_adverts(state, t1))
+            out = _ph("connect", lambda: _phase_connect(
+                spec, state, net, cache, buf, t0, t1, views=fv))
+            if fused:
+                fv = out
+        out = _ph("adverts", lambda: _phase_adverts(
+            state, t1, buf=buf, views=fv))
+        if fused:
+            fv = out
         if spec.adv_periodic and spec.fog_model != int(FogModel.POOL):
             _ph("adverts", lambda: _phase_periodic_adverts(
                 spec, state, net, cache, t0, t1))
         if spec.max_sends_per_tick > 1:
-            _ph("spawn", lambda: _phase_spawn_multi(
-                spec, state, net, cache, buf, t0, t1))
+            out = _ph("spawn", lambda: _phase_spawn_multi(
+                spec, state, net, cache, buf, t0, t1, views=fv))
         else:
-            _ph("spawn", lambda: _phase_spawn(
-                spec, state, net, cache, buf, t0, t1))
+            out = _ph("spawn", lambda: _phase_spawn(
+                spec, state, net, cache, buf, t0, t1, views=fv))
+        if fused:
+            fv = out
         v2_local = (
             spec.policy == int(Policy.LOCAL_FIRST) and spec.v2_local_broker
         )
@@ -2230,8 +2918,10 @@ def make_step(
                 spec, state, net, cache, buf, t1, before_broker=True))
         v2_resched = None
         if _broker_dense_ok(spec):
-            _ph("broker", lambda: _phase_broker_dense(
-                spec, state, net, cache, buf, t1))
+            out = _ph("broker", lambda: _phase_broker_dense(
+                spec, state, net, cache, buf, t1, views=fv))
+            if fused:
+                fv = out
         else:
             v2_resched = _ph("broker", lambda: _phase_broker(
                 spec, state, net, cache, buf, t1))
@@ -2268,10 +2958,64 @@ def make_step(
                     spec, state, net, cache, buf, t1))
             else:
                 for _ in range(spec.completions_per_tick):
-                    _ph("completions", lambda: _phase_completions(
-                        spec, state, net, cache, buf, t1))
-                _ph("fog_arrivals", lambda: _phase_fog_arrivals(
-                    spec, state, net, cache, buf, t1))
+                    out = _ph("completions", lambda: _phase_completions(
+                        spec, state, net, cache, buf, t1, views=fv))
+                    if fused:
+                        fv = out
+                out = _ph("fog_arrivals", lambda: _phase_fog_arrivals(
+                    spec, state, net, cache, buf, t1, views=fv))
+                if fused:
+                    fv = out
+        if fused:
+            # the one task-table writeback of the tick: each threaded
+            # column lands as a single dense write, each deferred
+            # column as a single concatenated scatter — plus the
+            # deferred host-facing counters (telemetry-off only)
+            with jax.named_scope("phase_flush"):
+                state = state.replace(
+                    tasks=_flush_task_views(spec, state.tasks, fv)
+                )
+                if fv["rx_u"]:
+                    buf = buf._replace(
+                        rx_u=buf.rx_u.at[
+                            jnp.concatenate([i for i, _ in fv["rx_u"]])
+                        ].add(
+                            jnp.concatenate([a for _, a in fv["rx_u"]]),
+                            mode="drop",
+                        )
+                    )
+                # deferred scalar counters: ONE stacked reduction per
+                # row width, then integer adds to their targets (exact,
+                # and commutative, so totals equal the eager per-phase
+                # adds bit-for-bit)
+                m_adds: dict = {}
+                b_adds: dict = {}
+                for pool in ("def_u", "def_f"):
+                    entries = fv[pool]
+                    if not entries:
+                        continue
+                    red = jnp.sum(
+                        jnp.stack(
+                            [r for r, _ in entries]
+                        ).astype(jnp.int32),
+                        axis=1,
+                    )
+                    for i, (_, targets) in enumerate(entries):
+                        for name, scale in targets:
+                            d = m_adds if name.startswith("n_") else b_adds
+                            add = red[i] * scale if scale != 1 else red[i]
+                            d[name] = d.get(name, 0) + add
+                if m_adds:
+                    state = state.replace(
+                        metrics=state.metrics.replace(**{
+                            k: getattr(state.metrics, k) + v
+                            for k, v in m_adds.items()
+                        })
+                    )
+                if b_adds:
+                    buf = buf._replace(**{
+                        k: getattr(buf, k) + v for k, v in b_adds.items()
+                    })
         if spec.policy == int(Policy.LOCAL_FIRST) and not spec.v2_local_broker:
             _ph("local_completions", lambda: _phase_local_completions(
                 spec, state, net, cache, buf, t1))
@@ -2545,6 +3289,7 @@ def run_chunked(
     bounds: Optional[MobilityBounds] = None,
     chunk_ticks: int = 10_000,
     callback: Optional[Callable[[WorldState, int], None]] = None,
+    telemetry_stream: Optional[Callable[[dict, int], None]] = None,
 ) -> WorldState:
     """Advance an arbitrarily long horizon in fixed-size scan chunks.
 
@@ -2570,6 +3315,16 @@ def run_chunked(
     chunks do not donate: the callback may retain each chunk-boundary
     state (checkpoint streaming), and donating it to the next chunk
     would delete those buffers behind the callback's back.
+
+    ``telemetry_stream`` (the PR-4 live-dashboard follow-up): with
+    ``spec.telemetry`` on, called after every chunk as
+    ``telemetry_stream(rows, ticks_done)`` where ``rows`` maps each
+    :data:`~fognetsimpp_tpu.telemetry.metrics.RES_FIELDS` name to the
+    HOST copy of the reservoir rows this chunk completed (strictly
+    in tick order, no row delivered twice).  Unlike ``callback`` it
+    does NOT disable donation: the rows are fetched to host before the
+    next chunk consumes the state, and nothing device-resident is
+    retained.
     """
     if spec.record_tick_series:
         raise ValueError(
@@ -2601,8 +3356,14 @@ def run_chunked(
         final, _ = run(spec, s, net_, bounds_, n_ticks=n)
         return final
 
+    if telemetry_stream is not None and not spec.telemetry:
+        raise ValueError(
+            "telemetry_stream needs spec.telemetry=True (the reservoir "
+            "is zero-row when the plane is off)"
+        )
     donating = callback is None
     done = 0
+    next_row = 0
     while done < total:
         n = min(chunk, total - done)
         if donating:
@@ -2610,6 +3371,13 @@ def run_chunked(
         else:
             state = go_keep(n, state, net, bounds)
         done += n
+        if telemetry_stream is not None:
+            from ..telemetry.metrics import reservoir_progress
+
+            rows, next_row = reservoir_progress(
+                spec, state.telem, done, next_row
+            )
+            telemetry_stream(rows, done)
         if callback is not None:
             callback(state, done)
     return state

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -30,17 +31,164 @@ from jax.experimental import pallas as pl
 _ROW_TILE = 512
 _MAX_K = 4096
 
+#: (env, reason) pairs already reported to stderr — the note is emitted
+#: once per distinct disqualification, not once per trace.
+_warned: set = set()
+
+
+def _optin_note(env: str, reason: str) -> None:
+    """One-line stderr note when an opt-in kernel's env flag is set but
+    the shape/backend disqualifies it — so opt-in users aren't silently
+    left on the XLA path wondering why nothing changed."""
+    key = (env, reason)
+    if key not in _warned:
+        _warned.add(key)
+        print(
+            f"fognetsimpp_tpu: {env}=1 requested but {reason}; "
+            "falling back to the XLA path",
+            file=sys.stderr,
+        )
+
 
 def pallas_rank_applicable(K: int) -> bool:
     """Opt-in (FNS_PALLAS_RANK=1) + tile-aligned window on a TPU backend."""
+    if os.environ.get("FNS_PALLAS_RANK", "0") != "1":
+        return False
     tk = min(_ROW_TILE, K)
-    return (
-        os.environ.get("FNS_PALLAS_RANK", "0") == "1"
-        and K % 128 == 0
-        and K % tk == 0  # grid rows must tile K exactly
-        and K <= _MAX_K
-        and jax.default_backend() == "tpu"
+    if not (K % 128 == 0 and K % tk == 0 and K <= _MAX_K):
+        _optin_note(
+            "FNS_PALLAS_RANK",
+            f"window K={K} is not 128-aligned within the {_MAX_K} tile "
+            "budget",
+        )
+        return False
+    backend = jax.default_backend()
+    if backend != "tpu":
+        _optin_note(
+            "FNS_PALLAS_RANK", f"backend is {backend!r}, not tpu"
+        )
+        return False
+    return True
+
+
+def pallas_arrival_applicable(K: int, F: int) -> bool:
+    """Opt-in (FNS_PALLAS_ARRIVAL=1) gate for the fused decide-and-reduce
+    arrival kernel: tile-aligned window, a bounded fog axis, TPU backend.
+    Same one-line stderr note discipline as the rank kernel when the
+    opt-in is set but the shape disqualifies."""
+    if os.environ.get("FNS_PALLAS_ARRIVAL", "0") != "1":
+        return False
+    tk = min(_ROW_TILE, K)
+    if not (K % 128 == 0 and K % tk == 0 and K <= _MAX_K and F <= 1024):
+        _optin_note(
+            "FNS_PALLAS_ARRIVAL",
+            f"window K={K} / F={F} is outside the tile-aligned "
+            f"{_MAX_K}-window, F<=1024 envelope",
+        )
+        return False
+    backend = jax.default_backend()
+    if backend != "tpu":
+        _optin_note(
+            "FNS_PALLAS_ARRIVAL", f"backend is {backend!r}, not tpu"
+        )
+        return False
+    return True
+
+
+def _arrival_plan_kernel(
+    fog_all, t_all, mask_all, fog_row, t_row, mask_row,
+    rank_ref, cnt_ref, tmin_ref, first_ref, *, tk: int, K: int, F: int,
+):
+    """Fused decide-and-reduce over one row tile: the within-fog rank
+    (the O(K^2) pairwise compare + row-sum) AND the per-fog arrival
+    reductions (count, earliest (time, position) lex-min) in a single
+    pass over the tile — no (K, K) or (F, K) HBM intermediates.  The
+    per-fog outputs map every grid step to the same block and
+    accumulate across the sequential grid (int adds and lex-min are
+    associative and exact, so the result is bit-identical to the jnp
+    reference reductions)."""
+    i = pl.program_id(0)
+    fc = fog_all[0, :]  # (K,) column views
+    tc = t_all[0, :]
+    mc = mask_all[0, :]
+    fr = fog_row[0, :]  # (tk,) this tile's rows
+    tr = t_row[0, :]
+    mr = mask_row[0, :]
+
+    col_id = jax.lax.broadcasted_iota(jnp.int32, (tk, K), 1)
+    row_id = i * tk + jax.lax.broadcasted_iota(jnp.int32, (tk, K), 0)
+
+    same = fc[None, :] == fr[:, None]
+    earlier = (tc[None, :] < tr[:, None]) | (
+        (tc[None, :] == tr[:, None]) & (col_id < row_id)
     )
+    before = same & earlier & mc[None, :]
+    rank = jnp.sum(before.astype(jnp.int32), axis=1)
+    rank_ref[0, :] = jnp.where(mr, rank, -1)
+
+    # per-fog reduce over this tile's rows
+    pos = i * tk + jax.lax.broadcasted_iota(jnp.int32, (F, tk), 1)
+    fid = jax.lax.broadcasted_iota(jnp.int32, (F, tk), 0)
+    memb = (fr[None, :] == fid) & mr[None, :]  # (F, tk)
+    cnt_tile = jnp.sum(memb.astype(jnp.int32), axis=1)
+    tmat = jnp.where(memb, tr[None, :], jnp.inf)
+    tmin_tile = jnp.min(tmat, axis=1)
+    is_min = memb & (tmat == tmin_tile[:, None])
+    pos_tile = jnp.min(jnp.where(is_min, pos, K), axis=1)
+
+    @pl.when(i == 0)
+    def _init():
+        cnt_ref[0, :] = jnp.zeros((F,), jnp.int32)
+        tmin_ref[0, :] = jnp.full((F,), jnp.inf, jnp.float32)
+        first_ref[0, :] = jnp.full((F,), K, jnp.int32)
+
+    prev_t = tmin_ref[0, :]
+    prev_p = first_ref[0, :]
+    take = (tmin_tile < prev_t) | (
+        (tmin_tile == prev_t) & (pos_tile < prev_p)
+    )
+    cnt_ref[0, :] = cnt_ref[0, :] + cnt_tile
+    tmin_ref[0, :] = jnp.where(take, tmin_tile, prev_t)
+    first_ref[0, :] = jnp.where(take, pos_tile, prev_p)
+
+
+def fused_arrival_plan(
+    mask: jax.Array,  # (K,) bool
+    fog_key: jax.Array,  # (K,) i32 (sentinel-keyed, like pairwise_rank)
+    t_key: jax.Array,  # (K,) f32 (inf where masked out)
+    n_fogs: int,
+    interpret: bool = False,
+):
+    """(rank (K,), counts (F,), t_min (F,), first (F,)) in ONE Pallas
+    kernel — the arrival tail's "decide" (within-fog rank + earliest
+    arrival) and "reduce" (per-fog counts) fused.  ``interpret=True``
+    runs the same kernel on CPU (tests/test_pallas.py asserts exact
+    equality with the jnp reference path)."""
+    K = mask.shape[0]
+    F = n_fogs
+    tk = min(_ROW_TILE, K)
+    assert K % tk == 0, (K, tk)
+
+    full = pl.BlockSpec((1, K), lambda i: (0, 0))
+    row = pl.BlockSpec((1, tk), lambda i: (0, i))
+    fogb = pl.BlockSpec((1, F), lambda i: (0, 0))
+    rank, cnt, tmin, first = pl.pallas_call(
+        functools.partial(_arrival_plan_kernel, tk=tk, K=K, F=F),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, K), jnp.int32),
+            jax.ShapeDtypeStruct((1, F), jnp.int32),
+            jax.ShapeDtypeStruct((1, F), jnp.float32),
+            jax.ShapeDtypeStruct((1, F), jnp.int32),
+        ),
+        grid=(K // tk,),
+        in_specs=[full, full, full, row, row, row],
+        out_specs=(row, fogb, fogb, fogb),
+        interpret=interpret,
+    )(
+        fog_key.reshape(1, K), t_key.reshape(1, K), mask.reshape(1, K),
+        fog_key.reshape(1, K), t_key.reshape(1, K), mask.reshape(1, K),
+    )
+    return rank[0], cnt[0], tmin[0], first[0]
 
 
 def _rank_kernel(fog_all, t_all, mask_all, fog_row, t_row, mask_row, rank_ref,

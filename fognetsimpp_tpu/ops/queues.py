@@ -46,6 +46,34 @@ class ArrivalPlan(NamedTuple):
 _PAIRWISE_MAX = 4096
 
 
+def row_lexmin(keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row ``(min, argmin)`` of a 2-D array in ONE variadic reduce.
+
+    Bit-identical to ``(jnp.min(keys, 1), jnp.argmin(keys, 1))`` —
+    first-occurrence tie-break included (the comparator prefers the
+    lower index on equal values, and lexicographic min is associative,
+    so the reduction tree cannot change the result) — but the two
+    reductions collapse into a single HLO reduce: the fused tick's
+    kernel-count discipline (tools/op_budget.py).  ``keys`` must be
+    NaN-free (the engine's keys are times or +inf).
+    """
+    n_rows, n_cols = keys.shape
+    ids = jax.lax.broadcasted_iota(jnp.int32, keys.shape, 1)
+
+    def comb(a, b):
+        av, ai = a
+        bv, bi = b
+        take_a = (av < bv) | ((av == bv) & (ai <= bi))
+        return (jnp.where(take_a, av, bv), jnp.where(take_a, ai, bi))
+
+    return jax.lax.reduce(
+        (keys, ids),
+        (jnp.float32(jnp.inf), jnp.int32(n_cols)),
+        comb,
+        (1,),
+    )
+
+
 def plan_arrivals(
     mask: jax.Array,  # (K,) bool — tasks arriving at a fog this tick
     fog: jax.Array,  # (K,) i32 — destination fog per task
@@ -54,6 +82,10 @@ def plan_arrivals(
     fog_idle: jax.Array,  # (F,) bool — fog can take a task immediately
     per_fog: jax.Array = None,  # (F, K) bool membership (fog[k]==f & mask),
     #   precomputed by the caller when it already needs the matrix
+    fused: bool = False,  # fused tick (engine._fused_ok): merge the
+    #   first-arrival min/argmin into one variadic reduce and SKIP the
+    #   per-fog counts (the fused tail folds those into its single
+    #   merged reduction) — returns counts=None
 ) -> ArrivalPlan:
     """Compute per-fog arrival order for a batch of same-tick arrivals.
 
@@ -74,7 +106,25 @@ def plan_arrivals(
             fog[None, :] == jnp.arange(n_fogs, dtype=jnp.int32)[:, None]
         ) & mask[None, :]
 
-    from .pallas_kernels import pairwise_rank, pallas_rank_applicable
+    from .pallas_kernels import (
+        fused_arrival_plan,
+        pairwise_rank,
+        pallas_arrival_applicable,
+        pallas_rank_applicable,
+    )
+
+    if pallas_arrival_applicable(K, n_fogs):
+        # fused decide-and-reduce (opt-in, FNS_PALLAS_ARRIVAL=1): rank,
+        # per-fog counts and the earliest (time, position) pair come out
+        # of ONE Pallas pass — exact (int sums / lex-mins), so results
+        # are bit-identical to the jnp path (tests/test_pallas.py)
+        rank, counts, t_min, first = fused_arrival_plan(
+            mask, f_key, t_key, n_fogs
+        )
+        assign_task = jnp.where(
+            fog_idle & (counts > 0), first, NO_TASK
+        ).astype(jnp.int32)
+        return ArrivalPlan(assign_task=assign_task, rank=rank, counts=counts)
 
     if pallas_rank_applicable(K):
         # fused Pallas tile kernel: one pass, no (K, K) HBM intermediates
@@ -100,15 +150,26 @@ def plan_arrivals(
         rank_sorted = jnp.where(valid_sorted, idx - seg_start, -1)
         rank = jnp.zeros((K,), jnp.int32).at[order].set(rank_sorted)
 
-    counts = jnp.sum(per_fog, axis=1, dtype=jnp.int32)
+    if fused:
+        # one variadic lex-min reduce gives (earliest time, its id) per
+        # fog; an empty fog has t_min = inf, so finiteness replaces the
+        # counts > 0 test bit-exactly (masked-in arrivals always carry
+        # finite times).  counts move into the tail's merged reduction.
+        t_min, first = row_lexmin(
+            jnp.where(per_fog, t_key[None, :], jnp.inf)
+        )
+        counts = None
+        has_arrival = jnp.isfinite(t_min)
+    else:
+        counts = jnp.sum(per_fog, axis=1, dtype=jnp.int32)
 
-    # first arrival per fog: masked min on time, then min id among ties
-    t_min = jnp.min(jnp.where(per_fog, t_key[None, :], jnp.inf), axis=1)
-    is_tmin = per_fog & (t_key[None, :] == t_min[:, None])
-    first = jnp.min(
-        jnp.where(is_tmin, ids[None, :], jnp.iinfo(jnp.int32).max), axis=1
-    )
-    has_arrival = counts > 0
+        # first arrival per fog: masked min on time, then min id among ties
+        t_min = jnp.min(jnp.where(per_fog, t_key[None, :], jnp.inf), axis=1)
+        is_tmin = per_fog & (t_key[None, :] == t_min[:, None])
+        first = jnp.min(
+            jnp.where(is_tmin, ids[None, :], jnp.iinfo(jnp.int32).max), axis=1
+        )
+        has_arrival = counts > 0
     assign_task = jnp.where(
         fog_idle & has_arrival, first, NO_TASK
     ).astype(jnp.int32)
@@ -132,14 +193,9 @@ def batched_enqueue(
     drop (unbounded vector); size Q generously and watch the drop counter.
     """
     F, Q = queue.shape
-    slot = q_head[jnp.clip(fog, 0, F - 1)] + q_len[jnp.clip(fog, 0, F - 1)] + eff_rank
-    fits = mask & (q_len[jnp.clip(fog, 0, F - 1)] + eff_rank < Q) & (eff_rank >= 0)
-    flat_idx = jnp.where(fits, jnp.clip(fog, 0, F - 1) * Q + slot % Q, F * Q)
-    if task_ids is None:
-        task_ids = jnp.arange(mask.shape[0], dtype=jnp.int32)
-    flat = queue.reshape(F * Q)
-    flat = flat.at[flat_idx].set(task_ids, mode="drop")
-    queue = flat.reshape(F, Q)
+    queue, fits = enqueue_scatter(
+        queue, q_head, q_len, mask, fog, eff_rank, task_ids
+    )
 
     fog_eq = fog[None, :] == jnp.arange(F, dtype=jnp.int32)[:, None]  # (F, K)
     added = jnp.sum(fog_eq & fits[None, :], axis=1, dtype=jnp.int32)
@@ -148,6 +204,43 @@ def batched_enqueue(
     )
     q_len = q_len + added
     return queue, q_len, fits, dropped_per_fog
+
+
+def enqueue_scatter(
+    queue: jax.Array,  # (F, Q) i32
+    q_head: jax.Array,  # (F,) i32
+    q_len: jax.Array,  # (F,) i32
+    mask: jax.Array,  # (K,) bool
+    fog: jax.Array,  # (K,) i32
+    eff_rank: jax.Array,  # (K,) i32
+    task_ids: jax.Array = None,  # (K,) i32; defaults to arange(K)
+    stacked: bool = False,  # fused tick: fetch (q_head, q_len) in ONE
+    #   stacked gather (gathers are exact, so bit-identical; kept off
+    #   for batched_enqueue so the unfused reference path is untouched)
+) -> Tuple[jax.Array, jax.Array]:
+    """The scatter half of :func:`batched_enqueue`: write the fitting
+    tasks into their rings and return ``(queue, fits)``.
+
+    The per-fog added/dropped counting stays in
+    :func:`batched_enqueue`; the engine's fused tail calls this
+    directly and folds those counts into its single merged per-fog
+    reduction instead (same integers — `engine._fog_arrivals_tail`).
+    """
+    F, Q = queue.shape
+    if stacked:
+        hl = jnp.stack([q_head, q_len], axis=1)[jnp.clip(fog, 0, F - 1)]
+        head_g, len_g = hl[:, 0], hl[:, 1]
+    else:
+        head_g = q_head[jnp.clip(fog, 0, F - 1)]
+        len_g = q_len[jnp.clip(fog, 0, F - 1)]
+    slot = head_g + len_g + eff_rank
+    fits = mask & (len_g + eff_rank < Q) & (eff_rank >= 0)
+    flat_idx = jnp.where(fits, jnp.clip(fog, 0, F - 1) * Q + slot % Q, F * Q)
+    if task_ids is None:
+        task_ids = jnp.arange(mask.shape[0], dtype=jnp.int32)
+    flat = queue.reshape(F * Q)
+    flat = flat.at[flat_idx].set(task_ids, mode="drop")
+    return flat.reshape(F, Q), fits
 
 
 def batched_pop(

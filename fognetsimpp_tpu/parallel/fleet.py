@@ -174,15 +174,17 @@ def fleet_decisions(
     return _fleet_pipeline(spec, R, batch, net, bounds, keys)
 
 
-def fleet_busy_fractions(
+def fleet_busy_fractions_per_replica(
     spec: WorldSpec, final_batch: WorldState
 ) -> Optional[np.ndarray]:
-    """Replica-mean per-fog busy fraction of a finished fleet run.
+    """Per-replica per-fog busy fractions, shape ``(R, F)``.
 
-    The fleet analog of :func:`telemetry.metrics.busy_fractions`: each
-    replica carried its own device-resident ``TelemetryState``; this is
-    the single host gather averaging the (R, F) busy-tick counters over
-    the replica axis.  ``None`` when ``spec.telemetry`` was off.
+    The second PR-4 follow-up: the fleet's OpenMetrics exposition
+    publishes these as one gauge sample per ``(fleet=replica, fog)``
+    label pair instead of collapsing the replica axis to its mean — a
+    sweep's per-replica behaviour (different policies, loads, seeds) is
+    visible to the scrape, not averaged away.  One host gather of the
+    (R, F) busy-tick counters; ``None`` when ``spec.telemetry`` was off.
     """
     if not spec.telemetry:
         return None
@@ -190,7 +192,21 @@ def fleet_busy_fractions(
     ticks = np.maximum(
         np.asarray(final_batch.telem.ticks, np.float64), 1.0
     )  # (R,)
-    return (busy / ticks[:, None]).mean(axis=0)
+    return busy / ticks[:, None]
+
+
+def fleet_busy_fractions(
+    spec: WorldSpec, final_batch: WorldState
+) -> Optional[np.ndarray]:
+    """Replica-mean per-fog busy fraction of a finished fleet run.
+
+    The fleet analog of :func:`telemetry.metrics.busy_fractions` — kept
+    for summary readers; the OpenMetrics exposition uses
+    :func:`fleet_busy_fractions_per_replica` so replicas stay
+    distinguishable.  ``None`` when ``spec.telemetry`` was off.
+    """
+    per = fleet_busy_fractions_per_replica(spec, final_batch)
+    return None if per is None else per.mean(axis=0)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(2,))

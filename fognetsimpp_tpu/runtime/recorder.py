@@ -340,8 +340,10 @@ def record_fleet_run(
         json.dump(_json_sanitize(sca), f, indent=1, default=str,
                   allow_nan=False)
     paths = {"sca": sca_path}
-    # replica-aggregated OpenMetrics exposition (telemetry plane 3)
-    from ..parallel.fleet import fleet_busy_fractions
+    # OpenMetrics exposition (telemetry plane 3): aggregated counters
+    # plus PER-REPLICA fog gauges (fleet="r" label — the second PR-4
+    # follow-up; replicas are not averaged away in the scrape)
+    from ..parallel.fleet import fleet_busy_fractions_per_replica
     from ..telemetry.openmetrics import render_fleet_openmetrics
 
     # .fleet.-namespaced like the other fleet artifacts, so a
@@ -351,7 +353,8 @@ def record_fleet_run(
     with open(om_path, "w") as f:
         f.write(
             render_fleet_openmetrics(
-                sca["fleet"], fleet_busy_fractions(spec, final_batch)
+                sca["fleet"],
+                fleet_busy_fractions_per_replica(spec, final_batch),
             )
         )
     paths["om"] = om_path

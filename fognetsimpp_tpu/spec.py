@@ -281,6 +281,20 @@ class WorldSpec:
     # per-user candidate slots for the two-stage front-end; None derives
     # max_sends_per_tick (+1 slack when mobility can bunch arrivals)
     arrival_cands_per_user: Optional[int] = None
+    # Fused per-user slot-window front-end (r6 perf, "kernel-count
+    # collapse"): thread the hot task-table columns through
+    # spawn -> broker -> completions -> fog-arrivals as (U, S) register
+    # views and flush them ONCE per tick — each phase contributes column
+    # updates to a shared write set instead of materialising its own
+    # scatter chain, so the dt=1 ms tick compiles to measurably fewer
+    # HLO fusions/ops (gated by tools/op_budget.py).  Applies statically
+    # to the dense-broker policy family over FIFO fogs with the
+    # two-stage arrival front-end (engine._fused_ok); other worlds keep
+    # the classic per-phase path.  Bit-exact vs the unfused engine
+    # (state-hash A/B in tests/test_fused.py), which is why it defaults
+    # ON; set False to force the per-phase reference path (bench.py
+    # BENCH_FUSED=0 A/Bs the two).
+    fused_slots: bool = True
     # r5 perf: skip the per-tick writes of the five ack-timestamp columns
     # and queue_time_ms (each a ~25 us scatter or a full-column select)
     # and reconstruct them ONCE after the scan from the hot columns —

@@ -204,6 +204,33 @@ def accumulate_tick(
 # host-side readers (post-run; one fetch each)
 # ----------------------------------------------------------------------
 
+def reservoir_progress(
+    spec: WorldSpec, telem: TelemetryState, ticks_done: int,
+    start_row: int = 0,
+) -> tuple:
+    """Incremental read of the strided per-tick reservoir.
+
+    Returns ``({field: host rows [start_row:filled]}, filled)`` where
+    ``filled`` is the number of reservoir rows complete after
+    ``ticks_done`` ticks (row k holds tick ``k * stride``).  This is the
+    ``run_chunked`` live-streaming primitive (the PR-4 follow-up): each
+    chunk boundary fetches only the rows the chunk filled, so dashboards
+    see per-tick rows without waiting for run end — and without breaking
+    the chunk donation discipline (the fetch completes before the next
+    chunk consumes the state).
+    """
+    R = telem.res.shape[0]
+    if R == 0 or ticks_done <= 0:
+        return {f: np.zeros((0,)) for f in RES_FIELDS}, start_row
+    stride = max(1, -(-spec.n_ticks // R))
+    filled = min(R, -(-ticks_done // stride))
+    rows = np.asarray(telem.res[start_row:filled])
+    return (
+        {f: rows[:, i] for i, f in enumerate(RES_FIELDS)},
+        max(filled, start_row),
+    )
+
+
 def busy_fractions(spec: WorldSpec, final) -> Optional[np.ndarray]:
     """Per-fog busy fraction (ticks busy / ticks observed) as a host
     array, or ``None`` when ``spec.telemetry`` was off.

@@ -97,11 +97,16 @@ def render_fleet_openmetrics(
     fleet_scalars: Dict,
     busy_frac: Optional[np.ndarray] = None,
 ) -> str:
-    """OpenMetrics text for a fleet run's replica-aggregated scalars.
+    """OpenMetrics text for a fleet run's scalars.
 
     ``fleet_scalars`` is the dict from ``recorder.fleet_scalars``;
-    ``busy_frac`` is the optional replica-mean per-fog busy fraction
-    (``parallel.fleet.fleet_busy_fractions``).
+    ``busy_frac`` is the per-fog busy-fraction matrix — per-REPLICA
+    ``(R, F)`` from
+    :func:`fognetsimpp_tpu.parallel.fleet.fleet_busy_fractions_per_replica`
+    (each replica becomes its own ``fleet="r"`` label, the second PR-4
+    follow-up: a sweep's replicas stay distinguishable in the scrape
+    instead of being averaged away).  A 1-D vector is accepted for
+    backward compatibility and rendered without the ``fleet`` label.
     """
     lines: List[str] = []
     _family(lines, "fleet_replicas")
@@ -114,12 +119,21 @@ def render_fleet_openmetrics(
                 labels=f'{{stat="{stat}"}}',
             )
     if busy_frac is not None:
+        bf = np.asarray(busy_frac)
         _family(lines, "fleet_fog_busy_fraction")
-        for f in range(len(busy_frac)):
-            _sample(
-                lines, "fleet_fog_busy_fraction", busy_frac[f],
-                labels=f'{{fog="{f}"}}',
-            )
+        if bf.ndim == 2:
+            for r in range(bf.shape[0]):
+                for f in range(bf.shape[1]):
+                    _sample(
+                        lines, "fleet_fog_busy_fraction", bf[r, f],
+                        labels=f'{{fleet="{r}",fog="{f}"}}',
+                    )
+        else:
+            for f in range(len(bf)):
+                _sample(
+                    lines, "fleet_fog_busy_fraction", bf[f],
+                    labels=f'{{fog="{f}"}}',
+                )
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 

@@ -64,6 +64,11 @@ _QUICK_FILES = {
     # the Perfetto golden and the OpenMetrics/.sca.json agreement — all
     # small worlds, and exactly the checks an engine edit must not break
     "test_telemetry.py",
+    # fused slot-window front-end (ISSUE 5): the fused-vs-unfused
+    # state-hash A/B over the policy-family worlds + the HLO op-budget
+    # gate — the kernel-count win's correctness and its CI lock
+    "test_fused.py",
+    "test_op_budget.py",
 }
 
 

@@ -1,0 +1,87 @@
+"""The HLO op-budget gate (ISSUE 5): kernel-count regressions fail CI.
+
+tools/op_budget.py compiles the dt=1 ms tick at one pinned CPU shape,
+counts the optimized ENTRY computation's instructions and fusions, and
+gates them against the checked-in tools/op_budget.json — the same
+fail-fast discipline as simlint.  Here: the budget file exists and is
+self-consistent, the live counts sit within it, the fused front-end
+keeps its >= 30% kernel-count reduction, and the file is regenerable
+via --write.
+"""
+import json
+import os
+
+import pytest
+
+from tools import op_budget
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(scope="module")
+def measured():
+    return op_budget.measure()
+
+
+def test_budget_file_present_and_consistent():
+    assert os.path.exists(op_budget.BUDGET_PATH), (
+        "tools/op_budget.json missing — regenerate with "
+        "`python tools/op_budget.py --write` and commit it"
+    )
+    with open(op_budget.BUDGET_PATH) as f:
+        budget = json.load(f)
+    for key in ("shape", "fused", "unfused", "max_ops", "max_fusions",
+                "max_fused_ratio"):
+        assert key in budget, key
+    # the budget was measured at the tool's own pinned shape
+    assert budget["shape"] == {
+        k: (list(v) if isinstance(v, tuple) else v)
+        for k, v in op_budget.PINNED.items()
+    }
+    # slack caps genuinely cap the recorded counts
+    assert budget["fused"]["ops"] <= budget["max_ops"]
+    assert budget["fused"]["fusions"] <= budget["max_fusions"]
+
+
+def test_live_counts_within_budget(measured):
+    with open(op_budget.BUDGET_PATH) as f:
+        budget = json.load(f)
+    errs = op_budget.check(measured, budget)
+    assert not errs, "\n".join(errs)
+
+
+def test_fused_reduction_meets_the_30_percent_bar(measured):
+    """The ISSUE 5 acceptance number: >= 30% fewer HLO ops in the
+    compiled dt=1 ms tick with the fused front-end on."""
+    ratio = measured["fused"]["ops"] / measured["unfused"]["ops"]
+    assert ratio <= op_budget.MAX_FUSED_RATIO, measured
+
+
+def test_budget_regenerable_via_write(tmp_path, measured, capsys):
+    out = tmp_path / "budget.json"
+    rc = op_budget.main(["--write", "--budget", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    regen = json.loads(out.read_text())
+    # same jax/process -> identical counts as the module fixture
+    assert regen["fused"] == measured["fused"]
+    assert regen["unfused"] == measured["unfused"]
+    # and --check against the fresh file passes
+    rc = op_budget.main(["--check", "--budget", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_entry_op_counter_parses_hlo():
+    txt = """
+HloModule m
+ENTRY %main (p: f32[4]) -> f32[4] {
+  %p = f32[4]{0} parameter(0)
+  %c = f32[] constant(1)
+  %b = f32[4]{0} broadcast(f32[] %c), dimensions={}
+  %f = f32[4]{0} fusion(f32[4]{0} %p), kind=kLoop, calls=%fused
+  ROOT %a = f32[4]{0} add(f32[4]{0} %f, f32[4]{0} %b)
+}
+"""
+    got = op_budget.entry_op_counts(txt)
+    assert got == {"ops": 3, "fusions": 1}  # broadcast + fusion + add

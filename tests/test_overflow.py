@@ -84,6 +84,39 @@ def test_overflow_does_not_starve_high_id_users():
     )
 
 
+def _state_hash(state):
+    import hashlib
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def test_sustained_overflow_fused_vs_unfused_bit_exact():
+    """ISSUE 5: the rotation x slot-window interaction under SUSTAINED
+    window overflow is the likeliest fused-path bit-exactness hazard —
+    the fused front-end must reproduce the rotated K-window compaction
+    (which candidates defer, in which window positions) exactly.
+    MIN_BUSY keeps the dense broker (so the fused path actually
+    engages); the K=8 arrival window overflows at the fog side every
+    tick."""
+    kw = dict(policy=int(Policy.MIN_BUSY), arrival_window=8)
+    spec_f, state_f, net_f, bounds_f = _overflow_world(**kw)
+    from fognetsimpp_tpu.core.engine import _fused_ok
+
+    assert _fused_ok(spec_f), "fused path must engage for this A/B"
+    final_f, _ = run(spec_f, state_f, net_f, bounds_f)
+    assert int(final_f.metrics.n_deferred_max) > 0  # overflow sustained
+    spec_u, state_u, net_u, bounds_u = _overflow_world(
+        fused_slots=False, **kw
+    )
+    final_u, _ = run(spec_u, state_u, net_u, bounds_u)
+    assert _state_hash(final_f) == _state_hash(final_u)
+
+
 def test_no_overflow_when_window_auto_sized():
     spec, state, net, bounds = _overflow_world(arrival_window=None)
     auto = spec.auto_arrival_window

@@ -38,6 +38,66 @@ def test_pairwise_rank_matches_reference(K):  # grid (row_id = i*tk + iota)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.parametrize("K", [512, 1024])  # 1024 = multi-tile accumulation
+def test_fused_arrival_plan_matches_reference(K):
+    """The r6 fused decide-and-reduce kernel (rank + per-fog counts +
+    earliest (time, position) lex-min in one pass) is EXACTLY equal to
+    the jnp reference reductions — int sums and lex-mins, so tile order
+    cannot perturb it (interpret mode; opt-in on TPU)."""
+    import jax.numpy as jnp
+
+    from fognetsimpp_tpu.ops.pallas_kernels import fused_arrival_plan
+
+    F = 7
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+    mask = jax.random.bernoulli(k1, 0.6, (K,))
+    fog = jax.random.randint(k2, (K,), 0, F)
+    t = jnp.round(jax.random.uniform(k3, (K,), maxval=0.01), 4)
+    f_key = jnp.where(mask, fog, F).astype(jnp.int32)
+    t_key = jnp.where(mask, t, jnp.inf)
+
+    rank, counts, t_min, first = fused_arrival_plan(
+        mask, f_key, t_key, F, interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rank), np.asarray(_jnp_rank(mask, f_key, t_key))
+    )
+    per_fog = (f_key[None, :] == jnp.arange(F)[:, None]) & mask[None, :]
+    np.testing.assert_array_equal(
+        np.asarray(counts),
+        np.asarray(jnp.sum(per_fog, axis=1, dtype=jnp.int32)),
+    )
+    want_tmin = jnp.min(
+        jnp.where(per_fog, t_key[None, :], jnp.inf), axis=1
+    )
+    np.testing.assert_array_equal(np.asarray(t_min), np.asarray(want_tmin))
+    ids = jnp.arange(K, dtype=jnp.int32)
+    is_tmin = per_fog & (t_key[None, :] == want_tmin[:, None])
+    want_first = jnp.min(jnp.where(is_tmin, ids[None, :], K), axis=1)
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(want_first))
+
+
+def test_optin_disqualification_notes_once(monkeypatch, capsys):
+    """FNS_PALLAS_RANK / FNS_PALLAS_ARRIVAL set but disqualified (shape
+    or backend) -> ONE stderr line each, not silence (ISSUE 5)."""
+    from fognetsimpp_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setenv("FNS_PALLAS_RANK", "1")
+    monkeypatch.setenv("FNS_PALLAS_ARRIVAL", "1")
+    monkeypatch.setattr(pk, "_warned", set())
+    assert pk.pallas_rank_applicable(100) is False  # non-aligned K
+    assert pk.pallas_rank_applicable(100) is False  # second call: silent
+    assert pk.pallas_arrival_applicable(100, 4) is False
+    err = capsys.readouterr().err
+    assert err.count("FNS_PALLAS_RANK=1 requested but") == 1
+    assert err.count("FNS_PALLAS_ARRIVAL=1 requested but") == 1
+    assert "falling back to the XLA path" in err
+    # aligned shape on a CPU backend: the note names the backend
+    monkeypatch.setattr(pk, "_warned", set())
+    assert pk.pallas_rank_applicable(512) is False
+    assert "not tpu" in capsys.readouterr().err
+
+
 def test_plan_arrivals_unchanged_on_cpu():
     # on CPU the jnp path runs; sanity that the dispatch doesn't break it
     K, F = 64, 3

@@ -139,6 +139,56 @@ def test_reservoir_is_bounded_and_monotone():
     assert (np.diff(res["n_completed"]) >= 0).all()  # cumulative
 
 
+def test_run_chunked_streams_reservoir_in_order():
+    """The PR-4 follow-up: run_chunked delivers the telemetry reservoir
+    rows per chunk, in tick order, no row twice, and their union equals
+    the final reservoir — live dashboards see per-tick rows without
+    waiting for run end (and without disabling chunk donation)."""
+    from fognetsimpp_tpu.core.engine import run_chunked
+    from fognetsimpp_tpu.telemetry.metrics import (
+        RES_FIELDS,
+        telemetry_summary,
+    )
+
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_reservoir=24, horizon=1.2
+    )
+    chunk = 170  # ragged: several chunks per run, rows split unevenly
+    batches = []
+
+    def stream(rows, ticks_done):
+        assert set(rows) == set(RES_FIELDS)
+        # callback order: every delivered row's tick precedes the chunk
+        # boundary that delivered it (t is the row's end-of-tick time)
+        assert (rows["t"] <= ticks_done * spec.dt + 1e-6).all()
+        batches.append((rows, ticks_done))
+
+    final = run_chunked(
+        spec, state, net, bounds, chunk, telemetry_stream=stream
+    )
+    assert len(batches) == -(-spec.n_ticks // chunk)  # one per chunk
+    dones = [d for _, d in batches]
+    assert dones == sorted(dones)
+    t_all = np.concatenate([r["t"] for r, _ in batches])
+    assert (np.diff(t_all) > 0).all()  # in order, no duplicates
+    # union == the final reservoir, field by field
+    summ = telemetry_summary(spec, final)
+    for i, f in enumerate(RES_FIELDS):
+        got = np.concatenate([r[f] for r, _ in batches])
+        np.testing.assert_array_equal(got, summ["reservoir"][f])
+
+
+def test_run_chunked_stream_requires_telemetry():
+    from fognetsimpp_tpu.core.engine import run_chunked
+
+    spec, state, net, bounds = _build()
+    with pytest.raises(ValueError, match="telemetry_stream"):
+        run_chunked(
+            spec, state, net, bounds, 100,
+            telemetry_stream=lambda rows, done: None,
+        )
+
+
 def test_fleet_carries_telemetry_identically_to_vmap():
     """The telemetry carry rides the replica-sharded fleet scan
     bit-identically to the plain vmap path (8-virtual-device mesh)."""
@@ -311,13 +361,53 @@ def test_fleet_openmetrics_written(tmp_path):
     from fognetsimpp_tpu.runtime.recorder import record_fleet_run
     from tools.check_openmetrics import check
 
+    import re
+
     spec, state, net, bounds = _build(telemetry=True, horizon=0.2)
     batch = replicate_state(spec, state, 8, seed=0)
     final = run_fleet(spec, batch, net, bounds, make_mesh(8))
     paths = record_fleet_run(str(tmp_path), spec, final)
     text = open(paths["om"]).read()
-    assert "fns_fleet_fog_busy_fraction" in text
+    # per-replica gauges (second PR-4 follow-up): one sample per
+    # (fleet=replica, fog) pair — replicas are NOT averaged away
+    for r in range(8):
+        for f in range(spec.n_fogs):
+            assert re.search(
+                rf'^fns_fleet_fog_busy_fraction\{{fleet="{r}",fog="{f}"\}} ',
+                text, re.M,
+            ), (r, f)
+    # ...and they agree with the per-replica host computation
+    from fognetsimpp_tpu.parallel.fleet import (
+        fleet_busy_fractions_per_replica,
+    )
+
+    per = fleet_busy_fractions_per_replica(spec, final)
+    assert per.shape == (8, spec.n_fogs)
+    m = re.search(
+        r'^fns_fleet_fog_busy_fraction\{fleet="3",fog="1"\} (\S+)$',
+        text, re.M,
+    )
+    assert abs(float(m.group(1)) - per[3, 1]) <= 1e-9
     assert check(paths["om"]) == 0
+
+
+def test_openmetrics_linter_rejects_duplicate_series(tmp_path):
+    """The linter extension that came with the labelled fleet gauges:
+    two samples sharing (name, label-set) fail the lint."""
+    from tools.check_openmetrics import check
+
+    good = tmp_path / "good.om.txt"
+    good.write_text(
+        '# TYPE fns_x gauge\nfns_x{fleet="0",fog="1"} 1\n'
+        'fns_x{fleet="1",fog="1"} 2\n# EOF\n'
+    )
+    assert check(str(good)) == 0
+    bad = tmp_path / "bad.om.txt"
+    bad.write_text(
+        '# TYPE fns_x gauge\nfns_x{fleet="0",fog="1"} 1\n'
+        'fns_x{fleet="0",fog="1"} 2\n# EOF\n'
+    )
+    assert check(str(bad)) == 1
 
 
 def test_cli_telemetry_flags(tmp_path, capsys):

@@ -1,22 +1,27 @@
-"""OpenMetrics text-format lint (~20 lines): `python tools/check_openmetrics.py FILE`.
+"""OpenMetrics text-format lint: `python tools/check_openmetrics.py FILE...`.
 
 Checks the subset the telemetry exposition emits: every line is either a
 `# TYPE <name> <kind>` / `# EOF` comment or a `<name>[{labels}] <value>`
-sample with a finite decimal value, and the file ends with `# EOF`.
+sample with a finite decimal value, the file ends with `# EOF`, and —
+since the fleet exposition grew per-replica labels (r6) — no two samples
+share the same (name, label-set): duplicate series are an exposition bug
+a scraper would silently last-write-win on.
 """
 import math
 import re
 import sys
 
+LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*"'
 SAMPLE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*"'
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*")*\})? -?[0-9][0-9.eE+-]*$'
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    rf'(\{{{LABEL}(,{LABEL})*\}})? -?[0-9][0-9.eE+-]*$'
 )
 TYPE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* [a-z]+$")
 
 
 def check(path: str) -> int:
     lines = open(path).read().splitlines()
+    seen = set()
     for i, ln in enumerate(lines, 1):
         if ln == "# EOF" or TYPE.match(ln):
             continue
@@ -24,6 +29,11 @@ def check(path: str) -> int:
         if not m or not math.isfinite(float(ln.rsplit(" ", 1)[1])):
             print(f"{path}:{i}: bad OpenMetrics line: {ln!r}")
             return 1
+        series = (m.group(1), m.group(2) or "")
+        if series in seen:
+            print(f"{path}:{i}: duplicate series {m.group(1)}{series[1]}")
+            return 1
+        seen.add(series)
     if not lines or lines[-1] != "# EOF":
         print(f"{path}: missing trailing '# EOF'")
         return 1

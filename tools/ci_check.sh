@@ -20,6 +20,9 @@ cd "$(dirname "$0")/.."
 echo "== simlint =="
 python -m tools.simlint fognetsimpp_tpu
 
+echo "== op budget (fused-tick kernel-count gate) =="
+JAX_PLATFORMS=cpu python tools/op_budget.py --check > /dev/null
+
 echo "== telemetry smoke (trace export + OpenMetrics lint) =="
 TELEM_OUT="$(mktemp -d)"
 JAX_PLATFORMS=cpu python -m fognetsimpp_tpu --scenario smoke \

@@ -1,0 +1,191 @@
+"""HLO op-budget gate for the exact-ordering tick (r6).
+
+The r5 roofline work proved the dt=1 ms tick is per-op-bound, not
+bytes-bound (~35 us per serialized op slot, tools/kernel_overhead.py),
+so the kernel COUNT of the compiled tick is the throughput-critical
+quantity — and, unlike wall time, it is deterministic and checkable in
+CI.  This tool compiles the single-tick step at one pinned CPU shape,
+counts the optimized HLO module's ENTRY-computation instructions
+(everything but parameter/constant/tuple plumbing) and its fusions, and
+gates them against the checked-in budget (``tools/op_budget.json``) the
+same way simlint failures gate tier-1:
+
+  python tools/op_budget.py            # print fused/unfused counts + ratio
+  python tools/op_budget.py --check    # exit 1 on budget violation (CI)
+  python tools/op_budget.py --write    # regenerate tools/op_budget.json
+
+The budget carries three gates:
+  * ``max_ops`` / ``max_fusions`` — the fused tick's counts with slack
+    (RATIO_SLACK) for toolchain drift: an engine change that grows the
+    kernel count fails here before it lands;
+  * ``max_fused_ratio`` — fused/unfused, measured live at check time
+    (version-independent): the fused front-end must keep its >= 30%
+    kernel-count reduction (ISSUE 5 acceptance).
+
+Pinned shape: the bench world's decision path at the exact-ordering
+tick (dt = 1 ms, dense MIN_BUSY broker, two-stage arrivals,
+derive_acks, ``arrival_window=None`` so the fused no-window mode
+engages), shrunk to 256 users so the CPU compile stays in tier-1 time.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "op_budget.json")
+
+#: ENTRY instructions that are plumbing, not kernels.
+_TRIVIAL = ("parameter", "constant", "get-tuple-element", "tuple",
+            "bitcast", "copy")
+
+#: Slack over the recorded fused counts before --check fails (absolute
+#: counts drift a little across XLA versions; the ratio gate does not).
+COUNT_SLACK = 1.10
+
+#: The acceptance bar: fused must compile to <= this fraction of the
+#: unfused tick's kernels at the pinned shape.
+MAX_FUSED_RATIO = 0.70
+
+PINNED = dict(
+    n_users=256,
+    n_fogs=8,
+    fog_mips=(1000.0, 2000.0, 3000.0, 4000.0),
+    send_interval=2.5e-3,
+    dt=1e-3,
+    horizon=0.02,
+    max_sends_per_user=12,
+    queue_capacity=32,
+    arrival_window=None,
+    derive_acks=True,
+)
+
+
+def _build():
+    from fognetsimpp_tpu.scenarios import smoke
+
+    return smoke.build(**PINNED)
+
+
+def entry_op_counts(hlo_text: str) -> dict:
+    """Count the optimized module's ENTRY-computation instructions.
+
+    Returns {"ops": nontrivial instruction count, "fusions": fusion
+    count} — "ops" approximates the serialized kernel slots the r5
+    calibration priced at ~35 us each.
+    """
+    m = re.search(r"^ENTRY [^{]+\{(.*?)^\}", hlo_text, re.M | re.S)
+    if not m:
+        raise ValueError("no ENTRY computation in HLO text")
+    ops = []
+    for line in m.group(1).splitlines():
+        g = re.search(r"= \S+? ([a-z0-9\-]+)\(", line)
+        if g and g.group(1) not in _TRIVIAL:
+            ops.append(g.group(1))
+    return {"ops": len(ops), "fusions": ops.count("fusion")}
+
+
+def compile_tick_counts(fused: bool) -> dict:
+    """Compile one tick of the pinned world and count its HLO ops."""
+    import jax
+
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.net.topology import associate
+
+    spec, state, net, bounds = _build()
+    spec = dataclasses.replace(spec, fused_slots=fused).validate()
+    step = make_step(spec)
+    cache = associate(
+        net, state.nodes.pos, state.nodes.alive, broker=spec.broker_index
+    )
+    compiled = jax.jit(
+        lambda s: step(s, net, bounds, cache)
+    ).lower(state).compile()
+    return entry_op_counts(compiled.as_text())
+
+
+def measure() -> dict:
+    fused = compile_tick_counts(fused=True)
+    unfused = compile_tick_counts(fused=False)
+    return {
+        "shape": {k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in PINNED.items()},
+        "fused": fused,
+        "unfused": unfused,
+        "ratio": {
+            k: round(fused[k] / max(unfused[k], 1), 4)
+            for k in ("ops", "fusions")
+        },
+        "max_ops": int(fused["ops"] * COUNT_SLACK),
+        "max_fusions": int(fused["fusions"] * COUNT_SLACK),
+        "max_fused_ratio": MAX_FUSED_RATIO,
+    }
+
+
+def check(measured: dict, budget: dict) -> list:
+    """Gate ``measured`` against ``budget``; returns failure strings."""
+    errs = []
+    for k, cap_key in (("ops", "max_ops"), ("fusions", "max_fusions")):
+        got = measured["fused"][k]
+        cap = budget[cap_key]
+        if got > cap:
+            errs.append(
+                f"fused tick {k} regressed: {got} > budget {cap} "
+                f"(regenerate with --write ONLY if the growth is "
+                f"justified and reviewed)"
+            )
+    cap = budget.get("max_fused_ratio", MAX_FUSED_RATIO)
+    # the ratio gate runs on "ops" — the serialized-kernel-slot count the
+    # r5 ~35 us/op calibration prices; "fusions" is recorded (and capped
+    # absolutely above) but not ratio-gated, since fusion granularity is
+    # an XLA partitioning choice, not a kernel-slot count
+    ratio = measured["fused"]["ops"] / max(measured["unfused"]["ops"], 1)
+    if ratio > cap:
+        errs.append(
+            f"fused/unfused ops ratio {ratio:.3f} > {cap} — the "
+            f"fused front-end lost its kernel-count reduction"
+        )
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate the checked-in budget file")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless counts are within the budget")
+    ap.add_argument("--budget", default=BUDGET_PATH,
+                    help="budget file path (default: tools/op_budget.json)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    measured = measure()
+    print(json.dumps(measured, indent=1))
+    if args.write:
+        with open(args.budget, "w") as f:
+            json.dump(measured, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.budget}", file=sys.stderr)
+        return 0
+    if args.check:
+        if not os.path.exists(args.budget):
+            print(f"missing budget file {args.budget} (run --write)",
+                  file=sys.stderr)
+            return 1
+        with open(args.budget) as f:
+            budget = json.load(f)
+        errs = check(measured, budget)
+        for e in errs:
+            print(f"op_budget: {e}", file=sys.stderr)
+        return 1 if errs else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,10 @@ def main():
     win = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
     spec, state, net, bounds = build(n_users, 1e-3)
     import dataclasses
-    spec = dataclasses.replace(spec, arrival_window=win)
+    # this tool bisects the r5 REFERENCE arrival path: pin the fused
+    # front-end off so the monkeypatched internals actually trace
+    spec = dataclasses.replace(spec, arrival_window=win,
+                               fused_slots=False)
     print(f"users={n_users} K={spec.window} T={spec.task_capacity} "
           f"R={spec.arrival_cands}")
     base, c = time_scan(spec, state, net, bounds)
@@ -34,7 +37,7 @@ def main():
         print(f"- {name:22s} {ms:8.3f} ms/tick   marginal {base - ms:+.3f}")
 
     # 1. rank/plan: constant plan (wrong but shape-correct)
-    def fake_plan(mask, fog, t, F, idle, per_fog=None):
+    def fake_plan(mask, fog, t, F, idle, per_fog=None, **_kw):
         K = mask.shape[0]
         return Q.ArrivalPlan(
             assign_task=jnp.full((F,), Q.NO_TASK, jnp.int32),
@@ -49,7 +52,7 @@ def main():
     patched("batched_enqueue", E, "batched_enqueue", fake_enq)
 
     # 3. whole tail
-    def fake_tail(spec_, state_, cache, buf, tasks, fogs, *a):
+    def fake_tail(spec_, state_, cache, buf, tasks, fogs, *a, **_kw):
         return state_.replace(tasks=tasks, fogs=fogs), buf
     patched("tail(all)", E, "_fog_arrivals_tail", fake_tail)
 

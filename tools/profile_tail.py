@@ -10,7 +10,7 @@ from tools.profile_tick import build, time_scan
 
 def make_tail(do_assign, do_queue, do_busy, do_bufm):
     def tail(spec, state, cache, buf, tasks, fogs, idx, idxc, valid,
-             fog_g, t_af_g, mips_g, user_g, n_fast, n_fast_f):
+             fog_g, t_af_g, mips_g, user_g, n_fast, n_fast_f, **_kw):
         T, F, K = spec.task_capacity, spec.n_fogs, spec.window
         U = spec.n_users
         i32 = jnp.int32
@@ -88,7 +88,10 @@ def main():
     enable_compile_cache()
     n_users = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
     spec, state, net, bounds = build(n_users, 1e-3)
-    spec = dataclasses.replace(spec, arrival_window=4096)
+    # the bisection targets the r5 reference tail: pin the fused
+    # front-end off so the monkeypatched tails actually run
+    spec = dataclasses.replace(spec, arrival_window=4096,
+                               fused_slots=False)
     base, c = time_scan(spec, state, net, bounds)
     print(f"full: {base:7.3f} ms/tick (compile {c:.0f}s)")
     orig = E._fog_arrivals_tail
