@@ -75,6 +75,12 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
     policy = policy_from_name(os.environ.get("BENCH_POLICY", "min_busy"))
 
     telemetry = os.environ.get("BENCH_TELEMETRY", "") not in ("", "0")
+    # BENCH_HIST=1 additionally carries the streaming latency histogram
+    # (spec.telemetry_hist; implies telemetry) — the ISSUE 6 overhead
+    # A/B knob: interleave BENCH_TELEMETRY=1 and BENCH_HIST=1 runs for
+    # the histogram-on-top-of-telemetry cost BENCHMARKS.md quotes
+    hist = os.environ.get("BENCH_HIST", "") not in ("", "0")
+    telemetry = telemetry or hist
     # BENCH_FUSED=0 forces the unfused per-phase reference engine — the
     # A/B knob for the r6 fused slot-window front-end (interleave 0/1
     # runs for the off/on comparison, the BENCH_TELEMETRY methodology)
@@ -82,6 +88,7 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
     mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
     build_kw = dict(
         telemetry=telemetry,
+        telemetry_hist=hist,
         fused_slots=fused,
         n_users=n_users,
         n_fogs=n_fogs,
@@ -97,8 +104,9 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
         # ack columns reconstructed once post-run (bit-exact; r5): the
         # per-tick scatters they cost are ~25 us each on the v5e —
         # except for the learned policies, which must observe the
-        # status-6 ack inside the tick to credit their rewards
-        derive_acks=policy not in LEARNED_POLICIES,
+        # status-6 ack inside the tick to credit their rewards, and the
+        # streaming histogram, which bins them at ack time (ISSUE 6)
+        derive_acks=policy not in LEARNED_POLICIES and not hist,
     )
     # default window: the K=4096 O(K^2)-rank sweet spot — warm-up
     # overflow defers to later windows (counted in n_deferred) and
@@ -119,7 +127,7 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
     knobs = dict(
         n_users=n_users, n_fogs=n_fogs, horizon=horizon,
         interval=interval, dt=dt, policy=policy, telemetry=telemetry,
-        fused=fused,
+        hist=hist, fused=fused,
     )
     return spec, state, net, bounds, knobs
 
@@ -197,10 +205,13 @@ def main() -> None:
     )
 
     # compile + warm
+    from fognetsimpp_tpu.compile_cache import compile_stats, note_compile
+
     keys0 = jax.random.split(jax.random.PRNGKey(0), n_pipeline)
     t_c0 = time.perf_counter()
     fetch(go(keys0))
     compile_s = time.perf_counter() - t_c0
+    note_compile(compile_s)  # compile-latency observability (ISSUE 6)
 
     walls, decs, defs = [], [], []
     with profile_trace(prof_dir) as prof:
@@ -247,7 +258,16 @@ def main() -> None:
                 # every window was fully current (Metrics.n_deferred_max)
                 "n_deferred_max": max(defs),
                 "compile_s": round(compile_s, 1),
+                # compile-latency observability (ISSUE 6): persistent-
+                # cache hit/miss + backend compile seconds — the
+                # streaming serving mode's blocker, tracked per capture
+                # (tools/bench_trend.py tabulates compile_s per round)
+                "compile_cache": {
+                    k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in compile_stats().items()
+                },
                 "telemetry": knobs["telemetry"],
+                "telemetry_hist": knobs["hist"],
                 "fidelity": "count-exact vs dt=1e-3; tests/test_coarse_dt.py",
                 # --profile extras: where the XLA trace landed plus the
                 # flat per-call dispatch+fetch cost the pipeline

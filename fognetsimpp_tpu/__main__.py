@@ -62,6 +62,34 @@ def main(argv=None) -> int:
                     "shorthand for spec.telemetry=true — adds the "
                     "per-fog gauges to .sca.json and the OpenMetrics "
                     "output")
+    ap.add_argument("--hist", action="store_true",
+                    help="carry the device-resident streaming latency "
+                    "histogram (per-fog log buckets of the task_time "
+                    "signal) through the scan; shorthand for "
+                    "spec.telemetry_hist=true (implies --telemetry) — "
+                    "adds '# TYPE histogram' series and p50/p95/p99 "
+                    "quantile gauges to the OpenMetrics output and "
+                    "lat_* rows to .sca.json")
+    ap.add_argument("--serve", type=int, metavar="PORT", default=None,
+                    help="live health plane (telemetry/live.py): run "
+                    "the horizon in chunks behind an OpenMetrics pull "
+                    "endpoint (GET /metrics, GET /healthz) with an "
+                    "EWMA z-score watchdog on queue depth / drop rate "
+                    "/ busy fraction; 0 binds an ephemeral port; "
+                    "implies --telemetry")
+    ap.add_argument("--serve-chunk", type=int, metavar="N", default=1000,
+                    help="ticks per serving chunk (default 1000): the "
+                    "scrape/watchdog refresh granularity")
+    ap.add_argument("--slo", type=float, metavar="MS", default=None,
+                    help="task-latency SLO in milliseconds: breaches "
+                    "derive from the streaming histogram (implies "
+                    "--hist) and trip the flight recorder under "
+                    "--serve")
+    ap.add_argument("--postmortem", metavar="DIR", default=None,
+                    help="flight-recorder dump directory: on NaN, SLO "
+                    "breach, watchdog anomaly or crash the serving "
+                    "loop writes a post-mortem bundle here (inspect "
+                    "with tools/postmortem.py)")
     ap.add_argument("--trace-out", metavar="JSON", default=None,
                     help="export the run's task-lifecycle spans as "
                     "Chrome/Perfetto trace-event JSON to this path "
@@ -152,8 +180,11 @@ def main(argv=None) -> int:
         pre.append("spec.record_tick_series = true")
     if args.trails:
         pre.append("spec.record_trails = true")
-    if args.telemetry:
+    if args.telemetry or args.serve is not None:
         pre.append("spec.telemetry = true")
+    if args.hist or args.slo is not None:
+        pre.append("spec.telemetry = true")
+        pre.append("spec.telemetry_hist = true")
     cfg = Config.from_str("\n".join(pre) + "\n" + text)
 
     if args.sweep:
@@ -169,6 +200,10 @@ def main(argv=None) -> int:
             ap.error("--sweep returns counter grids, not a final "
                      "world; --telemetry/--trace-out/--profile apply "
                      "to single-scenario runs")
+        if args.serve is not None or args.slo is not None or args.hist:
+            ap.error("--sweep returns counter grids, not a live "
+                     "world; --serve/--slo/--hist apply to "
+                     "single-scenario runs")
         if args.replicas is not None or args.mesh is not None:
             ap.error("--sweep owns its own replica fan-out (reps=); "
                      "--replicas/--mesh apply to single-scenario runs")
@@ -324,6 +359,67 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    if args.serve is not None:
+        # ---- live health plane (telemetry/live.py, ISSUE 6) -----------
+        if args.progress or args.ticks or args.trails:
+            ap.error("--serve owns the chunking (--serve-chunk); "
+                     "--progress/--ticks/--trails do not apply")
+        if args.replicas is not None or args.mesh is not None:
+            ap.error("--serve is a single-world loop; fleet serving is "
+                     "a follow-up (run --replicas without --serve)")
+        from .telemetry.live import serve_run
+
+        t0 = time.perf_counter()
+
+        def _announce(health):
+            # one status line per chunk, the Cmdenv-progress analog
+            print(json.dumps(health), flush=True)
+
+        final, status = serve_run(
+            spec, state, net, bounds,
+            chunk_ticks=args.serve_chunk,
+            port=args.serve,
+            slo_ms=args.slo,
+            dump_dir=args.postmortem,
+            on_chunk=_announce,
+        )
+        wall = time.perf_counter() - t0
+        out = {
+            "scenario": cfg.lookup("scenario", "smoke"),
+            "wall_s": round(wall, 3),
+            "port": status["port"],
+            "chunks": status["chunks"],
+            "anomalies": status["anomalies"],
+            "slo_breaches": status["slo_breaches"],
+            "dumps": status["dumps"],
+        }
+        outdir = args.out or cfg.lookup("output.dir")
+        if outdir:
+            run_id = args.run_id or cfg.lookup("output.run_id", "General-0")
+            out.update(record_run(
+                outdir, spec, final, run_id=run_id,
+                attrs={
+                    "argv": sys.argv[1:] if argv is None else list(argv),
+                    "scenario": cfg.lookup("scenario", "smoke"),
+                    "served_port": status["port"],
+                },
+            ))
+        if args.trace_out:
+            from .telemetry.timeline import export_trace
+
+            out["trace"] = export_trace(
+                spec, final, args.trace_out,
+                max_tasks=args.trace_max_tasks or None,
+            )
+        s = summarize(final)
+        out.update(
+            n_published=s["n_published"], n_completed=s["n_completed"],
+        )
+        if status["server"] is not None:
+            status["server"].close()
+        print(json.dumps(out))
+        return 0
+
     if args.replicas is not None or args.mesh is not None:
         # ---- replica-sharded fleet run (parallel/fleet.py) ------------
         if args.progress:
@@ -473,6 +569,21 @@ def main(argv=None) -> int:
         task_time_mean_ms=round(s["task_time_mean_ms"], 3)
         if s["task_time_mean_ms"] == s["task_time_mean_ms"] else None,
     )
+    if spec.telemetry_hist:
+        # streaming-histogram roll-up on the one-line summary (the same
+        # hist_summary() the recorder and OpenMetrics read)
+        from .telemetry.health import hist_summary, slo_breach_count
+
+        hist = hist_summary(spec, final)
+        out["lat_quantiles_ms"] = {
+            k: (round(v, 3) if v == v else None)
+            for k, v in hist["quantiles_ms"].items()
+        }
+        if args.slo is not None:
+            out["slo_ms"] = args.slo
+            out["slo_breaches"] = slo_breach_count(
+                spec, final, args.slo, summ=hist
+            )
     print(json.dumps(out))
     return 0
 

@@ -17,7 +17,92 @@ from __future__ import annotations
 import hashlib
 import os
 import platform
-from typing import Optional
+import threading
+from typing import Dict, Optional
+
+# ----------------------------------------------------------------------
+# compile-latency observability (ISSUE 6): the ROADMAP's streaming
+# serving mode is blocked on 8-56 s compiles vs sub-second run walls, so
+# hit/miss/compile-seconds become first-class metrics — surfaced in the
+# bench JSON, the OpenMetrics exposition and the flight recorder.
+# ----------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_STATS: Dict[str, float] = {
+    "cache_hits": 0,  # persistent-cache executable loads
+    "cache_misses": 0,  # compiles the cache could not serve
+    "compiles": 0,  # backend compile events observed
+    "compile_s_total": 0.0,  # wall seconds spent compiling
+    "compile_s_max": 0.0,  # worst single compile
+}
+_CACHE_DIR: Optional[str] = None
+_LISTENING = False
+
+
+def _on_event(event: str, **kw) -> None:
+    with _LOCK:
+        if event.endswith("cache_hits") or event.endswith("cache_hit"):
+            _STATS["cache_hits"] += 1
+        elif event.endswith("cache_misses") or event.endswith("cache_miss"):
+            _STATS["cache_misses"] += 1
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    if "compile" not in event or "saved" in event:
+        return
+    with _LOCK:
+        _STATS["compiles"] += 1
+        _STATS["compile_s_total"] += float(duration)
+        _STATS["compile_s_max"] = max(
+            _STATS["compile_s_max"], float(duration)
+        )
+
+
+def _ensure_listeners() -> None:
+    """Register the jax.monitoring listeners once (idempotent; a jax
+    without the monitoring API degrades to manual :func:`note_compile`
+    accounting only)."""
+    global _LISTENING
+    if _LISTENING:
+        return
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _LISTENING = True
+    except Exception:
+        pass
+
+
+def note_compile(seconds: float, cache_hit: Optional[bool] = None) -> None:
+    """Manual accounting entry for callers that time their own cold
+    calls (``bench.py`` ``compile_s``, the live loop's first chunk) —
+    the fallback when the monitoring listeners are unavailable, and the
+    place wall-clock truth (trace + compile + dispatch) is recorded
+    next to the listener's pure-compile seconds."""
+    with _LOCK:
+        _STATS.setdefault("noted_compiles", 0)
+        _STATS.setdefault("noted_compile_s_total", 0.0)
+        _STATS["noted_compiles"] += 1
+        _STATS["noted_compile_s_total"] += float(seconds)
+        if cache_hit is not None:
+            key = "cache_hits" if cache_hit else "cache_misses"
+            _STATS[key] += 1
+
+
+def compile_stats() -> Dict:
+    """Snapshot of the process's compile-latency counters.
+
+    Keys: ``cache_hits`` / ``cache_misses`` (persistent-cache events),
+    ``compiles`` / ``compile_s_total`` / ``compile_s_max`` (backend
+    compile durations from jax.monitoring), the ``noted_*`` manual
+    entries, plus ``cache_dir`` (None when the cache is disabled).
+    """
+    with _LOCK:
+        out: Dict = dict(_STATS)
+    out["cache_dir"] = _CACHE_DIR
+    return out
 
 
 def _host_tag() -> str:
@@ -35,6 +120,8 @@ def _host_tag() -> str:
 
 
 def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    global _CACHE_DIR
+    _ensure_listeners()  # compile stats flow even when the cache is off
     env = os.environ.get("FNS_JIT_CACHE")
     if env is not None and env.strip().lower() in ("off", "0", "false", ""):
         return None
@@ -57,4 +144,5 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
     except OSError:
         # pure optimization: an unwritable cache dir degrades to no cache
         return None
+    _CACHE_DIR = path
     return path

@@ -199,6 +199,13 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             when=lambda sp: sp.telemetry,
         ),
         PhaseContract(
+            "_phase_latency_hist",
+            lambda sp, s, n, c, b, t0, t1: E._phase_latency_hist(
+                sp, s, n, c, b, t1
+            ),
+            when=lambda sp: sp.telemetry_hist,
+        ),
+        PhaseContract(
             "_phase_local_completions",
             lambda sp, s, n, c, b, t0, t1: E._phase_local_completions(
                 sp, s, n, c, b, t1
@@ -320,6 +327,12 @@ def check_telemetry_contract(spec: WorldSpec, state) -> None:
         "busy_ticks": (F,), "pool_occ_sum": (F,), "pick_hist": (F,),
         "phase_work": (P,), "res": (R, len(RES_FIELDS)),
         "ticks": (), "defer_sum": (),
+        # streaming latency histogram (ISSUE 6): zero-row unless the
+        # spec.telemetry_hist gate is on — its OWN gate, nested inside
+        # spec.telemetry, so plain-telemetry worlds stay unchanged
+        "lat_hist": (spec.telemetry_hist_fogs, spec.telemetry_hist_nbins),
+        "lat_sum": (spec.telemetry_hist_fogs,),
+        "lat_seen": (spec.telemetry_hist_tasks,),
     }
     for name, shape in expect.items():
         got = tuple(getattr(t, name).shape)

@@ -59,6 +59,7 @@ from ..ops.queues import (
 from ..ops.sched import scalar_winner, schedule_batch, task_uniform
 from ..spec import STATIC_MAC_ERR, FogModel, Policy, Stage, WorldSpec
 from ..state import WorldState
+from ..telemetry.health import accumulate_latency
 from ..telemetry.metrics import PHASE_INDEX, accumulate_tick, tick_activity
 
 # Stage tags as hoisted int8 scalar constants (simlint R7): the hot phases
@@ -2665,6 +2666,25 @@ def _phase_learn_credit(
     return state.replace(learn=learn), buf
 
 
+def _phase_latency_hist(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Streaming latency-histogram accumulation (telemetry/health.py).
+
+    Folds every task whose status-6 ack has reached the client by
+    ``t1`` — and that the persistent ``lat_seen`` flag has not counted
+    yet — into the per-fog log-bucket histogram riding
+    :class:`TelemetryState`.  Statically gated on
+    ``spec.telemetry_hist``: worlds without the health plane trace none
+    of this and stay bit-exact (tests/test_health.py).  Pure carry
+    endomorphism, so it rides the scan and the fleet ``vmap``
+    unchanged.
+    """
+    telem = accumulate_latency(spec, state.telem, state.tasks, t1)
+    return state.replace(telem=telem), buf
+
+
 def _phase_telemetry(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t1: jax.Array,
@@ -3023,6 +3043,11 @@ def make_step(
             # delayed-reward credit: after completions/arrivals so a
             # status-6 ack that lands inside this tick credits this tick
             _ph("learn_credit", lambda: _phase_learn_credit(
+                spec, state, net, cache, buf, t1))
+        if spec.telemetry_hist:
+            # streaming latency histogram: after completions/acks so a
+            # status-6 ack landing inside this tick streams this tick
+            _ph("latency_hist", lambda: _phase_latency_hist(
                 spec, state, net, cache, buf, t1))
 
         # 7b. flat per-node views of this tick's message counts, feeding

@@ -195,6 +195,24 @@ def fleet_busy_fractions_per_replica(
     return busy / ticks[:, None]
 
 
+def fleet_latency_hist(
+    spec: WorldSpec, final_batch: WorldState
+) -> Optional[Dict]:
+    """Replica-MERGED streaming latency histogram of a finished fleet
+    run (ISSUE 6): one host gather of the ``(R, F, B)`` bucket counts,
+    summed over the replica axis into the same summary dict a
+    single-world run produces (:func:`telemetry.health.hist_summary`
+    detects the leading axis itself) — per-fog counts, ``p50/p95/p99``
+    quantiles, sums.  The fleet's OpenMetrics exposition renders this
+    as the ``fns_fleet_task_latency`` histogram family
+    (``runtime/recorder.record_fleet_run``).  ``None`` when
+    ``spec.telemetry_hist`` was off.
+    """
+    from ..telemetry.health import hist_summary
+
+    return hist_summary(spec, final_batch)
+
+
 def fleet_busy_fractions(
     spec: WorldSpec, final_batch: WorldState
 ) -> Optional[np.ndarray]:

@@ -59,7 +59,9 @@ def dict_to_spec(d: Dict) -> WorldSpec:
     return WorldSpec(**d).validate()
 
 
-def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
+def per_module_scalars(
+    spec: WorldSpec, final: WorldState, hist: Optional[Dict] = None
+) -> Dict:
     """Per-module scalar rows: the reference's per-host ``.sca`` section.
 
     OMNeT++ records scalars per module path (the example run has ~1.5k
@@ -103,6 +105,15 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
 
     telem = telemetry_summary(spec, final)
     busy_frac = telem["busy_frac"] if telem is not None else None
+    # streaming latency histogram (ISSUE 6): the per-fog quantile rows
+    # come from hist_summary() — the SAME call the OpenMetrics quantile
+    # gauges read, so .sca.json and the scrape agree exactly (record_run
+    # computes the dict once and passes it in; standalone callers derive
+    # it here)
+    if hist is None:
+        from ..telemetry.health import hist_summary
+
+        hist = hist_summary(spec, final)
     # stack-level rows (r2 missing #4): per-node message counters — the
     # "packets sent"/"packets received" and per-NIC traffic rows of the
     # reference's ~1.5k-scalar .sca — plus per-AP association occupancy.
@@ -158,6 +169,21 @@ def per_module_scalars(spec: WorldSpec, final: WorldState) -> Dict:
                     "q_len_peak": int(telem["q_len_max"][f]),
                 }
                 if telem is not None
+                else {}
+            ),
+            # streaming latency-histogram rows (spec.telemetry_hist)
+            **(
+                {
+                    "lat_count": int(hist["per_fog_count"][f]),
+                    "lat_sum_ms": float(hist["per_fog_sum_ms"][f]),
+                    **{
+                        f"lat_{q}_ms": float(vec[f])
+                        for q, vec in hist[
+                            "per_fog_quantiles_ms"
+                        ].items()
+                    },
+                }
+                if hist is not None
                 else {}
             ),
         }
@@ -218,13 +244,40 @@ def record_run(
     sca_path = os.path.join(outdir, f"{run_id}.sca.json")
     vec_path = os.path.join(outdir, f"{run_id}.vec.npz")
 
+    from ..compile_cache import compile_stats
+    from ..telemetry.health import hist_summary
+
+    hist = hist_summary(spec, final)
     sca = {
         "run": run_id,
         "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
         "attrs": attrs or {},
         "spec": spec_to_dict(spec),
         "scalars": summarize(final),
-        "modules": per_module_scalars(spec, final),
+        "modules": per_module_scalars(spec, final, hist=hist),
+        # compile-latency observability (ISSUE 6): hit/miss/compile
+        # seconds next to the run scalars, same keys as the OpenMetrics
+        # fns_compile_* families
+        "compile_cache": compile_stats(),
+        # global latency-histogram roll-up (spec.telemetry_hist): the
+        # quantiles are hist_summary()'s — identical to the OpenMetrics
+        # quantile gauges by construction
+        **(
+            {
+                "hist": {
+                    "count": hist["count"],
+                    "sum_ms": hist["sum_ms"],
+                    "edges_ms": [float(e) for e in hist["edges_ms"]],
+                    "counts": hist["counts"].tolist(),
+                    "quantiles_ms": {
+                        k: float(v)
+                        for k, v in hist["quantiles_ms"].items()
+                    },
+                }
+            }
+            if hist is not None
+            else {}
+        ),
     }
     # RFC-8259-valid output (ADVICE r2): summarize() yields nan means for
     # empty signal vectors and json.dump would emit literal NaN tokens —
@@ -248,7 +301,7 @@ def record_run(
     from ..telemetry.openmetrics import write_openmetrics
 
     paths["om"] = write_openmetrics(
-        os.path.join(outdir, f"{run_id}.om.txt"), spec, final
+        os.path.join(outdir, f"{run_id}.om.txt"), spec, final, hist=hist
     )
     if scave:
         from .scave import NETWORK_NAMES, export_scave
@@ -324,8 +377,13 @@ def record_fleet_run(
     the caller already gathered the counters (the CLI does, for its JSON
     summary) so the host gather is not repeated.
     """
+    from ..parallel.fleet import fleet_latency_hist
+
     os.makedirs(outdir, exist_ok=True)
     sca_path = os.path.join(outdir, f"{run_id}.fleet.sca.json")
+    # replica-merged latency histogram (ISSUE 6): the documented fleet
+    # API (sums the leading replica axis of the batched TelemetryState)
+    hist = fleet_latency_hist(spec, final_batch)
     sca = {
         "run": run_id,
         "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -334,6 +392,20 @@ def record_fleet_run(
         "fleet": (
             scalars if scalars is not None
             else fleet_scalars(spec, final_batch)
+        ),
+        **(
+            {
+                "hist": {
+                    "count": hist["count"],
+                    "sum_ms": hist["sum_ms"],
+                    "quantiles_ms": {
+                        k: float(v)
+                        for k, v in hist["quantiles_ms"].items()
+                    },
+                }
+            }
+            if hist is not None
+            else {}
         ),
     }
     with open(sca_path, "w") as f:
@@ -355,6 +427,7 @@ def record_fleet_run(
             render_fleet_openmetrics(
                 sca["fleet"],
                 fleet_busy_fractions_per_replica(spec, final_batch),
+                hist=hist,
             )
         )
     paths["om"] = om_path

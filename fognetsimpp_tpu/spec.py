@@ -470,6 +470,23 @@ class WorldSpec:
     # horizon, the run_fleet_series discipline without per-chunk host
     # offload.
     telemetry_reservoir: int = 256
+    # --- live health plane (telemetry/health.py, ISSUE 6) --------------
+    # Device-resident streaming latency histogram: per-fog log-spaced
+    # bucket counts of the task_time signal (publish -> status-6 ack),
+    # accumulated inside the scan carry by core/engine._phase_latency_
+    # hist and folded into p50/p95/p99 + SLO-breach counters on host.
+    # Off (the default) keeps every histogram leaf zero-row and the run
+    # bit-exact vs the histogram-less engine — the same gate discipline
+    # as spec.telemetry itself (tests/test_health.py A/Bs it).
+    # Requires spec.telemetry (the leaves ride TelemetryState).
+    telemetry_hist: bool = False
+    # Log-spaced bucket count: bucket b covers (edge[b-1], edge[b]] with
+    # edges geometric between the min/max bounds below; the last bucket
+    # is the +Inf overflow.  Fixed at trace time, so the carry shape
+    # never depends on data.
+    telemetry_hist_bins: int = 24
+    telemetry_hist_min_ms: float = 0.1  # lowest finite bucket edge
+    telemetry_hist_max_ms: float = 10_000.0  # highest finite bucket edge
 
     # --- misc ----------------------------------------------------------
     bug_compat: BugCompat = BugCompat()
@@ -578,6 +595,32 @@ class WorldSpec:
         return max(1, min(self.telemetry_reservoir, self.n_ticks))
 
     @property
+    def telemetry_hist_fogs(self) -> int:
+        """Rows of the per-fog latency-histogram leaves (0 when off)."""
+        return self.n_fogs if (self.telemetry and self.telemetry_hist) else 0
+
+    @property
+    def telemetry_hist_nbins(self) -> int:
+        """Columns of the latency histogram (0 when off; the last
+        column is the +Inf overflow bucket)."""
+        if not (self.telemetry and self.telemetry_hist):
+            return 0
+        return self.telemetry_hist_bins
+
+    @property
+    def telemetry_hist_tasks(self) -> int:
+        """Rows of the per-task counted flag that makes the streaming
+        histogram exactly-once (0 when off).  A completion backlog can
+        ack a task whose ``t_ack6`` already lies behind the tick window
+        (the learn-credit problem, PR 2), so the trigger is a persistent
+        flag, not a time-interval test."""
+        return (
+            self.task_capacity
+            if (self.telemetry and self.telemetry_hist)
+            else 0
+        )
+
+    @property
     def auto_arrival_window(self) -> int:
         """Window sized from the spec's own arrival rate (VERDICT r3 #4).
 
@@ -608,6 +651,23 @@ class WorldSpec:
             "telemetry_reservoir sizes the per-tick sample reservoir "
             "(>= 1 row)"
         )
+        if self.telemetry_hist:
+            assert self.telemetry, (
+                "telemetry_hist rides TelemetryState in the scan carry: "
+                "set spec.telemetry=True as well"
+            )
+            assert self.telemetry_hist_bins >= 2, (
+                "the latency histogram needs >= 2 buckets (the last is "
+                "the +Inf overflow)"
+            )
+            assert (
+                0.0 < self.telemetry_hist_min_ms < self.telemetry_hist_max_ms
+            ), "histogram bounds must satisfy 0 < min_ms < max_ms"
+            assert not self.derive_acks, (
+                "telemetry_hist streams latencies at status-6 ack time "
+                "inside the tick; derive_acks reconstructs the ack "
+                "columns only after the scan"
+            )
         if self.assume_static:
             assert not self.energy_enabled, (
                 "assume_static promises constant (pos, alive); the energy "

@@ -27,10 +27,28 @@ observed what happens *inside* the jitted tick loop.  Three planes:
   ``runtime/recorder.py`` and the fleet runner's replica-aggregated
   recording.
 
-Only :mod:`.metrics` is imported here: the exporter modules import
-``state``/``recorder`` and would otherwise cycle with ``state.py``'s
-``TelemetryState`` import.
+* **Live health plane** (:mod:`.health`, :mod:`.live`, ISSUE 6): a
+  device-resident streaming latency histogram (per-fog log buckets of
+  the task_time signal, ``spec.telemetry_hist``, zero-row when off)
+  from which p50/p95/p99 and SLO-breach counters derive on host; a
+  serving loop over ``run_chunked`` exposing the OpenMetrics text —
+  histogram series and quantile gauges included — behind a stdlib
+  ``http.server`` pull endpoint with an EWMA z-score watchdog; a
+  bounded flight recorder that dumps post-mortem bundles on NaN / SLO
+  breach / anomaly / crash (``tools/postmortem.py`` inspects them);
+  and compile-latency stats (``compile_cache.compile_stats``) in every
+  exposition.
+
+Only :mod:`.metrics` and :mod:`.health`'s device half are imported
+here: the exporter modules import ``state``/``recorder`` and would
+otherwise cycle with ``state.py``'s ``TelemetryState`` import.
 """
+from .health import (  # noqa: F401
+    QUANTILES,
+    hist_edges_s,
+    hist_summary,
+    slo_breach_count,
+)
 from .metrics import (  # noqa: F401
     PHASES,
     RES_FIELDS,

@@ -1,0 +1,229 @@
+"""Live health plane, device half: streaming latency histograms.
+
+FogMQ-style always-on broker fleets (arXiv:1610.00620) live or die by
+continuous health monitoring, and iFogSim (arXiv:1606.02007) reports
+latency *distributions*, not just means — but until this module the
+repo's latency story was post-run sample vectors only
+(``runtime/signals.py``).  Here the ``task_time`` signal (publish →
+status-6 "performed" ack) streams into a **device-resident, per-fog,
+log-spaced-bucket histogram** carried in
+:class:`~fognetsimpp_tpu.telemetry.metrics.TelemetryState`: fixed
+shapes, zero rows when ``spec.telemetry_hist`` is off (the PR-4
+bit-exactness gate discipline), accumulated once per tick by
+``core/engine._phase_latency_hist``.
+
+Exactly-once: a completion backlog can ack a task whose ``t_ack6``
+already lies *behind* the current tick window (the same late-credit
+hazard the PR-2 learn-credit phase handles), so the trigger is a
+persistent per-task ``lat_seen`` flag, not a time-interval test — no
+sample is ever lost or double-counted, on any engine path
+(run/run_jit/run_chunked/fleet).
+
+Host half: :func:`hist_summary` is the SINGLE source of the derived
+p50/p95/p99 quantiles — the recorder's ``.sca.json`` fog rows and the
+OpenMetrics exposition both call it, so the two outputs agree exactly
+(the ISSUE 6 acceptance gate asserts 1e-6), the
+``telemetry.metrics.busy_fractions`` discipline.  SLO-breach counters
+derive from the same cumulative bucket counts
+(:func:`slo_breach_count`); the bucket edge containing the threshold is
+the snap point, documented there.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..spec import Stage, WorldSpec
+
+#: Quantiles the health plane derives and exposes, everywhere (recorder
+#: fog rows, OpenMetrics gauges, the live /healthz endpoint).
+QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+_ST_DONE = np.int8(int(Stage.DONE))
+
+
+def hist_edges_s(spec: WorldSpec) -> np.ndarray:
+    """The histogram's finite bucket upper bounds, in SECONDS.
+
+    ``B - 1`` log-spaced edges between ``telemetry_hist_min_ms`` and
+    ``telemetry_hist_max_ms``; bucket ``b`` counts latencies in
+    ``(edge[b-1], edge[b]]`` and bucket ``B-1`` is the +Inf overflow.
+    A pure function of the static spec (float64 on host, cast to f32
+    once at trace time), so device and host readers can never disagree
+    about the binning.
+    """
+    B = spec.telemetry_hist_bins
+    return np.geomspace(
+        spec.telemetry_hist_min_ms * 1e-3,
+        spec.telemetry_hist_max_ms * 1e-3,
+        B - 1,
+    ).astype(np.float32)
+
+
+def accumulate_latency(spec: WorldSpec, telem, tasks, t1: jax.Array):
+    """Fold this tick's newly-acked task latencies into the histogram.
+
+    Dense over the task table (no compaction: the scatter-add is one
+    fused pass and rows beyond this tick add zero).  A row streams when
+    it is DONE, it ran on a fog (``fog >= 0`` — broker-local
+    completions keep the ``NO_TASK`` sentinel and have no fog row to
+    land in, the ``_phase_learn_credit`` guard), its status-6 ack has
+    reached the client (``t_ack6 <= t1``) and its ``lat_seen`` flag is
+    still clear; the flag then sets, making the accumulation
+    exactly-once under any completion backlog.
+    Pure function of its arguments (simlint R3) and a
+    :class:`TelemetryState` endomorphism, so it rides the scan carry
+    and the fleet's replica ``vmap`` unchanged.  Only traced when
+    ``spec.telemetry_hist`` is on.
+    """
+    B, F = spec.telemetry_hist_bins, spec.n_fogs
+    i32 = jnp.int32
+    edges = jnp.asarray(hist_edges_s(spec))  # (B-1,) f32, trace constant
+    due = (
+        (tasks.stage == _ST_DONE)
+        & (tasks.fog >= 0)
+        & (tasks.t_ack6 <= t1)
+        & (telem.lat_seen == 0)
+    )
+    lat = tasks.t_ack6 - tasks.t_create  # (T,) f32 seconds
+    # searchsorted(side='left'): first bucket whose edge >= lat — the
+    # cumulative `le` semantics of the exposition, bucket B-1 = +Inf
+    b = jnp.searchsorted(edges, lat).astype(i32)
+    fog = jnp.clip(tasks.fog, 0, F - 1)
+    add = due.astype(i32)
+    hist = telem.lat_hist.reshape(-1).at[fog * B + b].add(add)
+    return telem.replace(
+        lat_hist=hist.reshape(F, B),
+        lat_sum=telem.lat_sum.at[fog].add(jnp.where(due, lat, 0.0)),
+        lat_seen=jnp.maximum(telem.lat_seen, due.astype(jnp.int8)),
+    )
+
+
+# ----------------------------------------------------------------------
+# host-side readers (post-run or per chunk; one fetch each)
+# ----------------------------------------------------------------------
+
+def _quantile_from_cum(
+    cum: np.ndarray, edges_ms: np.ndarray, q: float, total: int,
+    overflow_ms: float,
+) -> float:
+    """Upper-edge quantile estimator over cumulative bucket counts.
+
+    Returns the smallest bucket upper bound (ms) whose cumulative count
+    reaches ``q * total``; the +Inf overflow bucket reports
+    ``overflow_ms`` (the configured histogram ceiling) so downstream
+    JSON/OpenMetrics stay finite.  NaN when the histogram is empty.
+    """
+    if total <= 0:
+        return float("nan")
+    b = int(np.searchsorted(cum, q * total, side="left"))
+    if b >= len(edges_ms):
+        return float(overflow_ms)
+    return float(edges_ms[b])
+
+
+def hist_summary(spec: WorldSpec, final) -> Optional[Dict]:
+    """Host roll-up of the device-resident latency histogram.
+
+    ``None`` when ``spec.telemetry_hist`` was off.  The returned
+    quantiles (global and per-fog, in ms) are THE values every consumer
+    publishes — ``runtime/recorder.py`` (``.sca.json``),
+    ``telemetry/openmetrics.py`` (quantile gauges) and
+    ``telemetry/live.py`` (/healthz) all read this one dict, so they
+    agree exactly, not merely to tolerance.
+
+    Accepts a fleet's replica-batched final state too: a leading
+    replica axis on ``lat_hist`` is summed away (replica-merged
+    histogram, ``parallel/fleet.py``).
+    """
+    if not (spec.telemetry and spec.telemetry_hist):
+        return None
+    counts = np.asarray(final.telem.lat_hist, np.int64)
+    sums = np.asarray(final.telem.lat_sum, np.float64)
+    if counts.ndim == 3:  # (R, F, B) fleet batch -> replica-merged
+        counts = counts.sum(axis=0)
+        sums = sums.sum(axis=0)
+    edges_ms = hist_edges_s(spec).astype(np.float64) * 1e3
+    over_ms = float(spec.telemetry_hist_max_ms)
+    per_fog_cum = np.cumsum(counts, axis=1)
+    g_counts = counts.sum(axis=0)
+    g_cum = np.cumsum(g_counts)
+    total = int(g_cum[-1]) if g_cum.size else 0
+    out = {
+        "edges_ms": edges_ms,
+        "counts": counts,  # (F, B) non-cumulative, last = +Inf overflow
+        "sum_ms": float(sums.sum() * 1e3),
+        "count": total,
+        "per_fog_count": counts.sum(axis=1).astype(np.int64),
+        "per_fog_sum_ms": sums * 1e3,
+        "quantiles_ms": {
+            name: _quantile_from_cum(g_cum, edges_ms, q, total, over_ms)
+            for name, q in QUANTILES
+        },
+        "per_fog_quantiles_ms": {
+            name: np.asarray(
+                [
+                    _quantile_from_cum(
+                        per_fog_cum[f], edges_ms, q,
+                        int(per_fog_cum[f][-1]), over_ms,
+                    )
+                    for f in range(counts.shape[0])
+                ]
+            )
+            for name, q in QUANTILES
+        },
+    }
+    return out
+
+
+def slo_breach_count(
+    spec: WorldSpec, final, slo_ms: float, summ: Optional[Dict] = None
+) -> Optional[int]:
+    """Tasks whose latency exceeded ``slo_ms``, from the histogram.
+
+    Bucket-resolution: the threshold snaps UP to the containing
+    bucket's upper edge (a breach is only counted once the whole bucket
+    lies above the SLO), so the count is a lower bound within one
+    bucket's width — log-spaced buckets keep that error a constant
+    ratio.  ``None`` when the histogram plane is off.  Callers that
+    already hold a :func:`hist_summary` dict pass it as ``summ`` to
+    skip the device re-fetch (the live loop computes one per chunk).
+    """
+    if summ is None:
+        summ = hist_summary(spec, final)
+    if summ is None:
+        return None
+    edges = summ["edges_ms"]
+    g = summ["counts"].sum(axis=0)
+    b = int(np.searchsorted(edges, float(slo_ms), side="left"))
+    return int(g[b + 1:].sum())
+
+
+def state_hash(state) -> str:
+    """sha256 over every leaf of a world state (host fetch).
+
+    The flight recorder's per-chunk fingerprint: two runs that diverge
+    anywhere diverge here, and the postmortem diff tool can bisect WHICH
+    chunk first diverged without storing full states.
+    """
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def find_nonfinite(state) -> Dict[str, str]:
+    """NaN detector for the flight recorder: ``{leaf path: kind}`` for
+    every float leaf containing NaN.  (+Inf is a legitimate "never
+    happened" sentinel throughout the task table, so only NaN trips.)
+    """
+    bad: Dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and np.isnan(a).any():
+            bad[jax.tree_util.keystr(path)] = "nan"
+    return bad

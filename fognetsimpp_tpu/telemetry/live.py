@@ -1,0 +1,519 @@
+"""Live health plane, host half: serving loop, watchdog, flight recorder.
+
+The ROADMAP's "streaming digital-twin serving mode" needs a production
+loop around ``run_chunked``: this module is that loop.  Per chunk it
+
+* fetches the reservoir rows the chunk completed
+  (:func:`telemetry.metrics.reservoir_progress`) and feeds them to an
+  **EWMA z-score watchdog** (queue depth, busy fraction, drop rate,
+  deferred backlog — the FogMQ always-on-broker health signals);
+* re-renders the full OpenMetrics exposition — including the
+  ``# TYPE ... histogram`` latency series and per-fog quantile gauges
+  when ``spec.telemetry_hist`` is on — behind a stdlib ``http.server``
+  **pull endpoint** (``GET /metrics``; ``GET /healthz`` returns the
+  watchdog/SLO state as JSON);
+* appends the rows + a per-chunk **state hash** to a bounded
+  :class:`FlightRecorder` ring, and on NaN, SLO breach, watchdog
+  anomaly or crash dumps a post-mortem bundle (manifest JSON + the
+  Perfetto trace of the last window) that ``tools/postmortem.py``
+  inspects and diffs.
+
+Everything here is host-side Python over the device-resident
+accumulators: the jitted tick loop is untouched (the chunk callback
+path of ``run_chunked`` already exists), so the health plane adds zero
+compiled ops and cannot perturb the simulation — the same read-only
+discipline the PR-4 telemetry gates enforce.
+"""
+from __future__ import annotations
+
+import collections
+import http.server
+import json
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..spec import WorldSpec
+
+#: Signals the watchdog tracks, derived per chunk from reservoir rows.
+WATCH_SIGNALS = ("q_depth", "busy_frac", "drop_rate", "defer")
+
+
+class Ewma:
+    """One exponentially-weighted mean/variance tracker.
+
+    ``update`` returns the z-score of the NEW sample against the
+    statistics accumulated *before* it (so a step change scores against
+    the pre-step regime), then folds the sample in.  The first
+    ``warmup`` samples return 0.0 — an empty-history z-score is noise.
+
+    The score's denominator is floored at ``rel_floor * |mean| +
+    abs_floor``: a signal that sat EXACTLY constant through warmup
+    (zero drops on a healthy run, busy_frac pinned at 1.0 on a
+    saturated fleet) has zero variance, and without the floor its first
+    infinitesimal wiggle would score z ~ 1e5 and page — only a change
+    that is material relative to the signal's own level should fire.
+    """
+
+    def __init__(
+        self,
+        alpha: float = 0.3,
+        warmup: int = 3,
+        rel_floor: float = 0.05,
+        abs_floor: float = 0.01,
+    ):
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.rel_floor = float(rel_floor)
+        self.abs_floor = float(abs_floor)
+        self.n = 0
+        self.mean = 0.0
+        self.var = 0.0
+
+    def update(self, x: float) -> float:
+        x = float(x)
+        if self.n < self.warmup:
+            z = 0.0
+        else:
+            floor = self.rel_floor * abs(self.mean) + self.abs_floor
+            z = (x - self.mean) / math.sqrt(self.var + floor * floor)
+        if self.n == 0:
+            self.mean = x
+        else:
+            a = self.alpha
+            d = x - self.mean
+            self.mean += a * d
+            self.var = (1.0 - a) * (self.var + a * d * d)
+        self.n += 1
+        return z
+
+
+class Watchdog:
+    """EWMA z-score anomaly detection over the per-chunk health signals.
+
+    Feed it the reservoir rows each chunk delivered
+    (:meth:`update_from_rows`); it derives per-chunk means of queue
+    depth / busy fraction / deferred backlog, the per-row drop RATE
+    from consecutive cumulative ``n_dropped`` samples, and flags any
+    signal whose z-score exceeds ``z_threshold``.  Anomalies are
+    returned AND kept in ``self.anomalies`` (the /healthz payload and
+    the flight-recorder manifest read it).
+    """
+
+    def __init__(
+        self,
+        n_fogs: int,
+        z_threshold: float = 4.0,
+        alpha: float = 0.3,
+        warmup: int = 3,
+    ):
+        self.n_fogs = max(int(n_fogs), 1)
+        self.z_threshold = float(z_threshold)
+        self._trackers = {
+            s: Ewma(alpha=alpha, warmup=warmup) for s in WATCH_SIGNALS
+        }
+        self._last_dropped: Optional[float] = None
+        self.anomalies: List[Dict] = []
+        self.last_signals: Dict[str, float] = {}
+        self.last_z: Dict[str, float] = {}
+
+    def signals_from_rows(self, rows: Dict[str, np.ndarray]) -> Dict:
+        """Chunk-level signal values from this chunk's reservoir rows
+        (empty dict when the chunk completed no reservoir row)."""
+        t = np.asarray(rows.get("t", ()))
+        if t.size == 0:
+            return {}
+        sig = {
+            "q_depth": float(np.mean(rows["q_len_total"])),
+            "busy_frac": float(np.mean(rows["n_busy"])) / self.n_fogs,
+            "defer": float(np.mean(rows["n_deferred"])),
+        }
+        dropped = np.asarray(rows["n_dropped"], dtype=float)
+        prev = (
+            self._last_dropped if self._last_dropped is not None
+            else float(dropped[0])
+        )
+        sig["drop_rate"] = float(dropped[-1] - prev) / max(dropped.size, 1)
+        self._last_dropped = float(dropped[-1])
+        return sig
+
+    def update(self, signals: Dict[str, float], ticks_done: int) -> List[Dict]:
+        """Score one chunk's signals; returns (and records) anomalies."""
+        fired = []
+        for name, value in signals.items():
+            tracker = self._trackers.get(name)
+            if tracker is None:
+                continue
+            z = tracker.update(value)
+            self.last_z[name] = z
+            if abs(z) > self.z_threshold:
+                fired.append(
+                    {
+                        "signal": name,
+                        "value": value,
+                        "z": z,
+                        "mean": tracker.mean,
+                        "ticks_done": int(ticks_done),
+                    }
+                )
+        self.last_signals = dict(signals)
+        self.anomalies.extend(fired)
+        return fired
+
+    def update_from_rows(
+        self, rows: Dict[str, np.ndarray], ticks_done: int
+    ) -> List[Dict]:
+        sig = self.signals_from_rows(rows)
+        if not sig:
+            return []
+        return self.update(sig, ticks_done)
+
+
+class FlightRecorder:
+    """Bounded ring of recent reservoir rows + per-chunk state hashes.
+
+    ``capacity`` bounds host memory no matter the horizon; on
+    :meth:`dump` the ring, the watchdog state, the compile-cache stats
+    and (when a final state is at hand) the Perfetto trace of the last
+    window land in ``outdir`` as a post-mortem bundle —
+    ``postmortem-<reason>-<ticks>.json`` plus a ``.trace.json`` twin —
+    that :mod:`tools.postmortem` inspects and diffs.
+    """
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity
+        )
+        self.dumps: List[str] = []
+
+    def note_chunk(
+        self,
+        ticks_done: int,
+        rows: Optional[Dict[str, np.ndarray]] = None,
+        state_hash: Optional[str] = None,
+        extra: Optional[Dict] = None,
+    ) -> None:
+        entry = {
+            "ticks_done": int(ticks_done),
+            "state_hash": state_hash,
+            "rows": {
+                k: [float(x) for x in np.asarray(v)]
+                for k, v in (rows or {}).items()
+            },
+        }
+        if extra:
+            entry.update(extra)
+        self._ring.append(entry)
+
+    @property
+    def ring(self) -> List[Dict]:
+        return list(self._ring)
+
+    def dump(
+        self,
+        outdir: str,
+        reason: str,
+        spec: Optional[WorldSpec] = None,
+        final=None,
+        watchdog: Optional[Watchdog] = None,
+        detail: Optional[Dict] = None,
+        max_tasks: int = 5000,
+    ) -> str:
+        """Write the post-mortem bundle; returns the manifest path."""
+        from ..compile_cache import compile_stats
+        from ..runtime.recorder import _json_sanitize, spec_to_dict
+
+        os.makedirs(outdir, exist_ok=True)
+        ticks = self._ring[-1]["ticks_done"] if self._ring else 0
+        stem = f"postmortem-{reason}-{ticks:09d}"
+        manifest_path = os.path.join(outdir, f"{stem}.json")
+        manifest = {
+            "reason": reason,
+            "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "ticks_done": ticks,
+            "detail": detail or {},
+            "ring": self.ring,
+            "compile_cache": compile_stats(),
+        }
+        if watchdog is not None:
+            manifest["watchdog"] = {
+                "anomalies": watchdog.anomalies,
+                "last_signals": watchdog.last_signals,
+                "last_z": watchdog.last_z,
+                "z_threshold": watchdog.z_threshold,
+            }
+        if spec is not None:
+            manifest["spec"] = spec_to_dict(spec)
+        if spec is not None and final is not None:
+            from .health import hist_summary
+
+            hist = hist_summary(spec, final)
+            if hist is not None:
+                manifest["hist"] = {
+                    "count": hist["count"],
+                    "quantiles_ms": hist["quantiles_ms"],
+                }
+            # the Perfetto trace of the last window: the task spans
+            # + counter tracks a post-mortem zooms into first
+            from .timeline import export_trace
+
+            trace_path = os.path.join(outdir, f"{stem}.trace.json")
+            manifest["trace"] = export_trace(
+                spec, final, trace_path, max_tasks=max_tasks
+            )
+        with open(manifest_path, "w") as f:
+            json.dump(
+                _json_sanitize(manifest), f, indent=1, allow_nan=False
+            )
+        self.dumps.append(manifest_path)
+        return manifest_path
+
+    @staticmethod
+    def load(path: str) -> Dict:
+        with open(path) as f:
+            return json.load(f)
+
+
+class HealthServer:
+    """Stdlib pull endpoint: ``GET /metrics`` (OpenMetrics text) and
+    ``GET /healthz`` (watchdog/SLO JSON).
+
+    A daemon-threaded ``http.server`` — no dependency beyond the
+    stdlib, matching the container constraint.  ``port=0`` binds an
+    ephemeral port (read it back from ``.port``); content is swapped
+    atomically under a lock by the serving loop.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._lock = threading.Lock()
+        self._metrics = "# EOF\n"
+        self._health: Dict = {"status": "starting"}
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.startswith("/metrics"):
+                    with outer._lock:
+                        body = outer._metrics.encode()
+                    ctype = "application/openmetrics-text; version=1.0.0"
+                elif self.path.startswith("/healthz"):
+                    with outer._lock:
+                        payload = dict(outer._health)
+                    body = (json.dumps(payload) + "\n").encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, port), Handler
+        )
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def set_metrics(self, text: str) -> None:
+        with self._lock:
+            self._metrics = text
+
+    def set_health(self, payload: Dict) -> None:
+        with self._lock:
+            self._health = payload
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve_run(
+    spec: WorldSpec,
+    state,
+    net,
+    bounds=None,
+    chunk_ticks: int = 1000,
+    port: Optional[int] = 0,
+    slo_ms: Optional[float] = None,
+    z_threshold: float = 4.0,
+    dump_dir: Optional[str] = None,
+    recorder: Optional[FlightRecorder] = None,
+    watchdog: Optional[Watchdog] = None,
+    server: Optional[HealthServer] = None,
+    on_chunk: Optional[Callable[[Dict], None]] = None,
+    hash_every_chunk: bool = True,
+):
+    """The production serving loop over ``run_chunked``.
+
+    Returns ``(final_state, status)`` where ``status`` carries the
+    server (still live, so late scrapes see the final exposition —
+    callers own ``status['server'].close()``), the watchdog, the flight
+    recorder and the run roll-up.  ``port=None`` disables the endpoint
+    (watchdog + recorder only).  ``slo_ms`` arms the SLO-breach trigger
+    (needs ``spec.telemetry_hist``); breaches, watchdog anomalies, NaNs
+    and crashes each dump at most one post-mortem bundle per reason
+    into ``dump_dir``.
+
+    ``hash_every_chunk=False`` skips the per-chunk full-state fetch —
+    both the state hash AND the NaN scan ride one ``device_get`` — for
+    latency-sensitive serving; the flight recorder ring then carries
+    rows only and NaN dumps are disabled (the histogram/SLO/watchdog
+    triggers still fire).
+    """
+    import jax
+
+    from ..core.engine import run_chunked
+    from ..runtime.signals import summarize
+    from .health import find_nonfinite, hist_summary, slo_breach_count
+    from .health import state_hash as health_state_hash
+    from .metrics import reservoir_progress
+    from .openmetrics import render_openmetrics
+
+    if not spec.telemetry:
+        raise ValueError(
+            "serve_run needs spec.telemetry=True (the health plane "
+            "reads the device-resident reservoir)"
+        )
+    if slo_ms is not None and not spec.telemetry_hist:
+        raise ValueError(
+            "slo_ms needs spec.telemetry_hist=True (SLO breaches are "
+            "derived from the streaming latency histogram)"
+        )
+    watchdog = watchdog or Watchdog(spec.n_fogs, z_threshold=z_threshold)
+    recorder = recorder or FlightRecorder()
+    if server is None and port is not None:
+        server = HealthServer(port=port)
+    dumped_reasons: set = set()
+    progress = {"next_row": 0, "chunks": 0, "t0": time.perf_counter()}
+    slo_state = {"breaches": 0}
+
+    def _dump(reason: str, s, detail: Optional[Dict] = None) -> None:
+        if dump_dir is None or reason in dumped_reasons:
+            return
+        dumped_reasons.add(reason)
+        recorder.dump(
+            dump_dir, reason, spec=spec, final=s,
+            watchdog=watchdog, detail=detail,
+        )
+
+    def _chunk_cb(s, ticks_done: int) -> None:
+        rows, progress["next_row"] = reservoir_progress(
+            spec, s.telem, ticks_done, progress["next_row"]
+        )
+        progress["chunks"] += 1
+        # one device->host fetch serves both the fingerprint and the
+        # NaN scan; hash_every_chunk=False skips the whole full-state
+        # transfer for latency-sensitive serving (rows + histogram only)
+        if hash_every_chunk:
+            host = jax.device_get(s)
+            h = health_state_hash(host)
+            bad = find_nonfinite(host)
+        else:
+            h, bad = None, {}
+        recorder.note_chunk(ticks_done, rows=rows, state_hash=h)
+        fired = watchdog.update_from_rows(rows, ticks_done)
+        if fired:
+            _dump("anomaly", s, detail={"anomalies": fired})
+        if bad:
+            _dump("nan", s, detail={"nonfinite": bad})
+        # ONE hist_summary per chunk feeds the SLO check, /healthz and
+        # the exposition alike (the single-quantile-source discipline)
+        hist = hist_summary(spec, s)
+        breaches = None
+        if slo_ms is not None:
+            breaches = slo_breach_count(spec, s, slo_ms, summ=hist)
+            if breaches and breaches > slo_state["breaches"]:
+                _dump(
+                    "slo",
+                    s,
+                    detail={"slo_ms": slo_ms, "breaches": breaches},
+                )
+            slo_state["breaches"] = breaches or 0
+        health = {
+            "status": (
+                "degraded" if (fired or bad or (breaches or 0) > 0)
+                else "ok"
+            ),
+            "ticks_done": int(ticks_done),
+            "chunks": progress["chunks"],
+            "wall_s": round(time.perf_counter() - progress["t0"], 3),
+            "signals": watchdog.last_signals,
+            "z": watchdog.last_z,
+            "anomalies": len(watchdog.anomalies),
+            "nonfinite": sorted(bad),
+            **(
+                {"slo_ms": slo_ms, "slo_breaches": breaches}
+                if slo_ms is not None
+                else {}
+            ),
+        }
+        if server is not None:
+            if hist is not None:
+                # an empty histogram yields NaN quantiles; /healthz is
+                # strict JSON, so those become null
+                health["latency_ms"] = {
+                    k: (v if math.isfinite(v) else None)
+                    for k, v in hist["quantiles_ms"].items()
+                }
+            server.set_metrics(
+                render_openmetrics(
+                    spec, s,
+                    hist=hist,
+                    attrs={
+                        "live_chunks": progress["chunks"],
+                        "live_ticks": int(ticks_done),
+                        **(
+                            {"slo_breaches": breaches}
+                            if breaches is not None
+                            else {}
+                        ),
+                    },
+                )
+            )
+            server.set_health(health)
+        if on_chunk is not None:
+            on_chunk(health)
+
+    try:
+        final = run_chunked(
+            spec, state, net, bounds,
+            chunk_ticks=chunk_ticks, callback=_chunk_cb,
+        )
+    except Exception as e:
+        # crash flight-record: the ring up to the last good chunk plus
+        # the failure, then re-raise — a serving loop must not swallow
+        if dump_dir is not None:
+            recorder.dump(
+                dump_dir, "crash", spec=spec, watchdog=watchdog,
+                detail={"error": f"{type(e).__name__}: {e}"},
+            )
+        if server is not None:
+            server.set_health(
+                {"status": "crashed", "error": f"{type(e).__name__}: {e}"}
+            )
+        raise
+    status = {
+        "server": server,
+        "port": server.port if server is not None else None,
+        "watchdog": watchdog,
+        "recorder": recorder,
+        "chunks": progress["chunks"],
+        "anomalies": len(watchdog.anomalies),
+        "slo_breaches": slo_state["breaches"],
+        "dumps": list(recorder.dumps),
+        "scalars": summarize(final),
+    }
+    return final, status

@@ -51,11 +51,17 @@ PHASES = (
     "fog_arrivals",
     "local_completions",
     "learn_credit",
+    "latency_hist",
 )
 PHASE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(PHASES)}
 
-#: Columns of one reservoir row (all f32).
-RES_FIELDS = ("t", "q_len_total", "n_busy", "n_deferred", "n_completed")
+#: Columns of one reservoir row (all f32).  ``n_dropped`` (cumulative
+#: queue-overflow count) joined in r6: the live watchdog derives its
+#: per-chunk drop RATE from consecutive rows' deltas
+#: (telemetry/live.py), so the signal must ride the reservoir.
+RES_FIELDS = (
+    "t", "q_len_total", "n_busy", "n_deferred", "n_completed", "n_dropped",
+)
 
 
 @struct.dataclass
@@ -78,6 +84,15 @@ class TelemetryState:
     #   of LearnState.pick_count; zeros when the learn subsystem is off)
     phase_work: jax.Array  # (Pm,) i32 per-phase work-done counters
     res: jax.Array  # (Rm, len(RES_FIELDS)) f32 strided per-tick rows
+    # --- streaming latency histogram (spec.telemetry_hist, ISSUE 6) ---
+    # accumulated by core/engine._phase_latency_hist via
+    # telemetry/health.accumulate_latency; all three leaves are
+    # zero-row when the histogram gate is off
+    lat_hist: jax.Array  # (Fh, Bh) i32 per-fog log-bucket counts of the
+    #   task_time latency (publish -> status-6 ack); last bucket = +Inf
+    lat_sum: jax.Array  # (Fh,) f32 per-fog latency sum (seconds) — the
+    #   OpenMetrics histogram `_sum` series
+    lat_seen: jax.Array  # (Th,) i8 per-task counted flag (exactly-once)
 
 
 def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
@@ -97,6 +112,11 @@ def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
         pick_hist=jnp.zeros((Fm,), f32),
         phase_work=jnp.zeros((Pm,), i32),
         res=jnp.zeros((Rm, len(RES_FIELDS)), f32),
+        lat_hist=jnp.zeros(
+            (spec.telemetry_hist_fogs, spec.telemetry_hist_nbins), i32
+        ),
+        lat_sum=jnp.zeros((spec.telemetry_hist_fogs,), f32),
+        lat_seen=jnp.zeros((spec.telemetry_hist_tasks,), jnp.int8),
     )
 
 
@@ -190,6 +210,7 @@ def accumulate_tick(
                 jnp.sum(busy.astype(i32)).astype(f32),
                 metrics.n_deferred.astype(f32),
                 metrics.n_completed.astype(f32),
+                metrics.n_dropped.astype(f32),
             ]
         )
         telem = telem.replace(

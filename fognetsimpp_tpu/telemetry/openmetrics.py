@@ -44,16 +44,112 @@ def _sample(lines: List[str], name: str, value, labels: str = "") -> None:
     lines.append(f"{_PREFIX}_{name}{labels} {sv}")
 
 
-def _family(lines: List[str], name: str, kind: str = "gauge") -> None:
+def _family(
+    lines: List[str], name: str, kind: str = "gauge",
+    help_text: Optional[str] = None,
+) -> None:
+    # every family carries both metadata comments: the extended
+    # tools/check_openmetrics.py lint REQUIRES a # HELP next to each
+    # # TYPE (scrape UIs surface it; a bare family reads as a bug)
+    h = help_text or name.replace("_", " ")
+    lines.append(f"# HELP {_PREFIX}_{name} {h}")
     lines.append(f"# TYPE {_PREFIX}_{name} {kind}")
+
+
+def _fmt_le(v: float) -> str:
+    """`le` label text for a finite bucket edge (round-trips float())."""
+    return repr(float(v))
+
+
+def _render_latency_hist(
+    lines: List[str], hist: Dict, family: str = "task_latency"
+) -> None:
+    """Emit one per-fog latency histogram + its quantile gauges.
+
+    ``hist`` is :func:`telemetry.health.hist_summary`'s dict — the
+    single source both this exposition and the recorder's ``.sca.json``
+    read, so their quantiles agree exactly.  Bucket series follow the
+    OpenMetrics histogram contract the extended lint enforces:
+    cumulative counts, ascending ``le`` labels, ``+Inf`` terminal,
+    ``_count`` == the +Inf bucket, ``_sum`` present.  Latency samples
+    are seconds (the Prometheus base unit); the quantile gauges are
+    milliseconds and say so in their name.
+    """
+    import numpy as np
+
+    edges_s = hist["edges_ms"] / 1e3
+    counts = hist["counts"]
+    F = counts.shape[0]
+    _family(
+        lines, family, "histogram",
+        help_text="task_time latency publish to status-6 ack (seconds)",
+    )
+    for f in range(F):
+        cum = np.cumsum(counts[f])
+        for b in range(len(edges_s)):
+            _sample(
+                lines, f"{family}_bucket", cum[b],
+                labels=f'{{fog="{f}",le="{_fmt_le(edges_s[b])}"}}',
+            )
+        _sample(
+            lines, f"{family}_bucket", cum[-1],
+            labels=f'{{fog="{f}",le="+Inf"}}',
+        )
+        _sample(
+            lines, f"{family}_sum", hist["per_fog_sum_ms"][f] / 1e3,
+            labels=f'{{fog="{f}"}}',
+        )
+        _sample(
+            lines, f"{family}_count", cum[-1], labels=f'{{fog="{f}"}}'
+        )
+    _family(
+        lines, f"{family}_quantile_ms",
+        help_text="latency quantiles from the device histogram (ms)",
+    )
+    for qname, qv in hist["quantiles_ms"].items():
+        _sample(
+            lines, f"{family}_quantile_ms", qv, labels=f'{{q="{qname}"}}'
+        )
+    for qname, vec in hist["per_fog_quantiles_ms"].items():
+        for f in range(F):
+            _sample(
+                lines, f"{family}_quantile_ms", vec[f],
+                labels=f'{{fog="{f}",q="{qname}"}}',
+            )
+
+
+def _render_compile_stats(lines: List[str]) -> None:
+    """Compile-latency observability (ISSUE 6): the persistent-cache
+    hit/miss counters and backend compile seconds from
+    :func:`fognetsimpp_tpu.compile_cache.compile_stats`, in every
+    exposition — the streaming serving mode's blocker is compile
+    latency, so the scrape must see it."""
+    from ..compile_cache import compile_stats
+
+    cs = compile_stats()
+    for family, key, kind in (
+        ("compile_cache_hits", "cache_hits", "counter"),
+        ("compile_cache_misses", "cache_misses", "counter"),
+        ("compile_backend_compiles", "compiles", "counter"),
+        ("compile_seconds_total", "compile_s_total", "counter"),
+        ("compile_seconds_max", "compile_s_max", "gauge"),
+    ):
+        _family(lines, family, kind)
+        _sample(lines, family, cs.get(key, 0))
 
 
 def render_openmetrics(
     spec: WorldSpec,
     final: WorldState,
     attrs: Optional[Dict] = None,
+    hist: Optional[Dict] = None,
 ) -> str:
-    """OpenMetrics text for one finished run (terminated by ``# EOF``)."""
+    """OpenMetrics text for one finished run (terminated by ``# EOF``).
+
+    ``hist``: a :func:`telemetry.health.hist_summary` dict the caller
+    already computed (the recorder and the live loop hold one); when
+    omitted it is derived here — one extra device fetch per render.
+    """
     from ..runtime.signals import summarize
     from .metrics import telemetry_summary
 
@@ -85,6 +181,14 @@ def render_openmetrics(
         _sample(lines, "telemetry_ticks", summ["ticks"])
         _family(lines, "deferred_sum")
         _sample(lines, "deferred_sum", summ["defer_sum"])
+    # streaming latency histogram (spec.telemetry_hist, ISSUE 6)
+    if hist is None:
+        from .health import hist_summary
+
+        hist = hist_summary(spec, final)
+    if hist is not None:
+        _render_latency_hist(lines, hist)
+    _render_compile_stats(lines)
     for k, v in (attrs or {}).items():
         if isinstance(v, (int, float)) and math.isfinite(float(v)):
             _family(lines, f"run_{k}")
@@ -96,6 +200,7 @@ def render_openmetrics(
 def render_fleet_openmetrics(
     fleet_scalars: Dict,
     busy_frac: Optional[np.ndarray] = None,
+    hist: Optional[Dict] = None,
 ) -> str:
     """OpenMetrics text for a fleet run's scalars.
 
@@ -107,6 +212,14 @@ def render_fleet_openmetrics(
     follow-up: a sweep's replicas stay distinguishable in the scrape
     instead of being averaged away).  A 1-D vector is accepted for
     backward compatibility and rendered without the ``fleet`` label.
+
+    ``hist``: the REPLICA-MERGED latency histogram — pass
+    :func:`telemetry.health.hist_summary` of the batched final state
+    (it sums a leading replica axis away), rendered as the
+    ``fns_fleet_task_latency`` histogram family.  Unlike the busy-frac
+    gauges the histogram is merged, not per-replica: R x F x B bucket
+    series would swamp a scrape, and the fleet's latency SLO is a
+    fleet-level question.
     """
     lines: List[str] = []
     _family(lines, "fleet_replicas")
@@ -134,6 +247,9 @@ def render_fleet_openmetrics(
                     lines, "fleet_fog_busy_fraction", bf[f],
                     labels=f'{{fog="{f}"}}',
                 )
+    if hist is not None:
+        _render_latency_hist(lines, hist, family="fleet_task_latency")
+    _render_compile_stats(lines)
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -143,8 +259,9 @@ def write_openmetrics(
     spec: WorldSpec,
     final: WorldState,
     attrs: Optional[Dict] = None,
+    hist: Optional[Dict] = None,
 ) -> str:
     """Render and write; returns ``path``."""
     with open(path, "w") as f:
-        f.write(render_openmetrics(spec, final, attrs=attrs))
+        f.write(render_openmetrics(spec, final, attrs=attrs, hist=hist))
     return path

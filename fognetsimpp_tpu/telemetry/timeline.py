@@ -20,6 +20,15 @@ simulated microseconds (the trace-event unit), durations clamped ≥ 0,
 and only finite columns are emitted — the output round-trips through
 strict ``json.loads`` with no ``NaN``/``Infinity`` tokens (the same
 RFC 8259 pitfall ``recorder.spec_to_dict`` already handles).
+
+Counter tracks (ISSUE 6): next to the spans, each fog contributes two
+Perfetto counter series reconstructed from the same task columns —
+``fogN queue_depth`` (a +1/−1 edge at every queue enter / service
+start, cumulatively summed: the exact queue-occupancy staircase) and
+``fogN busy_frac`` (service-interval overlap fraction over
+:data:`BUSY_WINDOWS` equal windows of the run).  iFogSim-style
+*distribution-over-time* observability in the same zoomable timeline
+as the task lifecycle.
 """
 from __future__ import annotations
 
@@ -30,6 +39,9 @@ import numpy as np
 
 from ..spec import Stage, WorldSpec
 from ..state import WorldState
+
+#: Windows the per-fog busy-fraction counter track averages over.
+BUSY_WINDOWS = 24
 
 #: Terminal stages that never reach a fog: shown as instant markers.
 _FAIL_STAGES = {
@@ -58,6 +70,82 @@ def _span(name, pid, tid, ts, dur, args=None) -> Dict:
     if args:
         ev["args"] = args
     return ev
+
+
+def _counter(name: str, pid: int, ts: float, key: str, value) -> Dict:
+    return {
+        "name": name,
+        "ph": "C",
+        "pid": int(pid),
+        "ts": float(ts),
+        "cat": "health",
+        "args": {key: float(value)},
+    }
+
+
+def _counter_events(
+    spec: WorldSpec,
+    tasks_np: Dict[str, np.ndarray],
+    pid: int,
+    ids: np.ndarray,
+) -> List[Dict]:
+    """Per-fog queue-depth and busy-fraction counter tracks.
+
+    Reconstructed from the same (capped) task rows the span builder
+    uses: queue depth is the cumulative sum of +1 edges at
+    ``t_q_enter`` and −1 edges at ``t_service_start``; busy fraction is
+    the service-interval overlap with :data:`BUSY_WINDOWS` equal
+    windows of the observed span.  Pure post-run host work — no new
+    device state.
+    """
+    events: List[Dict] = []
+    if ids.size == 0:
+        return events
+    fog = tasks_np["fog"].astype(np.int64)[ids]
+    qe = _us(tasks_np["t_q_enter"])[ids]
+    ss = _us(tasks_np["t_service_start"])[ids]
+    tc = _us(tasks_np["t_complete"])[ids]
+    t_hi = spec.horizon * 1e6
+    for f in range(spec.n_fogs):
+        mine = fog == f
+        # queue-depth staircase: +1 on queue enter, -1 on service start
+        t_in = qe[mine & np.isfinite(qe)]
+        t_out = ss[mine & np.isfinite(qe) & np.isfinite(ss)]
+        if t_in.size:
+            ts = np.concatenate([t_in, t_out])
+            dv = np.concatenate(
+                [np.ones_like(t_in), -np.ones_like(t_out)]
+            )
+            order = np.argsort(ts, kind="stable")
+            depth = np.cumsum(dv[order])
+            ts_s = ts[order]
+            events.extend(
+                _counter(
+                    f"fog{f} queue_depth", pid, ts_s[i], "tasks",
+                    max(depth[i], 0.0),
+                )
+                for i in range(len(ts_s))
+            )
+        # busy fraction: service-interval overlap per window
+        svc = mine & np.isfinite(ss) & np.isfinite(tc)
+        if not svc.any():
+            continue
+        s0, s1 = ss[svc], np.minimum(tc[svc], t_hi)
+        edges = np.linspace(0.0, t_hi, BUSY_WINDOWS + 1)
+        for w in range(BUSY_WINDOWS):
+            w0, w1 = edges[w], edges[w + 1]
+            if w1 <= w0:
+                continue
+            overlap = np.clip(
+                np.minimum(s1, w1) - np.maximum(s0, w0), 0.0, None
+            ).sum()
+            events.append(
+                _counter(
+                    f"fog{f} busy_frac", pid, w0, "frac",
+                    min(overlap / (w1 - w0), 1.0),
+                )
+            )
+    return events
 
 
 def _replica_events(
@@ -137,6 +225,8 @@ def _replica_events(
                     t_ack6[i] - t_complete[i], args,
                 )
             )
+    # per-fog queue-depth / busy-fraction counter tracks (ISSUE 6)
+    events.extend(_counter_events(spec, tasks_np, pid, ids))
     # lane names: one metadata event per thread (Perfetto track labels)
     for f in range(F):
         events.append(

@@ -64,6 +64,10 @@ _QUICK_FILES = {
     # the Perfetto golden and the OpenMetrics/.sca.json agreement — all
     # small worlds, and exactly the checks an engine edit must not break
     "test_telemetry.py",
+    # live health plane (ISSUE 6): the inert-histogram bit-exactness
+    # gate, watchdog/flight-recorder/live-endpoint units and the
+    # bench-trend CI gate — small worlds + pure host logic
+    "test_health.py",
     # fused slot-window front-end (ISSUE 5): the fused-vs-unfused
     # state-hash A/B over the policy-family worlds + the HLO op-budget
     # gate — the kernel-count win's correctness and its CI lock

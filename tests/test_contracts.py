@@ -29,7 +29,7 @@ def _worlds():
             horizon=0.3, dt=0.2, send_interval=0.05, max_sends_per_tick=8
         ),
         smoke.build(horizon=0.4, policy=8),  # Policy.UCB
-        smoke.build(horizon=0.4, telemetry=True),
+        smoke.build(horizon=0.4, telemetry=True, telemetry_hist=True),
     ]
 
 

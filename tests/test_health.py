@@ -1,0 +1,637 @@
+"""Live health plane (ISSUE 6): streaming latency histograms, SLO
+watchdog, flight recorder, live endpoint, bench-trend gate.
+
+Gate structure mirrors tests/test_telemetry.py: the zero-row
+``telemetry_hist`` leaves are inert (state-hash A/B across run entries
+and fleet-vs-vmap; histogram ON perturbs not one non-telem bit), the
+device-resident buckets agree with host-side ground truth sample by
+sample, and every derived consumer — OpenMetrics quantile gauges,
+``.sca.json`` rows, the live endpoint — reads ONE hist_summary() dict,
+asserted here to 1e-6.
+"""
+import dataclasses
+import json
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, run
+from fognetsimpp_tpu.scenarios import smoke
+
+SMALL = dict(n_users=2, n_fogs=2, send_interval=0.05, horizon=0.4)
+
+WORLDS = [
+    dict(policy=int(Policy.MIN_BUSY)),  # dense broker path
+    dict(policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0),  # compacted
+    dict(policy=int(Policy.UCB)),  # learned (learn + telem carry fields)
+]
+
+
+def _state_hash(state) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+# ----------------------------------------------------------------------
+# gate: hist off is inert, hist on is read-only
+# ----------------------------------------------------------------------
+
+def test_hist_off_leaves_zero_row_and_entries_bit_exact():
+    """With telemetry_hist off every histogram leaf has zero rows and
+    run / run_jit / run_chunked produce bit-identical final states —
+    the spec.telemetry discipline, nested one level deeper."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    for kw in WORLDS:
+        spec, state, net, bounds = _build(telemetry=True, **kw)
+        assert not spec.telemetry_hist
+        assert spec.telemetry_hist_fogs == 0
+        assert spec.telemetry_hist_tasks == 0
+        ref, _ = run(spec, state, net, bounds)
+        assert ref.telem.lat_hist.shape == (0, 0)
+        assert ref.telem.lat_seen.shape == (0,)
+        h_ref = _state_hash(ref)
+        spec2, state2, net2, bounds2 = _build(telemetry=True, **kw)
+        assert _state_hash(run_jit(spec2, state2, net2, bounds2)) == h_ref
+        spec3, state3, net3, bounds3 = _build(telemetry=True, **kw)
+        assert (
+            _state_hash(run_chunked(spec3, state3, net3, bounds3, 170))
+            == h_ref
+        )
+
+
+def test_hist_on_never_perturbs_the_simulation():
+    """Histogram ON is read-only: every non-telem leaf of the final
+    state is bit-equal to the hist-off run of the same world, across
+    run / run_jit / run_chunked."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    for kw in WORLDS:
+        spec_off, s_off, net, bounds = _build(telemetry=True, **kw)
+        ref, _ = run(spec_off, s_off, net, bounds)
+        spec_on, s_on, net2, bounds2 = _build(
+            telemetry=True, telemetry_hist=True, **kw
+        )
+        assert spec_on.telemetry_hist_fogs == spec_on.n_fogs
+        got, _ = run(spec_on, s_on, net2, bounds2)
+        for f in dataclasses.fields(ref):
+            if f.name == "telem":
+                continue
+            for a, b in zip(
+                jax.tree.leaves(getattr(ref, f.name)),
+                jax.tree.leaves(getattr(got, f.name)),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=f.name
+                )
+        # ...and the hist-on entries agree among themselves bit-for-bit
+        h_got = _state_hash(got)
+        spec4, s4, net4, bounds4 = _build(
+            telemetry=True, telemetry_hist=True, **kw
+        )
+        assert _state_hash(run_jit(spec4, s4, net4, bounds4)) == h_got
+        spec5, s5, net5, bounds5 = _build(
+            telemetry=True, telemetry_hist=True, **kw
+        )
+        assert (
+            _state_hash(run_chunked(spec5, s5, net5, bounds5, 170))
+            == h_got
+        )
+
+
+def test_fleet_carries_hist_identically_to_vmap():
+    from fognetsimpp_tpu.parallel import make_mesh, replicate_state
+    from fognetsimpp_tpu.parallel.fleet import fleet_latency_hist, run_fleet
+    from fognetsimpp_tpu.parallel.replicas import run_replicated
+
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_hist=True, horizon=0.2
+    )
+    batch = replicate_state(spec, state, 8, seed=3)
+    ref = run_replicated(spec, batch, net, bounds)
+    got = run_fleet(spec, batch, net, bounds, make_mesh(8), donate=False)
+    for a, b in zip(jax.tree.leaves(ref.telem), jax.tree.leaves(got.telem)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    merged = fleet_latency_hist(spec, got)
+    per_replica = np.asarray(got.telem.lat_hist, np.int64)  # (R, F, B)
+    assert per_replica.shape[0] == 8
+    np.testing.assert_array_equal(
+        merged["counts"], per_replica.sum(axis=0)
+    )
+
+
+# ----------------------------------------------------------------------
+# accumulators vs host ground truth
+# ----------------------------------------------------------------------
+
+def _ground_world(**kw):
+    return _build(
+        n_users=4, horizon=2.0, telemetry=True, telemetry_hist=True, **kw
+    )
+
+
+def test_hist_matches_host_ground_truth():
+    """Device bucket counts equal a host re-binning of the task_time
+    sample vector: same count, same buckets, same sums — and identical
+    whether the run went through one scan or ragged chunks (the
+    lat_seen exactly-once flag)."""
+    from fognetsimpp_tpu.core.engine import run_chunked
+    from fognetsimpp_tpu.runtime.signals import extract_signals
+    from fognetsimpp_tpu.telemetry.health import hist_edges_s, hist_summary
+
+    spec, state, net, bounds = _ground_world()
+    final, _ = run(spec, state, net, bounds)
+    summ = hist_summary(spec, final)
+    tt = extract_signals(final)["task_time"]  # ms
+    assert summ["count"] == tt.size > 0
+    assert abs(summ["sum_ms"] - tt.sum()) <= 1e-2
+    edges_ms = hist_edges_s(spec).astype(np.float64) * 1e3
+    host_bins = np.bincount(
+        np.searchsorted(edges_ms, tt),
+        minlength=spec.telemetry_hist_bins,
+    )
+    np.testing.assert_array_equal(summ["counts"].sum(axis=0), host_bins)
+    # chunked run streams the identical histogram (exactly-once across
+    # chunk boundaries, including acks processed late)
+    spec2, state2, net2, bounds2 = _ground_world()
+    final2 = run_chunked(spec2, state2, net2, bounds2, 170)
+    np.testing.assert_array_equal(
+        np.asarray(final.telem.lat_hist), np.asarray(final2.telem.lat_hist)
+    )
+
+
+def test_hist_excludes_broker_local_completions():
+    """Broker-local completions keep fog == NO_TASK (-1): they have no
+    fog row to land in, so they must not be clipped into fog 0's
+    buckets — the per-fog histogram covers fog-executed tasks only."""
+    from fognetsimpp_tpu import Stage
+    from fognetsimpp_tpu.telemetry.health import hist_summary
+
+    spec, state, net, bounds = _build(
+        policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0,
+        n_users=4, horizon=2.0, telemetry=True, telemetry_hist=True,
+    )
+    final, _ = run(spec, state, net, bounds)
+    fog = np.asarray(final.tasks.fog)
+    ack6 = np.asarray(final.tasks.t_ack6)
+    done = (
+        (np.asarray(final.tasks.stage) == int(Stage.DONE))
+        & np.isfinite(ack6)
+        & (ack6 <= float(final.t))
+    )
+    assert (done & (fog < 0)).any(), (
+        "world grew no broker-local completions; the exclusion gate "
+        "is untested"
+    )
+    summ = hist_summary(spec, final)
+    want = np.bincount(
+        fog[done & (fog >= 0)], minlength=spec.n_fogs
+    )
+    np.testing.assert_array_equal(summ["per_fog_count"], want)
+    assert summ["count"] == int(want.sum())
+
+
+def test_slo_breach_count_matches_bucket_snap():
+    from fognetsimpp_tpu.runtime.signals import extract_signals
+    from fognetsimpp_tpu.telemetry.health import (
+        hist_edges_s,
+        slo_breach_count,
+    )
+
+    spec, state, net, bounds = _ground_world()
+    final, _ = run(spec, state, net, bounds)
+    tt = extract_signals(final)["task_time"]
+    edges_ms = hist_edges_s(spec).astype(np.float64) * 1e3
+    for slo in (1.0, 20.0, 500.0, 1e6):
+        got = slo_breach_count(spec, final, slo)
+        snap = edges_ms[min(
+            int(np.searchsorted(edges_ms, slo)), len(edges_ms) - 1
+        )]
+        want = int((tt > snap).sum()) if slo <= edges_ms[-1] else 0
+        assert got == want, (slo, got, want)
+    # off world -> None
+    spec0, s0, n0, b0 = _build()
+    f0, _ = run(spec0, s0, n0, b0)
+    assert slo_breach_count(spec0, f0, 10.0) is None
+
+
+def test_openmetrics_hist_quantiles_match_sca_json(tmp_path):
+    """The ISSUE 6 acceptance gate: the OpenMetrics quantile gauges and
+    the recorder's .sca.json latency rows agree to 1e-6 (one shared
+    hist_summary()), and the histogram family passes the extended
+    format lint (le monotone, +Inf terminal, cumulative counts)."""
+    import re
+
+    from fognetsimpp_tpu.runtime.recorder import load_scalars, record_run
+    from tools.check_openmetrics import check
+
+    spec, state, net, bounds = _ground_world()
+    final, _ = run(spec, state, net, bounds)
+    paths = record_run(str(tmp_path), spec, final, scave=False)
+    assert check(paths["om"]) == 0
+    sca = load_scalars(paths["sca"])
+    text = open(paths["om"]).read()
+    assert "# TYPE fns_task_latency histogram" in text
+    for f in range(spec.n_fogs):
+        for q in ("p50", "p95", "p99"):
+            m = re.search(
+                rf'^fns_task_latency_quantile_ms\{{fog="{f}",q="{q}"\}}'
+                r" (\S+)$",
+                text, re.M,
+            )
+            sca_val = sca["modules"]["fog"][f].get(f"lat_{q}_ms")
+            if m is None:
+                assert sca_val is None  # empty fog: both sides skip
+                continue
+            assert abs(float(m.group(1)) - sca_val) <= 1e-6
+        # bucket series terminate at +Inf and count matches
+        m = re.search(
+            rf'^fns_task_latency_bucket\{{fog="{f}",le="\+Inf"\}} (\d+)$',
+            text, re.M,
+        )
+        assert m
+        assert int(m.group(1)) == sca["modules"]["fog"][f]["lat_count"]
+    # global quantiles mirror sca["hist"]
+    for q, v in sca["hist"]["quantiles_ms"].items():
+        m = re.search(
+            rf'^fns_task_latency_quantile_ms\{{q="{q}"\}} (\S+)$',
+            text, re.M,
+        )
+        assert m and abs(float(m.group(1)) - v) <= 1e-6
+    # compile-latency observability rides every exposition + .sca.json
+    assert "# TYPE fns_compile_seconds_total counter" in text
+    assert "compile_cache" in sca
+
+
+def test_fleet_openmetrics_histogram(tmp_path):
+    from fognetsimpp_tpu.parallel import make_mesh, replicate_state
+    from fognetsimpp_tpu.parallel.fleet import run_fleet
+    from fognetsimpp_tpu.runtime.recorder import record_fleet_run
+    from tools.check_openmetrics import check
+
+    spec, state, net, bounds = _build(
+        n_users=4, horizon=1.0, telemetry=True, telemetry_hist=True
+    )
+    batch = replicate_state(spec, state, 8, seed=0)
+    final = run_fleet(spec, batch, net, bounds, make_mesh(8))
+    paths = record_fleet_run(str(tmp_path), spec, final)
+    text = open(paths["om"]).read()
+    assert "# TYPE fns_fleet_task_latency histogram" in text
+    assert check(paths["om"]) == 0
+    sca = json.load(open(paths["sca"]))
+    assert sca["hist"]["count"] == int(
+        np.asarray(final.telem.lat_hist, np.int64).sum()
+    )
+
+
+# ----------------------------------------------------------------------
+# watchdog + flight recorder + live endpoint
+# ----------------------------------------------------------------------
+
+def test_watchdog_fires_on_injected_queue_depth_step():
+    from fognetsimpp_tpu.telemetry.live import Watchdog
+
+    wd = Watchdog(n_fogs=4, z_threshold=4.0, warmup=3)
+
+    def rows(q):
+        return {
+            "t": np.asarray([0.1]),
+            "q_len_total": np.asarray([q], float),
+            "n_busy": np.asarray([2.0]),
+            "n_deferred": np.asarray([0.0]),
+            "n_completed": np.asarray([1.0]),
+            "n_dropped": np.asarray([0.0]),
+        }
+
+    fired = []
+    for i in range(8):  # stable regime
+        fired += wd.update_from_rows(rows(10.0 + 0.1 * (i % 2)), i)
+    assert fired == []
+    fired = wd.update_from_rows(rows(80.0), 99)  # injected step
+    assert any(a["signal"] == "q_depth" for a in fired)
+    assert wd.anomalies and wd.anomalies[-1]["ticks_done"] == 99
+    # empty chunk (no reservoir rows) is a no-op, not a crash
+    assert wd.update_from_rows({"t": np.zeros((0,))}, 100) == []
+
+
+def test_watchdog_variance_floor_ignores_infinitesimal_wiggle():
+    """A signal that sat exactly constant through warmup has zero EWMA
+    variance; the z denominator's rel/abs floor keeps its first tiny
+    wiggle (one routine drop, a 0.001 busy_frac dip) from paging while
+    a genuine step still scores far past the threshold."""
+    from fognetsimpp_tpu.telemetry.live import Ewma
+
+    flat = Ewma(warmup=3)
+    for _ in range(6):
+        assert abs(flat.update(0.0)) <= 1e-12
+    assert abs(flat.update(0.01)) < 4.0  # one routine drop: no page
+    pinned = Ewma(warmup=3)
+    for _ in range(6):
+        pinned.update(1.0)
+    assert abs(pinned.update(0.999)) < 4.0  # saturated fleet dip
+    assert abs(pinned.update(0.2)) > 4.0  # a real collapse still fires
+
+
+def test_flight_recorder_dump_load_roundtrip_on_nan(tmp_path):
+    """A forced-NaN world trips the recorder: the dump bundle
+    round-trips through load() with the ring, reason and nonfinite
+    detail intact, plus a strict-JSON Perfetto trace twin."""
+    from fognetsimpp_tpu.telemetry.live import FlightRecorder, serve_run
+
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_hist=True, horizon=0.4
+    )
+    # poison one float leaf: the NaN detector must catch it at the
+    # first chunk boundary regardless of engine propagation
+    state = state.replace(
+        nodes=state.nodes.replace(
+            energy=state.nodes.energy.at[0].set(jnp_nan())
+        )
+    )
+    final, status = serve_run(
+        spec, state, net, bounds, chunk_ticks=200, port=None,
+        dump_dir=str(tmp_path),
+    )
+    dumps = [p for p in status["dumps"] if "-nan-" in p]
+    assert dumps, status["dumps"]
+    m = FlightRecorder.load(dumps[0])
+    assert m["reason"] == "nan"
+    assert any("energy" in k for k in m["detail"]["nonfinite"])
+    assert m["ring"] and m["ring"][-1]["state_hash"]
+    assert set(m["ring"][0]["rows"]) >= {"t", "q_len_total", "n_dropped"}
+    trace = json.load(open(m["trace"]))
+    assert "traceEvents" in trace
+    # ring round-trip: a dump of the (final) recorder state loads back
+    # exactly — the dump above fired mid-run, so compare a fresh dump
+    p2 = status["recorder"].dump(str(tmp_path), "manual", spec=spec)
+    m2 = FlightRecorder.load(p2)
+    assert len(m2["ring"]) == len(status["recorder"].ring)
+    np.testing.assert_array_equal(
+        m2["ring"][-1]["rows"]["t"],
+        status["recorder"].ring[-1]["rows"]["t"],
+    )
+    assert (
+        m2["ring"][-1]["state_hash"]
+        == status["recorder"].ring[-1]["state_hash"]
+    )
+
+
+def jnp_nan():
+    import jax.numpy as jnp
+
+    return jnp.float32(float("nan"))
+
+
+def test_postmortem_cli_summarize_and_diff(tmp_path, capsys):
+    from fognetsimpp_tpu.telemetry.live import FlightRecorder
+    from tools.postmortem import main as pm_main
+
+    ra, rb = FlightRecorder(), FlightRecorder()
+    for ticks, ha, hb in ((100, "aaa", "aaa"), (200, "bbb", "ccc")):
+        ra.note_chunk(ticks, rows={"t": np.asarray([ticks * 1.0])},
+                      state_hash=ha)
+        rb.note_chunk(ticks, rows={"t": np.asarray([ticks * 1.0])},
+                      state_hash=hb)
+    pa = ra.dump(str(tmp_path / "a"), "anomaly")
+    pb = rb.dump(str(tmp_path / "b"), "anomaly")
+    assert pm_main([pa]) == 0
+    out = capsys.readouterr().out
+    assert "reason:      anomaly" in out
+    assert pm_main(["--diff", pa, pb]) == 0
+    out = capsys.readouterr().out
+    assert "first state-hash divergence at tick 200" in out
+
+
+def test_live_endpoint_smoke():
+    """Serve one chunk, GET /metrics + /healthz, lint the exposition."""
+    from fognetsimpp_tpu.telemetry.live import serve_run
+    from tools.check_openmetrics import check_text
+
+    spec, state, net, bounds = _build(
+        n_users=4, telemetry=True, telemetry_hist=True, horizon=1.0
+    )
+    chunks = []
+    final, status = serve_run(
+        spec, state, net, bounds,
+        chunk_ticks=spec.n_ticks,  # exactly one chunk
+        port=0, slo_ms=1e6, on_chunk=chunks.append,
+    )
+    try:
+        assert status["chunks"] == 1 and len(chunks) == 1
+        url = f"http://127.0.0.1:{status['port']}"
+        text = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert check_text(text, "live") == 0
+        assert "# TYPE fns_task_latency histogram" in text
+        assert "fns_run_live_chunks 1" in text
+        hz = json.loads(urllib.request.urlopen(url + "/healthz").read())
+        assert hz["status"] == "ok"
+        assert hz["ticks_done"] == spec.n_ticks
+        assert hz["slo_breaches"] == 0
+        assert chunks[0]["signals"]["busy_frac"] <= 1.0
+    finally:
+        status["server"].close()
+
+
+def test_serve_run_validates_gates():
+    from fognetsimpp_tpu.telemetry.live import serve_run
+
+    spec, state, net, bounds = _build()
+    with pytest.raises(ValueError, match="telemetry"):
+        serve_run(spec, state, net, bounds, port=None)
+    spec2, state2, net2, bounds2 = _build(telemetry=True)
+    with pytest.raises(ValueError, match="telemetry_hist"):
+        serve_run(
+            spec2, state2, net2, bounds2, port=None, slo_ms=10.0
+        )
+
+
+# ----------------------------------------------------------------------
+# contracts, spec validation, linter, bench trend
+# ----------------------------------------------------------------------
+
+def test_contract_and_phase_registry():
+    from fognetsimpp_tpu.core.contracts import (
+        PHASE_CONTRACTS,
+        check_step_contract,
+        check_telemetry_contract,
+    )
+
+    assert any(
+        pc.name == "_phase_latency_hist" for pc in PHASE_CONTRACTS
+    )
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_hist=True
+    )
+    check_telemetry_contract(spec, state)
+    check_step_contract(spec, state, net, bounds)
+
+
+def test_spec_validation_guards():
+    with pytest.raises(AssertionError, match="telemetry_hist rides"):
+        _build(telemetry_hist=True)
+    with pytest.raises(AssertionError, match="derive_acks"):
+        _build(telemetry=True, telemetry_hist=True, derive_acks=True)
+    with pytest.raises(AssertionError, match="buckets"):
+        _build(telemetry=True, telemetry_hist=True, telemetry_hist_bins=1)
+
+
+def test_openmetrics_linter_histogram_rules(tmp_path):
+    from tools.check_openmetrics import check_text
+
+    head = (
+        "# HELP fns_h h\n# TYPE fns_h histogram\n"
+    )
+    good = (
+        head
+        + 'fns_h_bucket{le="0.1"} 1\nfns_h_bucket{le="1"} 2\n'
+        + 'fns_h_bucket{le="+Inf"} 3\nfns_h_sum 4.2\nfns_h_count 3\n'
+        + "# EOF\n"
+    )
+    assert check_text(good) == 0
+    # non-cumulative counts
+    bad = good.replace('fns_h_bucket{le="1"} 2', 'fns_h_bucket{le="1"} 0')
+    assert check_text(bad) == 1
+    # missing +Inf terminal
+    bad = (
+        head + 'fns_h_bucket{le="0.1"} 1\nfns_h_sum 1\nfns_h_count 1\n'
+        + "# EOF\n"
+    )
+    assert check_text(bad) == 1
+    # le values out of order
+    bad = (
+        head
+        + 'fns_h_bucket{le="1"} 1\nfns_h_bucket{le="0.1"} 1\n'
+        + 'fns_h_bucket{le="+Inf"} 1\nfns_h_sum 1\nfns_h_count 1\n# EOF\n'
+    )
+    assert check_text(bad) == 1
+    # _count disagreeing with the +Inf bucket
+    bad = good.replace("fns_h_count 3", "fns_h_count 5")
+    assert check_text(bad) == 1
+    # missing _sum
+    bad = good.replace("fns_h_sum 4.2\n", "")
+    assert check_text(bad) == 1
+    # bucket without an le label
+    bad = (
+        head + "fns_h_bucket 1\nfns_h_sum 1\nfns_h_count 1\n# EOF\n"
+    )
+    assert check_text(bad) == 1
+    # missing _count entirely (not just disagreeing)
+    bad = good.replace("fns_h_count 3\n", "")
+    assert check_text(bad) == 1
+    # non-numeric le label is a finding, not a linter traceback
+    bad = good.replace('le="0.1"', 'le="abc"')
+    assert check_text(bad) == 1
+
+
+def test_bench_trend_gate(tmp_path):
+    """Green on the checked-in BENCH history; red on a fabricated >10%
+    regression at the same shape; silent on shape changes."""
+    from tools.bench_trend import check, load_rounds, table
+
+    rows = load_rounds(str(Path(__file__).parent / ".."))
+    assert rows, "checked-in BENCH_r*.json history went missing"
+    assert check(rows) == []
+    assert "BENCH_r05.json" in table(rows)
+    assert "| r5 |" in table(rows, markdown=True)
+
+    def cap(n, value, dt=0.005):
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps({
+            "parsed": {
+                "metric": "m", "value": value, "unit": "d/s",
+                "backend": "tpu", "n_users": 10, "n_fogs": 2, "dt": dt,
+                "compile_s": 1.0,
+            }
+        }))
+
+    cap(1, 100.0)
+    cap(2, 85.0)  # -15% at the same shape
+    rows = load_rounds(str(tmp_path))
+    problems = check(rows)
+    assert len(problems) == 1 and "15.0%" in problems[0]
+    # a shape change (different dt) is a new trajectory, not a regression
+    cap(2, 85.0, dt=0.001)
+    assert check(load_rounds(str(tmp_path))) == []
+
+
+def test_bench_trend_policy_backfill(tmp_path):
+    """A capture that predates the 'policy' field compares against a
+    new capture recording the bench default — the gate must not lose
+    its entire history the first round that records the knob."""
+    from tools.bench_trend import check, load_rounds
+
+    base = {
+        "metric": "m", "unit": "d/s", "backend": "tpu",
+        "n_users": 10, "n_fogs": 2, "dt": 0.005, "compile_s": 1.0,
+    }
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {**base, "value": 100.0}})  # no 'policy'
+    )
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(
+            {"parsed": {**base, "value": 80.0, "policy": "min_busy"}}
+        )
+    )
+    problems = check(load_rounds(str(tmp_path)))
+    assert len(problems) == 1 and "20.0%" in problems[0]
+    # a genuinely different policy is still its own trajectory
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps({"parsed": {**base, "value": 10.0, "policy": "ucb"}})
+    )
+    assert check(load_rounds(str(tmp_path))) == problems
+
+
+def test_compile_stats_accounting():
+    from fognetsimpp_tpu.compile_cache import compile_stats, note_compile
+
+    before = compile_stats()
+    note_compile(1.5, cache_hit=False)
+    after = compile_stats()
+    assert after["noted_compiles"] == before.get("noted_compiles", 0) + 1
+    assert after["cache_misses"] == before["cache_misses"] + 1
+    assert (
+        after["noted_compile_s_total"]
+        >= before.get("noted_compile_s_total", 0.0) + 1.5 - 1e-9
+    )
+    assert "cache_dir" in after
+
+
+def test_timeline_counter_tracks():
+    """Per-fog queue-depth / busy-frac counter events ride next to the
+    task spans: non-negative, finite, per-fog named, strict JSON."""
+    from fognetsimpp_tpu.telemetry.timeline import build_trace
+
+    spec, state, net, bounds = _ground_world()
+    final, _ = run(spec, state, net, bounds)
+    trace = build_trace(spec, final)
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    assert counters
+    names = {e["name"] for e in counters}
+    assert any("queue_depth" in n for n in names)
+    assert any("busy_frac" in n for n in names)
+    for e in counters:
+        (val,) = e["args"].values()
+        assert np.isfinite(val) and val >= 0.0
+        if "busy_frac" in e["name"]:
+            assert val <= 1.0
+    # depth staircase: integral task counts, consistent with the final
+    # state's own queue length at the last sample
+    depth = [
+        e["args"]["tasks"] for e in counters
+        if e["name"] == "fog0 queue_depth"
+    ]
+    assert depth and all(d == int(d) for d in depth)
+    assert depth[-1] == float(np.asarray(final.fogs.q_len)[0])

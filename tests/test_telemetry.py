@@ -398,16 +398,20 @@ def test_openmetrics_linter_rejects_duplicate_series(tmp_path):
 
     good = tmp_path / "good.om.txt"
     good.write_text(
-        '# TYPE fns_x gauge\nfns_x{fleet="0",fog="1"} 1\n'
+        '# HELP fns_x x\n# TYPE fns_x gauge\nfns_x{fleet="0",fog="1"} 1\n'
         'fns_x{fleet="1",fog="1"} 2\n# EOF\n'
     )
     assert check(str(good)) == 0
     bad = tmp_path / "bad.om.txt"
     bad.write_text(
-        '# TYPE fns_x gauge\nfns_x{fleet="0",fog="1"} 1\n'
+        '# HELP fns_x x\n# TYPE fns_x gauge\nfns_x{fleet="0",fog="1"} 1\n'
         'fns_x{fleet="0",fog="1"} 2\n# EOF\n'
     )
     assert check(str(bad)) == 1
+    # the r6 metadata requirement: a family without # HELP fails too
+    nohelp = tmp_path / "nohelp.om.txt"
+    nohelp.write_text("# TYPE fns_x gauge\nfns_x 1\n# EOF\n")
+    assert check(str(nohelp)) == 1
 
 
 def test_cli_telemetry_flags(tmp_path, capsys):

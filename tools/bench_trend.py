@@ -1,0 +1,207 @@
+"""Bench-trend observability: trajectory table + CI regression gate.
+
+    python tools/bench_trend.py             # print the trajectory table
+    python tools/bench_trend.py --check     # CI gate (>10% regression fails)
+    python tools/bench_trend.py --markdown  # the table BENCHMARKS.md embeds
+
+Parses the committed ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` round
+captures into one trajectory per *shape* (metric, backend, users, fogs,
+dt, window, policy — rounds that changed the measured configuration are
+different trajectories, so a dt=1ms round is never compared against a
+windowed dt=5ms round).  ``--check`` fails when the LATEST round at a
+shape regressed more than :data:`TOLERANCE` vs the best prior round at
+the same shape — the perf story's ratchet, wired into
+``tools/ci_check.sh`` so a throughput loss is a red build, not a line
+in a markdown file nobody re-reads.  Compile seconds ride along
+(``compile_s``): the streaming serving mode's blocker is tracked in the
+same table.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: Latest round may lose at most this fraction vs the best prior round
+#: at the same shape.
+TOLERANCE = 0.10
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+#: Fields that define a comparable measurement shape.  Missing fields
+#: (older capture formats) stay None and form their own shape — an old
+#: round that did not record dt is never silently compared to a new one.
+SHAPE_FIELDS = (
+    "metric", "backend", "n_users", "n_fogs", "dt", "arrival_window",
+    "policy", "n_devices", "n_replicas",
+)
+
+#: Shape values a capture that predates the field is known to have run
+#: with.  bench.py only started recording ``policy`` in r6, but every
+#: committed BENCH_r*/MULTICHIP_r* round ran the BENCH_POLICY default
+#: (min_busy) — without this backfill the first policy-recording
+#: capture would form a fresh one-entry trajectory and the regression
+#: gate would silently stop comparing against all prior history.
+SHAPE_DEFAULTS = {"policy": "min_busy"}
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def load_rounds(root: str = ".") -> List[Dict]:
+    """All parseable round captures, sorted by round number.
+
+    A capture without a ``parsed`` metric dict (e.g. the dryrun-only
+    MULTICHIP rounds before ISSUE 3, or a failed capture) is skipped —
+    absence of a number is not a regression.
+    """
+    rows = []
+    for pattern in ("BENCH_r*.json", "MULTICHIP_r*.json"):
+        for path in glob.glob(os.path.join(root, pattern)):
+            rnd = _round_of(path)
+            if rnd is None:
+                continue
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            parsed = d.get("parsed")
+            if not isinstance(parsed, dict) or "value" not in parsed:
+                continue
+            rows.append(
+                {
+                    "round": rnd,
+                    "file": os.path.basename(path),
+                    "shape": tuple(
+                        (k, parsed.get(k, SHAPE_DEFAULTS.get(k)))
+                        for k in SHAPE_FIELDS
+                    ),
+                    "value": float(parsed["value"]),
+                    "unit": parsed.get("unit", ""),
+                    "compile_s": parsed.get("compile_s"),
+                    "parsed": parsed,
+                }
+            )
+    rows.sort(key=lambda r: (r["file"].split("_r")[0], r["round"]))
+    return rows
+
+
+def _shape_str(shape: Tuple) -> str:
+    d = dict(shape)
+    bits = [str(d.get("metric") or "?"), str(d.get("backend") or "?")]
+    for k in ("n_users", "n_fogs", "dt", "arrival_window", "n_devices"):
+        if d.get(k) is not None:
+            bits.append(f"{k}={d[k]}")
+    return " ".join(bits)
+
+
+def trajectories(rows: List[Dict]) -> Dict[Tuple, List[Dict]]:
+    by_shape: Dict[Tuple, List[Dict]] = {}
+    for r in rows:
+        by_shape.setdefault(r["shape"], []).append(r)
+    for v in by_shape.values():
+        v.sort(key=lambda r: r["round"])
+    return by_shape
+
+
+def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
+    """Regression findings (empty = green)."""
+    problems = []
+    for shape, traj in trajectories(rows).items():
+        if len(traj) < 2:
+            continue
+        latest = traj[-1]
+        best_prior = max(traj[:-1], key=lambda r: r["value"])
+        floor = best_prior["value"] * (1.0 - tolerance)
+        if latest["value"] < floor:
+            problems.append(
+                f"{latest['file']}: {latest['value']:.1f} is "
+                f"{(1 - latest['value'] / best_prior['value']) * 100:.1f}% "
+                f"below best prior {best_prior['value']:.1f} "
+                f"({best_prior['file']}) at shape [{_shape_str(shape)}] "
+                f"(tolerance {tolerance * 100:.0f}%)"
+            )
+    return problems
+
+
+def table(rows: List[Dict], markdown: bool = False) -> str:
+    """The trajectory table (``--markdown`` = the BENCHMARKS.md embed)."""
+    out = []
+    if markdown:
+        out.append(
+            "| round | file | decisions/s | vs prior | compile_s |"
+        )
+        out.append("|---|---|---|---|---|")
+    for shape, traj in sorted(
+        trajectories(rows).items(), key=lambda kv: _shape_str(kv[0])
+    ):
+        if not markdown:
+            out.append(f"# shape: {_shape_str(shape)}")
+        prev = None
+        for r in traj:
+            ratio = (
+                f"{r['value'] / prev:.2f}x" if prev else "—"
+            )
+            comp = (
+                f"{r['compile_s']:.1f}" if r["compile_s"] is not None
+                else "—"
+            )
+            if markdown:
+                out.append(
+                    f"| r{r['round']} | {r['file']} | "
+                    f"{r['value']:,.0f} | {ratio} | {comp} |"
+                )
+            else:
+                out.append(
+                    f"  r{r['round']:<2} {r['value']:>14,.1f} {r['unit']}"
+                    f"  ({ratio}, compile {comp}s)  {r['file']}"
+                )
+            prev = r["value"]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/bench_trend.py",
+        description="bench trajectory table + >10%% regression CI gate",
+    )
+    ap.add_argument("--root", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."
+    ))
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on a >tolerance regression vs the best "
+                    "prior round at the same shape")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit the markdown table BENCHMARKS.md embeds")
+    ap.add_argument("--tolerance", type=float, default=TOLERANCE)
+    args = ap.parse_args(argv)
+    rows = load_rounds(args.root)
+    if not rows:
+        print("bench_trend: no parseable BENCH_r*/MULTICHIP_r* captures",
+              file=sys.stderr)
+        return 0 if args.check else 2
+    if args.check:
+        problems = check(rows, args.tolerance)
+        for p in problems:
+            print(f"REGRESSION: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        shapes = len(trajectories(rows))
+        print(
+            f"bench_trend ok: {len(rows)} captures, {shapes} shape(s), "
+            f"no regression > {args.tolerance * 100:.0f}%"
+        )
+        return 0
+    print(table(rows, markdown=args.markdown))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

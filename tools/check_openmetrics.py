@@ -1,11 +1,22 @@
 """OpenMetrics text-format lint: `python tools/check_openmetrics.py FILE...`.
 
-Checks the subset the telemetry exposition emits: every line is either a
-`# TYPE <name> <kind>` / `# EOF` comment or a `<name>[{labels}] <value>`
-sample with a finite decimal value, the file ends with `# EOF`, and —
-since the fleet exposition grew per-replica labels (r6) — no two samples
-share the same (name, label-set): duplicate series are an exposition bug
-a scraper would silently last-write-win on.
+Checks the subset the telemetry exposition emits, extended for the live
+health plane (r6):
+
+* every line is a ``# HELP`` / ``# TYPE`` / ``# EOF`` comment or a
+  ``<name>[{labels}] <value>`` sample with a finite decimal value, and
+  the file ends with ``# EOF``;
+* every sample's family carries BOTH a ``# TYPE`` and a ``# HELP``
+  line (scrape UIs surface the help text; a bare family reads as an
+  exposition bug) — histogram samples (``_bucket``/``_sum``/``_count``
+  suffixes) resolve to their base family;
+* no two samples share the same (name, label-set): a scraper would
+  silently last-write-win on duplicates;
+* histogram families obey the bucket contract: every ``_bucket``
+  sample has an ``le`` label, each label-group's ``le`` values ascend
+  strictly and terminate at ``+Inf``, bucket counts are cumulative
+  (non-decreasing), the group's ``_count`` equals its ``+Inf`` bucket
+  and a ``_sum`` sample is present.
 """
 import math
 import re
@@ -16,28 +27,161 @@ SAMPLE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
     rf'(\{{{LABEL}(,{LABEL})*\}})? -?[0-9][0-9.eE+-]*$'
 )
-TYPE = re.compile(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* [a-z]+$")
+TYPE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) ([a-z]+)$")
+HELP = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) \S.*$")
+LABEL_ONE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"\\\n]*)"')
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
-def check(path: str) -> int:
-    lines = open(path).read().splitlines()
+def _parse_labels(text):
+    """'{a="1",b="2"}' -> dict; '' -> {}."""
+    return dict(LABEL_ONE.findall(text or ""))
+
+
+def _family(name, types):
+    """Resolve a sample name to its metadata family: histogram samples
+    drop their `_bucket`/`_sum`/`_count` suffix when the base family is
+    TYPE histogram."""
+    for suf in _HIST_SUFFIXES:
+        if name.endswith(suf):
+            base = name[: -len(suf)]
+            if types.get(base) == "histogram":
+                return base
+    return name
+
+
+def check_lines(lines, where: str) -> int:
+    types, helps = {}, {}
+    samples = []  # (lineno, name, labels_text, value)
     seen = set()
     for i, ln in enumerate(lines, 1):
-        if ln == "# EOF" or TYPE.match(ln):
+        if ln == "# EOF":
             continue
+        mt = TYPE.match(ln)
+        if mt:
+            if mt.group(1) in types:
+                print(f"{where}:{i}: duplicate # TYPE for {mt.group(1)}")
+                return 1
+            types[mt.group(1)] = mt.group(2)
+            continue
+        mh = HELP.match(ln)
+        if mh:
+            helps[mh.group(1)] = True
+            continue
+        if ln.startswith("#"):
+            print(f"{where}:{i}: bad comment line: {ln!r}")
+            return 1
         m = SAMPLE.match(ln)
-        if not m or not math.isfinite(float(ln.rsplit(" ", 1)[1])):
-            print(f"{path}:{i}: bad OpenMetrics line: {ln!r}")
+        if not m:
+            print(f"{where}:{i}: bad OpenMetrics line: {ln!r}")
+            return 1
+        v = float(ln.rsplit(" ", 1)[1])
+        if not math.isfinite(v):
+            print(f"{where}:{i}: non-finite sample value: {ln!r}")
             return 1
         series = (m.group(1), m.group(2) or "")
         if series in seen:
-            print(f"{path}:{i}: duplicate series {m.group(1)}{series[1]}")
+            print(f"{where}:{i}: duplicate series {m.group(1)}{series[1]}")
             return 1
         seen.add(series)
+        samples.append((i, m.group(1), m.group(2) or "", v))
     if not lines or lines[-1] != "# EOF":
-        print(f"{path}: missing trailing '# EOF'")
+        print(f"{where}: missing trailing '# EOF'")
         return 1
+    # metadata coverage: every sample family needs # TYPE and # HELP
+    for i, name, _labels, _v in samples:
+        fam = _family(name, types)
+        if fam not in types:
+            print(f"{where}:{i}: sample {name} has no # TYPE line")
+            return 1
+        if fam not in helps:
+            print(f"{where}:{i}: sample {name} has no # HELP line")
+            return 1
+    # histogram bucket contract
+    hist_fams = {n for n, k in types.items() if k == "histogram"}
+    for fam in hist_fams:
+        groups = {}  # non-le label signature -> [(le, count, lineno)]
+        counts, sums = {}, set()
+        for i, name, labels_text, v in samples:
+            labels = _parse_labels(labels_text)
+            if name == fam + "_bucket":
+                if "le" not in labels:
+                    print(
+                        f"{where}:{i}: {name} sample without an "
+                        "'le' label"
+                    )
+                    return 1
+                le = labels.pop("le")
+                key = tuple(sorted(labels.items()))
+                try:
+                    le_v = (
+                        float("inf") if le == "+Inf" else float(le)
+                    )
+                except ValueError:
+                    print(
+                        f"{where}:{i}: {name} has non-numeric "
+                        f"le={le!r}"
+                    )
+                    return 1
+                groups.setdefault(key, []).append((le_v, v, i))
+            elif name == fam + "_count":
+                key = tuple(sorted(labels.items()))
+                counts[key] = (v, i)
+            elif name == fam + "_sum":
+                sums.add(tuple(sorted(labels.items())))
+        if not groups:
+            print(f"{where}: histogram {fam} has no _bucket samples")
+            return 1
+        for key, rows in groups.items():
+            les = [le for le, _, _ in rows]
+            if les != sorted(les) or len(set(les)) != len(les):
+                print(
+                    f"{where}: histogram {fam}{dict(key)}: 'le' values "
+                    "not strictly ascending"
+                )
+                return 1
+            if not math.isinf(les[-1]):
+                print(
+                    f"{where}: histogram {fam}{dict(key)}: missing "
+                    "terminal '+Inf' bucket"
+                )
+                return 1
+            vals = [c for _, c, _ in rows]
+            if any(b < a for a, b in zip(vals, vals[1:])):
+                print(
+                    f"{where}: histogram {fam}{dict(key)}: bucket "
+                    "counts not cumulative"
+                )
+                return 1
+            if key not in counts:
+                print(
+                    f"{where}: histogram {fam}{dict(key)}: missing "
+                    "_count sample"
+                )
+                return 1
+            if counts[key][0] != vals[-1]:
+                print(
+                    f"{where}: histogram {fam}{dict(key)}: _count "
+                    f"{counts[key][0]} != +Inf bucket {vals[-1]}"
+                )
+                return 1
+            if key not in sums:
+                print(
+                    f"{where}: histogram {fam}{dict(key)}: missing "
+                    "_sum sample"
+                )
+                return 1
     return 0
+
+
+def check_text(text: str, where: str = "<text>") -> int:
+    """Lint an in-memory exposition (the live endpoint smoke test)."""
+    return check_lines(text.splitlines(), where)
+
+
+def check(path: str) -> int:
+    return check_lines(open(path).read().splitlines(), path)
 
 
 if __name__ == "__main__":

@@ -23,10 +23,13 @@ python -m tools.simlint fognetsimpp_tpu
 echo "== op budget (fused-tick kernel-count gate) =="
 JAX_PLATFORMS=cpu python tools/op_budget.py --check > /dev/null
 
-echo "== telemetry smoke (trace export + OpenMetrics lint) =="
+echo "== bench trend (>10% regression gate over BENCH_r*/MULTICHIP_r*) =="
+python tools/bench_trend.py --check
+
+echo "== telemetry smoke (trace export + OpenMetrics lint, hist on) =="
 TELEM_OUT="$(mktemp -d)"
 JAX_PLATFORMS=cpu python -m fognetsimpp_tpu --scenario smoke \
-    --set spec.horizon=0.5 --telemetry \
+    --set spec.horizon=0.5 --telemetry --hist --slo 100 \
     --trace-out "${TELEM_OUT}/trace.json" --out "${TELEM_OUT}" > /dev/null
 python -c "import json, sys; json.load(open(sys.argv[1]))" "${TELEM_OUT}/trace.json"
 python tools/check_openmetrics.py "${TELEM_OUT}"/General-0.om.txt

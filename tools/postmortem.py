@@ -1,0 +1,141 @@
+"""Flight-recorder post-mortem inspector.
+
+    python tools/postmortem.py DUMP.json            # summarize one dump
+    python tools/postmortem.py --diff A.json B.json # field-level diff
+
+A dump is the manifest :class:`fognetsimpp_tpu.telemetry.live
+.FlightRecorder` writes on NaN / SLO breach / watchdog anomaly / crash:
+the bounded ring of recent reservoir rows + per-chunk state hashes, the
+watchdog state, compile-cache stats, the spec and (when the world was
+at hand) a Perfetto trace twin.  The inspector answers the two
+first-response questions without opening a notebook: *what tripped*
+(reason, anomalies, nonfinite leaves) and *when the runs diverged*
+(``--diff`` walks the two rings and reports the first chunk whose state
+hashes disagree).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def summarize(d: Dict) -> List[str]:
+    out = [
+        f"reason:      {d.get('reason')}",
+        f"recorded_at: {d.get('recorded_at')}",
+        f"ticks_done:  {d.get('ticks_done')}",
+    ]
+    detail = d.get("detail") or {}
+    for k, v in detail.items():
+        out.append(f"detail.{k}: {json.dumps(v)[:200]}")
+    wd = d.get("watchdog") or {}
+    anomalies = wd.get("anomalies") or []
+    out.append(f"anomalies:   {len(anomalies)}")
+    for a in anomalies[-5:]:
+        out.append(
+            f"  - {a.get('signal')} z={a.get('z'):.2f} "
+            f"value={a.get('value')} at tick {a.get('ticks_done')}"
+        )
+    if wd.get("last_signals"):
+        out.append(f"signals:     {json.dumps(wd['last_signals'])}")
+    hist = d.get("hist") or {}
+    if hist:
+        out.append(
+            f"latency:     n={hist.get('count')} "
+            f"quantiles_ms={json.dumps(hist.get('quantiles_ms'))}"
+        )
+    cc = d.get("compile_cache") or {}
+    if cc:
+        out.append(
+            "compile:     "
+            f"hits={cc.get('cache_hits')} misses={cc.get('cache_misses')} "
+            f"compiles={cc.get('compiles')} "
+            f"compile_s_total={cc.get('compile_s_total')}"
+        )
+    ring = d.get("ring") or []
+    out.append(f"ring:        {len(ring)} chunk(s)")
+    if ring:
+        first, last = ring[0], ring[-1]
+        out.append(
+            f"  ticks {first['ticks_done']} .. {last['ticks_done']}, "
+            f"hashes {'present' if last.get('state_hash') else 'absent'}"
+        )
+    if d.get("trace"):
+        out.append(f"trace:       {d['trace']}")
+    return out
+
+
+def diff(a: Dict, b: Dict) -> List[str]:
+    """Field-level diff of two dumps; pinpoints first hash divergence."""
+    out = []
+    for key in ("reason", "ticks_done"):
+        if a.get(key) != b.get(key):
+            out.append(f"{key}: {a.get(key)} != {b.get(key)}")
+    ra = {e["ticks_done"]: e for e in a.get("ring") or []}
+    rb = {e["ticks_done"]: e for e in b.get("ring") or []}
+    shared = sorted(set(ra) & set(rb))
+    if not shared:
+        out.append("rings share no chunk boundaries")
+        return out
+    first_div = None
+    for t in shared:
+        ha, hb = ra[t].get("state_hash"), rb[t].get("state_hash")
+        if ha and hb and ha != hb:
+            first_div = t
+            break
+    if first_div is None:
+        out.append(
+            f"state hashes agree on all {len(shared)} shared chunk(s)"
+        )
+    else:
+        out.append(f"first state-hash divergence at tick {first_div}")
+    for t in shared:
+        for field, va in (ra[t].get("rows") or {}).items():
+            vb = (rb[t].get("rows") or {}).get(field)
+            if vb is not None and va != vb:
+                out.append(
+                    f"tick {t}: reservoir field {field!r} differs "
+                    f"(first {next((i for i, (x, y) in enumerate(zip(va, vb)) if x != y), '?')})"
+                )
+    wa = (a.get("watchdog") or {}).get("anomalies") or []
+    wb = (b.get("watchdog") or {}).get("anomalies") or []
+    if len(wa) != len(wb):
+        out.append(f"anomaly count: {len(wa)} != {len(wb)}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/postmortem.py",
+        description="inspect / diff flight-recorder post-mortem dumps",
+    )
+    ap.add_argument("paths", nargs="+", metavar="DUMP.json")
+    ap.add_argument(
+        "--diff", action="store_true",
+        help="diff exactly two dumps instead of summarizing each",
+    )
+    args = ap.parse_args(argv)
+    if args.diff:
+        if len(args.paths) != 2:
+            ap.error("--diff needs exactly two dump paths")
+        lines = diff(load(args.paths[0]), load(args.paths[1]))
+        print("\n".join(lines) if lines else "dumps are equivalent")
+        return 0
+    for p in args.paths:
+        print(f"== {p} ==")
+        print("\n".join(summarize(load(p))))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closed stdout; not an error
+        sys.exit(0)

@@ -54,6 +54,11 @@ DEVICE_MODULE_GLOBS: Tuple[str, ...] = (
     "parallel/tp.py",
     "state.py",
 )
+# telemetry/metrics.py and telemetry/health.py are deliberately NOT
+# blanket device modules: each mixes one carry-resident accumulation
+# function (device, reached through core/engine.py which IS covered)
+# with host-side post-run readers over fetched numpy arrays — a
+# blanket classification would flag the legitimate host half.
 
 # Annotation tokens that mean "static under jit" (hashable, not traced).
 STATIC_TYPE_TOKENS: Set[str] = {
