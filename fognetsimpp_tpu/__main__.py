@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -121,6 +122,14 @@ def main(argv=None) -> int:
                     "--replicas is omitted")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (cpu/tpu)")
+    ap.add_argument("--checkify", nargs="?", const="div", default=None,
+                    metavar="SETS",
+                    help="debug SLOW PATH: run under "
+                    "jax.experimental.checkify with the named error "
+                    "sets (comma-joined from nan,div,oob, or 'all'; "
+                    "default div — engine.CHECKIFY_SETS documents why "
+                    "nan/oob page on two deliberate idioms); also "
+                    "enabled by FNS_CHECKIFY=1 or FNS_CHECKIFY=<SETS>")
     ap.add_argument("--analyze", metavar="DIR", default=None,
                     help="analyse recorded runs in DIR and exit (.anf analog)")
     _dyn_names = ", ".join(
@@ -162,6 +171,24 @@ def main(argv=None) -> int:
     from .core.engine import run
     from .runtime.recorder import record_run
     from .runtime.signals import summarize
+
+    # opt-in runtime sanitizer (ISSUE 7 satellite): --checkify wins,
+    # else the FNS_CHECKIFY env knob ("1"/set names; "0"/"" = off)
+    checkify_sets = args.checkify
+    if checkify_sets is None:
+        env = os.environ.get("FNS_CHECKIFY", "")
+        if env.lower() not in ("", "0", "off", "false", "no"):
+            checkify_sets = env
+    if checkify_sets is not None and (
+        args.serve is not None
+        or args.replicas is not None
+        or args.mesh is not None
+        or args.sweep
+        or args.progress
+    ):
+        ap.error("--checkify/FNS_CHECKIFY is the single-world debug "
+                 "slow path; it does not combine with "
+                 "--serve/--replicas/--mesh/--sweep/--progress")
 
     text = ""
     if args.config:
@@ -527,6 +554,26 @@ def main(argv=None) -> int:
             final = run_chunked(spec, state, net, bounds,
                                 chunk_ticks=args.progress, callback=_cb)
             series = None
+        elif checkify_sets is not None:
+            from jax.experimental.checkify import JaxRuntimeError
+
+            from .core.engine import _checkify_errors, run_checkified
+
+            try:
+                _checkify_errors(checkify_sets)  # unknown set names
+            except ValueError as e:
+                print(f"error: checkify: {e}", file=sys.stderr)
+                return 1
+            try:
+                final, series = run_checkified(
+                    spec, state, net, bounds, errors=checkify_sets
+                )
+            except JaxRuntimeError as e:
+                # a tripped runtime check: one actionable line (the
+                # offending primitive is in the message); any other
+                # error is NOT the sanitizer's and keeps its traceback
+                print(f"error: checkify: {e}", file=sys.stderr)
+                return 1
         else:
             final, series = run(spec, state, net, bounds)
         import jax

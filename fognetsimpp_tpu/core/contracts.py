@@ -44,6 +44,9 @@ def assert_same_struct(expected, got, what: str = "pytree") -> None:
     ignored: weak f32 and strong f32 lower identically)."""
     exp_paths, exp_def = jax.tree_util.tree_flatten_with_path(expected)
     got_paths, got_def = jax.tree_util.tree_flatten_with_path(got)
+    # simlint: disable=R2 -- treedefs are host metadata: tree_flatten
+    # returns (traced leaves, HOST treedef) and this branch compares
+    # only the latter; the flow layer cannot split the tuple's halves
     if exp_def != got_def:
         raise ContractError(
             f"{what}: tree structure changed\n"

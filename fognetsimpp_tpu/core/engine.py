@@ -3428,3 +3428,85 @@ def _run_jit(
 ) -> WorldState:
     final, _ = run(spec, state, net, bounds)
     return final
+
+
+#: ``FNS_CHECKIFY`` / ``--checkify`` error-set names.  ``div`` is the
+#: default: the other two sets page on two DELIBERATE engine idioms —
+#: ``nan`` fires on inf-sentinel arithmetic in masked lanes (checkify
+#: instruments the untaken side of every ``jnp.where``, and the ack
+#: columns subtract ``inf - inf`` there by design) and ``oob`` fires on
+#: the sentinel drop-scatter idiom (``NO_TASK`` rows index one past the
+#: table so the scatter drops them — well-defined JAX semantics the
+#: phases rely on).  Both stay available for targeted debugging; their
+#: known-benign findings on the stock engine are exactly those two
+#: classes.
+CHECKIFY_SETS = ("nan", "div", "oob")
+
+
+def _checkify_errors(names: Optional[str]):
+    from jax.experimental import checkify
+
+    table = {
+        "nan": checkify.nan_checks,
+        "div": checkify.div_checks,
+        "oob": checkify.index_checks,
+    }
+    # "1"/"on"/"true" are the FNS_CHECKIFY boolean-enable spellings; a
+    # "0" reaching here is a CLI `--checkify 0` that MEANT "off" — the
+    # env layer already treats 0 as disabled, so reject it loudly
+    # rather than silently taking the slow path with the default set
+    if names is None or names in ("", "1", "on", "true", "div"):
+        picked = ["div"]
+    elif names == "all":
+        picked = list(CHECKIFY_SETS)
+    else:
+        picked = [t.strip() for t in names.split(",") if t.strip()]
+        bad = sorted(set(picked) - set(CHECKIFY_SETS))
+        if bad:
+            raise ValueError(
+                f"unknown checkify set(s) {bad} "
+                f"(have {list(CHECKIFY_SETS)} or 'all')"
+            )
+    errs = checkify.user_checks
+    for t in picked:
+        errs = errs | table[t]
+    return errs
+
+
+def run_checkified(
+    spec: WorldSpec,
+    state: WorldState,
+    net: NetParams,
+    bounds: Optional[MobilityBounds] = None,
+    n_ticks: Optional[int] = None,
+    errors: Optional[str] = None,
+) -> Tuple[WorldState, Optional[dict]]:
+    """Opt-in runtime sanitizer: the full-horizon run under
+    ``jax.experimental.checkify`` (ISSUE 7 satellite).
+
+    SLOW PATH, debug runs only: checkify threads a functionalized error
+    carry through every instrumented primitive in the scan body, so the
+    compiled program is materially slower and allocates extra carry
+    state — never benchmark or gate on it.  Enabled via ``FNS_CHECKIFY=1``
+    or CLI ``--checkify``; ``errors`` picks the instrumented sets
+    (comma-joined names from :data:`CHECKIFY_SETS`, or ``"all"`` —
+    default ``div``; see the :data:`CHECKIFY_SETS` note for why ``nan``/
+    ``oob`` page on two deliberate engine idioms).  Raises
+    ``checkify.JaxRuntimeError`` (via ``err.throw()``) on the first
+    check that trips, with the offending primitive in the message.
+    """
+    if bounds is None:
+        from ..net.mobility import default_bounds
+
+        bounds = default_bounds()
+    errs = _checkify_errors(errors)
+    from jax.experimental import checkify
+
+    def go(s, net_, bounds_):
+        return run(spec, s, net_, bounds_, n_ticks=n_ticks)
+
+    err, (final, series) = jax.jit(checkify.checkify(go, errors=errs))(
+        state, net, bounds
+    )
+    err.throw()
+    return final, series

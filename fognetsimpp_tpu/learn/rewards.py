@@ -26,6 +26,27 @@ def reward_from_latency(lat: jax.Array, scale: float) -> jax.Array:
     return jnp.exp(-jnp.maximum(lat, 0.0) / jnp.float32(scale))
 
 
+def _credit_counts_exact(k_rows: int) -> None:
+    """Static guard for the integer-valued f32 credit counters (simlint
+    R10, the ``engine._fused_mips_exact`` pattern).
+
+    ``credit_batch`` counts credit rows by summing booleans in f32
+    (``cnt_f``/``lat_cnt``): each per-tick increment is an exact integer
+    — and therefore reduction-order/backend independent — only while the
+    summed width stays below 2^24.  ``k_rows`` is the static credit
+    window (a trace-time shape), so this raises at trace time, never on
+    device.  The CUMULATIVE counters stay exact while total credits per
+    fog stay below 2^24 (~16.7M acks); ``tools/hloaudit`` audit rule A4
+    pins that end via ``spec.task_capacity`` on learn-active specs.
+    """
+    if k_rows >= 2 ** 24:
+        raise ValueError(
+            f"credit window of {k_rows} rows >= 2^24: the f32 credit "
+            "count sums lose integer exactness — shrink the compaction "
+            "window or switch the counters to int32"
+        )
+
+
 def credit_batch(
     learn: LearnState,
     valid: jax.Array,  # (K,) bool — rows of this tick's credit window
@@ -44,6 +65,7 @@ def credit_batch(
     caller's to set (it owns the compaction indices).
     """
     f32 = jnp.float32
+    _credit_counts_exact(int(valid.shape[0]))
     r01 = jnp.where(valid, reward_from_latency(lat, reward_scale), 0.0)
     cnt_f = jnp.sum(memb, axis=1, dtype=f32)  # (F,)
     sum_f = jnp.sum(jnp.where(memb, r01[None, :], 0.0), axis=1)

@@ -50,6 +50,15 @@ from ..state import WorldState
 from .mesh import REPLICA_AXIS, make_mesh, shard_world
 
 
+#: The fleet's headline sharding claim, made statically checkable: the
+#: replica-DP layout compiles to ZERO steady-state collectives (replicas
+#: never communicate).  ``tools/hloaudit`` audits the compiled fleet
+#: scan against this empty table (rule A3), so a future engine change
+#: that makes GSPMD insert a cross-replica combine fails CI instead of
+#: silently taxing every tick.
+DECLARED_COLLECTIVES: Dict[str, set] = {}
+
+
 def fold_replica_keys(key: jax.Array, n_replicas: int) -> jax.Array:
     """(R, 2) per-replica keys: ``fold_in(key, r)`` for each replica id.
 

@@ -36,6 +36,15 @@ except ImportError:  # pragma: no cover - older jax: check_rep, not check_vma
 
 FOG_AXIS = "fog"
 
+#: Collectives this module's compiled programs are ALLOWED to contain,
+#: keyed by the op_name scope they must attribute to — the contract
+#: ``tools/hloaudit`` enforces on the compiled artifact (audit rule A3).
+#: The two-stage combine is exactly one all_gather family inside the
+#: shard_map body; anything else (an accidental all-reduce from a leaked
+#: sharding annotation, a GSPMD resharding all-to-all) is a fatal CI
+#: finding.  Extend this table in the same change that adds a collective.
+DECLARED_COLLECTIVES = {"shmap_body": {"all-gather"}}
+
 
 def sharded_min_busy(
     mesh: Mesh,

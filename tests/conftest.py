@@ -73,6 +73,10 @@ _QUICK_FILES = {
     # gate — the kernel-count win's correctness and its CI lock
     "test_fused.py",
     "test_op_budget.py",
+    # compiled-artifact auditor (ISSUE 7): canned-HLO rule units are
+    # milliseconds; the live tier compiles one tick + the TP dryrun —
+    # the same correctness rail the TP-sharding promotion runs on
+    "test_hloaudit.py",
 }
 
 

@@ -115,3 +115,28 @@ def test_deterministic_same_seed():
     np.testing.assert_array_equal(
         np.asarray(f1.tasks.mips_req), np.asarray(f2.tasks.mips_req)
     )
+
+
+def test_checkify_sanitizer_smoke():
+    """The opt-in runtime sanitizer (FNS_CHECKIFY / --checkify, ISSUE 7
+    satellite): the default `div` set runs the smoke world clean AND
+    bit-exact vs the plain path; the opt-in `nan` set demonstrably
+    trips on the engine's deliberate inf-sentinel masked-lane
+    arithmetic (the documented known-benign class — proving the error
+    carry threads through the whole scan)."""
+    from jax.experimental.checkify import JaxRuntimeError
+
+    from fognetsimpp_tpu.core.engine import run_checkified
+
+    spec, state, net, bounds = smoke.build(horizon=0.3, seed=7)
+    ref, _ = run(spec, state, net, bounds)
+    spec2, state2, net2, bounds2 = smoke.build(horizon=0.3, seed=7)
+    final, _ = run_checkified(spec2, state2, net2, bounds2)  # default: div
+    np.testing.assert_array_equal(
+        np.asarray(ref.tasks.t_ack6), np.asarray(final.tasks.t_ack6)
+    )
+    spec3, state3, net3, bounds3 = smoke.build(horizon=0.3, seed=7)
+    with pytest.raises(JaxRuntimeError):
+        run_checkified(spec3, state3, net3, bounds3, errors="nan")
+    with pytest.raises(ValueError):
+        run_checkified(spec3, state3, net3, bounds3, errors="bogus")

@@ -1,0 +1,399 @@
+"""Compiled-artifact auditor (tools/hloaudit, ISSUE 7).
+
+Unit tier: the shared HLO parser and every audit rule on canned HLO
+text (host-transfer, f64-promotion, undeclared/degenerate-collective
+and phase-attribution/manifest cases — no compiles, milliseconds).
+
+Live tier: seeded regressions through real `jit(...).lower().compile()`
+— an injected host sync (`pure_callback` carrying a `float()`) and a
+forced f64 `convert` each produce a fatal finding; the TP dryrun step
+compiles with ONLY its declared collectives; and the fused tick at the
+CPU budget shape audits clean against its checked-in manifest (the same
+gate `python -m tools.hloaudit --check` runs in CI).
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from tools.hloaudit.audit import (
+    AuditFinding,
+    check_collectives,
+    check_exact_integer_bound,
+    check_f64,
+    check_host_transfers,
+    check_manifest,
+)
+from tools.hloaudit.hlo import entry_op_counts, parse_hlo
+
+# ----------------------------------------------------------------------
+# canned HLO (the grammar tools/hloaudit/hlo.py documents)
+# ----------------------------------------------------------------------
+
+CANNED = """\
+HloModule canned.0, entry_computation_layout={(f32[8]{0})->f32[8]{0}}
+
+%fused_computation (param_0: f32[8]) -> f32[8] {
+  %param_0 = f32[8]{0} parameter(0)
+  ROOT %mul.1 = f32[8]{0} multiply(%param_0, %param_0), metadata={op_name="jit(f)/jit(main)/phase_spawn/mul" source_file="a.py"}
+}
+
+ENTRY %main.4 (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %c0 = f32[] constant(2)
+  %fusion = f32[8]{0} fusion(%p0), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(f)/jit(main)/phase_spawn/mul"}
+  ROOT %add.2 = f32[8]{0} add(%fusion, %p0), metadata={op_name="jit(f)/jit(main)/phase_broker/add"}
+}
+"""
+
+
+def test_parser_counts_and_attribution():
+    mod = parse_hlo(CANNED)
+    assert mod.name == "canned.0"
+    assert [c.name for c in mod.computations] == [
+        "fused_computation", "main.4",
+    ]
+    assert mod.entry.name == "main.4"
+    # parameter/constant are trivial; fusion + add count
+    assert mod.entry_op_counts() == {"ops": 2, "fusions": 1}
+    assert entry_op_counts(CANNED) == {"ops": 2, "fusions": 1}
+    # phase attribution rides op_name: the nested fusion body attributes
+    # too (all_instructions), ENTRY-only view stays consistent
+    assert mod.phase_op_counts() == {"broker": 1, "spawn": 2}
+    assert mod.phase_op_counts(entry_only=True) == {"broker": 1, "spawn": 1}
+
+
+def test_parser_matches_op_budget_counting():
+    """tools/op_budget.entry_op_counts IS the shared parser (the ISSUE 7
+    refactor): identical numbers on the same text, by construction."""
+    from tools.op_budget import entry_op_counts as budget_counts
+
+    assert budget_counts(CANNED) == entry_op_counts(CANNED)
+
+
+def test_parser_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_hlo("not hlo at all\n")
+
+
+def _entry(body: str) -> str:
+    return (
+        "HloModule m.0\n\n"
+        "ENTRY %main.9 (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        f"{body}"
+        "}\n"
+    )
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ----------------------------------------------------------------------
+# A1 host transfers
+# ----------------------------------------------------------------------
+
+def test_a1_host_transfer_op():
+    mod = parse_hlo(_entry(
+        "  %out = token[] outfeed(%p0), outfeed_config=\"x\"\n"
+        "  ROOT %n = f32[8]{0} negate(%p0)\n"
+    ))
+    assert _rules(check_host_transfers(mod, "v")) == ["A1"]
+
+
+def test_a1_host_callback_custom_call():
+    mod = parse_hlo(_entry(
+        "  %cc = f32[8]{0} custom-call(%p0), "
+        "custom_call_target=\"xla_ffi_python_cpu_callback\", "
+        "custom_call_has_side_effect=true, "
+        "metadata={op_name=\"jit(f)/pure_callback\"}\n"
+        "  ROOT %n = f32[8]{0} negate(%cc)\n"
+    ))
+    out = check_host_transfers(mod, "v")
+    assert _rules(out) == ["A1"] and "callback" in out[0].message
+
+
+def test_a1_clean_compute_custom_call():
+    # a backend compute kernel custom-call (no host target, no side
+    # effect) is NOT a host round-trip
+    mod = parse_hlo(_entry(
+        "  %cc = f32[8]{0} custom-call(%p0), "
+        "custom_call_target=\"__onednn$matmul\"\n"
+        "  ROOT %n = f32[8]{0} negate(%cc)\n"
+    ))
+    assert check_host_transfers(mod, "v") == []
+
+
+# ----------------------------------------------------------------------
+# A2 64-bit floats
+# ----------------------------------------------------------------------
+
+def test_a2_f64_convert_chain():
+    mod = parse_hlo(_entry(
+        "  %cv = f64[8]{0} convert(%p0), "
+        "metadata={op_name=\"jit(f)/phase_spawn/convert\"}\n"
+        "  %m = f64[8]{0} multiply(%cv, %cv)\n"
+        "  ROOT %dn = f32[8]{0} convert(%m)\n"
+    ))
+    out = check_f64(mod, "v")
+    # the promoting convert AND the f64 multiply both surface (the
+    # downcast's own line shows only f32) — the chain is visible
+    assert len(out) == 2 and _rules(out) == ["A2"]
+    assert any("convert" in f.message for f in out)
+
+
+def test_a2_ignores_metadata_strings():
+    # "f64" inside metadata (a source path) must not trip the dtype scan
+    mod = parse_hlo(_entry(
+        "  ROOT %n = f32[8]{0} negate(%p0), "
+        "metadata={op_name=\"jit(f)/f64_compat/neg\" source_file=\"f64[x].py\"}\n"
+    ))
+    assert check_f64(mod, "v") == []
+
+
+# ----------------------------------------------------------------------
+# A3 collectives
+# ----------------------------------------------------------------------
+
+_AG = (
+    "  %ag = f32[64]{0} all-gather(%p0), replica_groups={{0,1,2,3,4,5,6,7}}, "
+    "dimensions={0}, metadata={op_name=\"jit(f)/shmap_body/all_gather\"}\n"
+    "  ROOT %n = f32[8]{0} negate(%p0)\n"
+)
+
+
+def test_a3_collective_in_single_device_compile():
+    mod = parse_hlo(_entry(_AG))
+    out = check_collectives(mod, "v", sharded=False)
+    assert _rules(out) == ["A3"] and "SINGLE-DEVICE" in out[0].message
+
+
+def test_a3_declared_collective_is_clean():
+    mod = parse_hlo(_entry(_AG))
+    assert check_collectives(
+        mod, "v", sharded=True, declared={"shmap_body": {"all-gather"}}
+    ) == []
+
+
+def test_a3_undeclared_collective():
+    mod = parse_hlo(_entry(_AG))
+    out = check_collectives(
+        mod, "v", sharded=True, declared={"shmap_body": {"all-reduce"}}
+    )
+    assert _rules(out) == ["A3"] and "undeclared" in out[0].message
+
+
+def test_a3_degenerate_collective():
+    body = _AG.replace("{{0,1,2,3,4,5,6,7}}", "{{0},{1}}")
+    mod = parse_hlo(_entry(body))
+    out = check_collectives(
+        mod, "v", sharded=True, declared={"shmap_body": {"all-gather"}}
+    )
+    assert _rules(out) == ["A3"] and "degenerate" in out[0].message
+
+
+def test_a3_async_start_normalizes():
+    body = _AG.replace("all-gather(", "all-gather-start(")
+    mod = parse_hlo(_entry(body))
+    assert check_collectives(
+        mod, "v", sharded=True, declared={"shmap_body": {"all-gather"}}
+    ) == []
+
+
+def test_tuple_typed_results_are_parsed():
+    """Async collective starts and send/recv carry TUPLE result types
+    (spaces in the type text) — the ops A1/A3 exist for.  A parser that
+    requires a space-free type silently drops exactly those lines
+    (review-pass regression)."""
+    body = (
+        "  %ags = (f32[8]{0}, f32[64]{0}) all-gather-start(%p0), "
+        "replica_groups={{0},{1}}, dimensions={0}, "
+        "metadata={op_name=\"jit(f)/shmap_body/all_gather\"}\n"
+        "  %agd = f32[64]{0} all-gather-done(%ags)\n"
+        "  %rv = (f32[8]{0}, u32[], token[]) recv(%p0), channel_id=1\n"
+        "  ROOT %n = f32[8]{0} negate(%p0)\n"
+    )
+    mod = parse_hlo(_entry(body))
+    assert {i.opcode for i in mod.all_instructions()} >= {
+        "all-gather-start", "all-gather-done", "recv",
+    }
+    # the recv is an A1 host transfer; the start op is flagged in a
+    # single-device compile AND as degenerate when sharded — the -done
+    # half does not double-report
+    assert _rules(check_host_transfers(mod, "v")) == ["A1"]
+    single = check_collectives(mod, "v", sharded=False)
+    assert _rules(single) == ["A3"] and len(single) == 1
+    degen = check_collectives(
+        mod, "v", sharded=True, declared={"shmap_body": {"all-gather"}}
+    )
+    assert len(degen) == 1 and "degenerate" in degen[0].message
+
+
+# ----------------------------------------------------------------------
+# A4 f32 exact-integer bound (drift between engine gate and audit)
+# ----------------------------------------------------------------------
+
+def test_a4_detects_gate_drift(monkeypatch):
+    from fognetsimpp_tpu.core import engine as E
+    from fognetsimpp_tpu.scenarios import smoke
+    from tools.op_budget import PINNED
+
+    spec, *_ = smoke.build(**PINNED)
+    assert check_exact_integer_bound(spec, "v") == []  # in-bound: clean
+    big = dataclasses.replace(spec, fixed_mips_required=float(2 ** 24))
+    # simulate the drift A4 exists for: the engine's own gate claims the
+    # merged reduction is still exact while the audit's independent
+    # derivation says the bound is blown
+    monkeypatch.setattr(E, "_fused_ok", lambda s: True)
+    out = check_exact_integer_bound(big, "v")
+    assert _rules(out) == ["A4"] and "2^24" in out[0].message
+
+
+# ----------------------------------------------------------------------
+# A5 manifests
+# ----------------------------------------------------------------------
+
+def _manifest(**over):
+    m = {
+        "max_ops": 2, "max_fusions": 1,
+        "phases": {"broker": 1, "spawn": 2},
+    }
+    m.update(over)
+    return m
+
+
+def test_a5_missing_manifest():
+    mod = parse_hlo(CANNED)
+    assert _rules(check_manifest(mod, "v", None)) == ["A5"]
+
+
+def test_a5_within_caps_and_phases_clean():
+    mod = parse_hlo(CANNED)
+    assert check_manifest(mod, "v", _manifest()) == []
+
+
+def test_a5_count_regression():
+    mod = parse_hlo(CANNED)
+    out = check_manifest(mod, "v", _manifest(max_ops=1))
+    assert _rules(out) == ["A5"] and "regressed" in out[0].message
+
+
+def test_a5_phase_set_drift():
+    mod = parse_hlo(CANNED)
+    out = check_manifest(
+        mod, "v", _manifest(phases={"broker": 1, "spawn": 2, "rank": 4})
+    )
+    assert _rules(out) == ["A5"] and "rank" in out[0].message
+
+
+# ----------------------------------------------------------------------
+# live compiles: seeded regressions must fail, production must not
+# ----------------------------------------------------------------------
+
+def test_seeded_host_sync_is_fatal():
+    """An injected host round-trip — a `pure_callback` whose host half
+    is a `float()` sync — must surface as a fatal A1 in the compiled
+    artifact, whatever the source tier missed."""
+    import jax
+    import jax.numpy as jnp
+
+    def bad(x):
+        y = jax.pure_callback(
+            lambda a: np.float32(float(a)),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jnp.sum(x),
+        )
+        return x + y
+
+    text = jax.jit(bad).lower(
+        jnp.ones((8,), jnp.float32)
+    ).compile().as_text()
+    out = check_host_transfers(parse_hlo(text), "seeded")
+    assert "A1" in _rules(out), text
+
+
+def test_seeded_f64_convert_is_fatal():
+    """A forced f64 promotion must surface as a fatal A2."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        text = jax.jit(
+            lambda x: (x.astype(jnp.float64) * 2.0).sum()
+        ).lower(jnp.ones((8,), jnp.float32)).compile().as_text()
+    out = check_f64(parse_hlo(text), "seeded")
+    assert "A2" in _rules(out), text
+
+
+def test_tp_dryrun_compiles_with_only_declared_collectives():
+    """The ROADMAP's correctness rail: the TP fog-sharded argmin step
+    carries EXACTLY its declared collectives — nothing else, nothing
+    degenerate — and audits clean end-to-end."""
+    from fognetsimpp_tpu.parallel.tp import DECLARED_COLLECTIVES
+    from tools.hloaudit.audit import audit_module
+    from tools.hloaudit.hlo import COLLECTIVE_OPS
+    from tools.hloaudit.variants import _compile_tp
+
+    text, _ = _compile_tp()
+    mod = parse_hlo(text)
+    seen = {
+        (i.opcode[:-6] if i.opcode.endswith("-start") else i.opcode)
+        for i in mod.all_instructions()
+        if (i.opcode[:-6] if i.opcode.endswith("-start") else i.opcode)
+        in COLLECTIVE_OPS
+    }
+    declared = set().union(*DECLARED_COLLECTIVES.values())
+    assert seen == declared, (seen, declared)
+    findings = audit_module(
+        mod, "tp_dryrun", sharded=True,
+        declared_collectives=DECLARED_COLLECTIVES,
+        check_manifest_counts=False,
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_budget_shape_audits_clean_against_manifest():
+    """The CI gate in miniature: compile the fused tick at the pinned
+    CPU budget shape and audit it against its checked-in manifest."""
+    from tools.hloaudit.__main__ import (
+        audit_variant,
+        load_manifest,
+        measure_variant,
+    )
+    from tools.hloaudit.variants import variants
+
+    v = next(x for x in variants() if x.name == "tick_fused")
+    measured = measure_variant(v)
+    manifest = load_manifest(v.name)
+    assert manifest is not None, "tick_fused manifest not checked in"
+    findings = audit_variant(measured, manifest)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # the manifest's recorded counts are the live counts' caps
+    assert measured["entry"]["ops"] <= manifest["max_ops"]
+
+
+def test_manifest_files_are_valid_json():
+    import os
+
+    from tools.hloaudit.__main__ import MANIFEST_DIR
+    from tools.hloaudit.variants import variants
+
+    names = {v.name for v in variants()}
+    on_disk = {
+        f[:-5] for f in os.listdir(MANIFEST_DIR) if f.endswith(".json")
+    }
+    assert on_disk == names, (on_disk, names)
+    for f in sorted(on_disk):
+        with open(os.path.join(MANIFEST_DIR, f + ".json")) as fh:
+            m = json.load(fh)
+        assert {"max_ops", "max_fusions", "phases"} <= set(m), f
+
+
+def test_render_findings_format():
+    from tools.hloaudit.audit import render_findings
+
+    f = AuditFinding("A1", "tick_fused", "msg")
+    assert render_findings([f]) == "tick_fused: A1: msg"

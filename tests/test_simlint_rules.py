@@ -7,7 +7,7 @@ import pytest
 from tools.simlint.core import lint, write_baseline
 
 FIXTURES = Path(__file__).resolve().parents[1] / "tools" / "simlint" / "fixtures"
-ALL_RULES = [f"R{i}" for i in range(1, 9)]
+ALL_RULES = [f"R{i}" for i in range(1, 13)]
 
 
 @pytest.mark.parametrize("rid", ALL_RULES)
@@ -32,11 +32,79 @@ def test_expected_hit_counts():
         # finding per name)
         "R1": 4, "R2": 2, "R3": 5, "R4": 3, "R5": 2, "R6": 2, "R7": 1,
         "R8": 1,
+        # v2 rules (ISSUE 7): each bad fixture seeds exactly two shapes
+        # (R9: unbound PartitionSpec axis + unbound collective axis;
+        # R10: dtype=f32 count + bool->f32 astype sum; R11: unordered
+        # io_callback + ungated debug print; R12: plain reuse + reuse
+        # after a known-donating run entry)
+        "R9": 2, "R10": 2, "R11": 2, "R12": 2,
     }
     for rid, n in expected.items():
         res = lint([str(FIXTURES / f"{rid.lower()}_bad.py")])
         got = sum(1 for f in res.findings if f.rule == rid)
         assert got == n, f"{rid}: expected {n} findings, got {got}"
+
+
+def test_dataflow_assignment_tracking(tmp_path):
+    """The v2 dataflow layer: tracedness flows through assignments, so
+    branching on a DERIVED name fires R2 exactly like branching on the
+    parameter would."""
+    p = tmp_path / "flow.py"
+    p.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = x * 2\n"
+        "    z = jnp.cumsum(y)\n"
+        "    if z[0] > 0:\n"
+        "        return z\n"
+        "    return -z\n"
+    )
+    res = lint([str(p)])
+    assert [f.rule for f in res.findings] == ["R2"]
+
+
+def test_dataflow_host_result_stops_flow(tmp_path):
+    """Host-materializing calls cut the traced flow: a branch on
+    `jax.device_get(...)`'s result is a HOST branch (outside jit), not
+    an R2 — the v1 false-positive class the flow layer removes."""
+    p = tmp_path / "host.py"
+    p.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def drive(x):\n"
+        "    total = jax.device_get(jnp.sum(x))\n"
+        "    if total > 0:\n"
+        "        return total\n"
+        "    return 0.0\n"
+        "def probe(state):\n"
+        "    n = len(state)\n"
+        "    if n > 4:\n"
+        "        return n\n"
+        "    return 0\n"
+    )
+    res = lint([str(p)])
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+
+
+def test_dataflow_container_store_is_not_a_rebind(tmp_path):
+    """`views['k'] = jnp...` mutates a container; it must not re-type
+    the container's NAME as traced (the fused-views pack idiom)."""
+    from tools.simlint.core import ModuleInfo
+    import ast as _ast
+
+    src = (
+        "import jax.numpy as jnp\n"
+        "def pack(spec, views: dict):\n"
+        "    views['q'] = jnp.zeros((4,))\n"
+        "    if spec.fused:\n"
+        "        return views\n"
+        "    return None\n"
+    )
+    mod = ModuleInfo("mem.py", "mem.py", src)
+    fn = mod.functions[0]
+    assert "views" not in mod.traced_env(fn)
 
 
 def test_inline_suppression(tmp_path):

@@ -23,6 +23,13 @@ python -m tools.simlint fognetsimpp_tpu
 echo "== op budget (fused-tick kernel-count gate) =="
 JAX_PLATFORMS=cpu python tools/op_budget.py --check > /dev/null
 
+echo "== hloaudit (compiled-artifact audit of every tick variant) =="
+# host transfers, f64 promotion chains, undeclared/degenerate
+# collectives, the f32 2^24 bound and golden audit manifests — over
+# fused/unfused x telemetry/hist x fleet x TP-dryrun compiles (the
+# 8-virtual-device CPU mesh is forced by the CLI itself)
+python -m tools.hloaudit --check > /dev/null
+
 echo "== bench trend (>10% regression gate over BENCH_r*/MULTICHIP_r*) =="
 python tools/bench_trend.py --check
 

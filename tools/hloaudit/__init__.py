@@ -1,0 +1,13 @@
+"""hloaudit: static analysis of the COMPILED artifact (ISSUE 7).
+
+simlint gates the source tier; this package compiles every production
+tick variant (``variants.py``), parses the optimized HLO with the one
+shared parser (``hlo.py`` — ``tools/op_budget.py`` counts through the
+same one), attributes ops to engine phases via the ``jax.named_scope``
+metadata, and checks the rule set in ``audit.py``: no host round-trips,
+no f64 promotion chains, collectives only where declared (and never
+degenerate), the f32 exact-integer 2^24 bound, and golden per-variant
+audit manifests.  ``python -m tools.hloaudit --check`` gates CI.
+"""
+from .audit import AuditFinding, audit_module  # noqa: F401
+from .hlo import HloModule, entry_op_counts, parse_hlo  # noqa: F401

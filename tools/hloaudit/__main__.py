@@ -1,0 +1,185 @@
+"""CLI for the compiled-artifact auditor.
+
+  python -m tools.hloaudit                 # audit + print per-variant summary
+  python -m tools.hloaudit --check         # exit 1 on any finding (CI)
+  python -m tools.hloaudit --write         # regenerate the golden manifests
+  python -m tools.hloaudit --only tick_fused --check
+  python -m tools.hloaudit --markdown      # the BENCHMARKS.md phase table
+
+Findings are fatal in CI exactly like simlint: `tools/ci_check.sh` runs
+``--check`` over every variant, so a hidden host transfer, a surviving
+f64 promotion, an undeclared collective or a phase-attribution drift in
+ANY compiled tick variant fails the build before it reaches hardware.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import List, Optional
+
+from .variants import ensure_devices
+
+MANIFEST_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "manifests"
+)
+
+
+def manifest_path(variant: str) -> str:
+    return os.path.join(MANIFEST_DIR, f"{variant}.json")
+
+
+def load_manifest(variant: str) -> Optional[dict]:
+    p = manifest_path(variant)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def measure_variant(v) -> dict:
+    """Compile one variant and roll up everything the manifest records."""
+    from .audit import COUNT_SLACK
+    from .hlo import COLLECTIVE_OPS, base_collective, parse_hlo
+    from .variants import declared_for
+
+    text, spec = v.compile_fn()
+    mod = parse_hlo(text)
+    counts = mod.entry_op_counts()
+    collectives = sorted({
+        base_collective(i.opcode) for i in mod.all_instructions()
+        if base_collective(i.opcode) in COLLECTIVE_OPS
+    })
+    return {
+        "variant": v.name,
+        "description": v.description,
+        "sharded": v.sharded,
+        "entry": counts,
+        # ceil, not floor: tiny variants (the 9-op TP combine) must keep
+        # at least one op of slack or every toolchain wiggle pages
+        "max_ops": math.ceil(counts["ops"] * COUNT_SLACK),
+        "max_fusions": math.ceil(counts["fusions"] * COUNT_SLACK),
+        "phases": mod.phase_op_counts(),
+        "collectives": collectives,
+        "_module": mod,  # stripped before serialization
+        "_spec": spec,
+        "_declared": declared_for(v),
+    }
+
+
+def audit_variant(measured: dict, manifest: Optional[dict]) -> List:
+    from .audit import audit_module
+
+    return audit_module(
+        measured["_module"],
+        measured["variant"],
+        spec=measured["_spec"],
+        sharded=measured["sharded"],
+        declared_collectives=measured["_declared"],
+        manifest=manifest,
+    )
+
+
+def _serializable(measured: dict) -> dict:
+    return {k: v for k, v in measured.items() if not k.startswith("_")}
+
+
+def phase_table_markdown(rows: List[dict]) -> str:
+    """The BENCHMARKS.md per-phase op-count attribution table."""
+    phases = sorted({p for r in rows for p in r["phases"]})
+    head = "| phase | " + " | ".join(r["variant"] for r in rows) + " |"
+    sep = "|" + "---|" * (len(rows) + 1)
+    lines = [head, sep]
+    for p in phases:
+        cells = [str(r["phases"].get(p, "—")) for r in rows]
+        lines.append(f"| {p} | " + " | ".join(cells) + " |")
+    totals = [str(r["entry"]["ops"]) for r in rows]
+    lines.append("| **ENTRY total** | " + " | ".join(totals) + " |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.hloaudit",
+        description="compiled-HLO static audit of every tick variant "
+        "(rules: tools/hloaudit/audit.py docstring)",
+    )
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any audit finding (CI gate)")
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate the golden audit manifests")
+    ap.add_argument("--only", action="append", default=None,
+                    metavar="VARIANT", help="restrict to named variant(s)")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit the per-phase op-count markdown table")
+    ap.add_argument("--list", action="store_true",
+                    help="list variant names and exit")
+    args = ap.parse_args(argv)
+
+    ensure_devices()
+    from .variants import variants
+
+    vs = variants()
+    if args.list:
+        for v in vs:
+            print(f"{v.name}: {v.description}")
+        return 0
+    if args.only:
+        known = {v.name for v in vs}
+        bad = sorted(set(args.only) - known)
+        if bad:
+            print(f"unknown variant(s) {bad} (have {sorted(known)})",
+                  file=sys.stderr)
+            return 2
+        vs = [v for v in vs if v.name in args.only]
+
+    findings = []
+    rows = []
+    for v in vs:
+        measured = measure_variant(v)
+        rows.append(measured)
+        if args.write:
+            os.makedirs(MANIFEST_DIR, exist_ok=True)
+            with open(manifest_path(v.name), "w") as f:
+                json.dump(_serializable(measured), f, indent=1)
+                f.write("\n")
+            print(f"wrote {manifest_path(v.name)}", file=sys.stderr)
+            continue
+        findings += audit_variant(measured, load_manifest(v.name))
+
+    if args.write:
+        return 0
+    if args.markdown:
+        # table on stdout (for embedding); findings still fall through
+        # to stderr below, and --check still fails on them
+        print(phase_table_markdown(rows))
+    else:
+        for r in rows:
+            e = r["entry"]
+            print(json.dumps({
+                "variant": r["variant"], "ops": e["ops"],
+                "fusions": e["fusions"], "collectives": r["collectives"],
+                "phases": len([p for p in r["phases"]
+                               if p != "(unattributed)"]),
+            }))
+    for f in findings:
+        print(f"hloaudit: {f.render()}", file=sys.stderr)
+    n = len(findings)
+    print(
+        f"hloaudit: {len(rows)} variant(s), "
+        + ("clean" if not n else f"{n} finding(s)"),
+        file=sys.stderr,
+    )
+    return 1 if (args.check and findings) else 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(
+        0,
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+    )
+    sys.exit(main())

@@ -1,0 +1,265 @@
+"""hloaudit rule set: defect classes only visible in the compiled artifact.
+
+simlint (tools/simlint/) guards the SOURCE tier; these rules guard what
+XLA actually compiled.  The classes — each has cost this repo, or is the
+failure mode the ROADMAP's TP-sharding promotion is most likely to ship:
+
+* **A1 host round-trips** — ``infeed``/``outfeed``/``send``/``recv`` or
+  a host-callback ``custom-call`` inside the step program serializes the
+  whole tick stream on a device->host hop the source never shows
+  (a `pure_callback` that survived into a scan body, a debug print left
+  in a phase).
+* **A2 64-bit floats** — an ``f64``/``c128`` op or a ``convert``
+  promotion that survived tracing doubles bandwidth on the carry and
+  breaks the f32 parity discipline (simlint R4's compiled-tier twin:
+  R4 sees written dtypes, A2 sees *promotion chains* XLA materialized).
+* **A3 collectives** — single-device programs must compile to ZERO
+  collectives (an accidental ``all-gather`` means a sharding annotation
+  leaked); sharded programs may contain only the collectives their
+  module DECLARES (``DECLARED_COLLECTIVES``), and none may be
+  degenerate (single-participant groups: a collective over a 1-wide
+  axis is a silent copy that still pays collective latency).
+* **A4 f32 exact-integer bound** — the fused tick's merged reductions
+  are bit-stable across backends only while the summed integers stay
+  below 2^24 (engine._fused_mips_exact); the audit re-derives that
+  bound from the spec so a spec drift that silently voids it fails CI
+  here, not in a TPU-vs-CPU parity hunt.
+* **A5 manifest drift** — per-variant golden "audit manifests"
+  (checked-in JSON) gate ENTRY op/fusion counts with slack and pin the
+  attributed PHASE SET exactly: a phase whose ``named_scope`` vanishes
+  from the compiled artifact is a silent observability regression even
+  when counts stay flat.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set
+
+from .hlo import COLLECTIVE_OPS, HloModule, Instruction, base_collective
+
+#: Slack over recorded counts before A5 fails (matches the op-budget
+#: convention: absolute counts drift a little across XLA versions).
+COUNT_SLACK = 1.10
+
+#: f32 integer-exactness bound: sums of integer-valued f32 above this
+#: stop being associativity-independent (engine._fused_mips_exact).
+EXACT_I32_IN_F32 = 2 ** 24
+
+_HOST_OPS = frozenset({"infeed", "outfeed", "send", "recv",
+                       "send-done", "recv-done"})
+#: custom-call targets that are host round-trips (python callbacks,
+#: host-memory placement) rather than backend compute kernels.
+_HOST_TARGET_RE = re.compile(
+    r"callback|MoveToHost|MoveToDevice|annotate_device_placement",
+    re.I,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditFinding:
+    rule: str
+    variant: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.variant}: {self.rule}: {self.message}"
+
+
+def _fmt(i: Instruction) -> str:
+    where = i.phase and f"phase_{i.phase}" or (i.op_name or i.computation)
+    return f"%{i.name} ({i.opcode}) in {where}"
+
+
+def check_host_transfers(
+    mod: HloModule, variant: str
+) -> List[AuditFinding]:
+    """A1: no host round-trips anywhere in the compiled step program."""
+    out = []
+    for i in mod.all_instructions():
+        if i.opcode in _HOST_OPS:
+            out.append(AuditFinding(
+                "A1", variant,
+                f"host transfer op {_fmt(i)}: the tick stream serializes "
+                "on a device->host hop; keep the step device-pure and "
+                "read results outside the jit boundary",
+            ))
+        elif i.opcode == "custom-call":
+            tgt = i.custom_call_target or ""
+            if _HOST_TARGET_RE.search(tgt) or i.has_side_effect:
+                out.append(AuditFinding(
+                    "A1", variant,
+                    f"host-callback custom-call {_fmt(i)} "
+                    f"(target={tgt!r}): a python callback survived into "
+                    "the compiled step — remove it or gate it out of the "
+                    "audited variants",
+                ))
+    return out
+
+
+def check_f64(mod: HloModule, variant: str) -> List[AuditFinding]:
+    """A2: no 64-bit floats in the compiled artifact (promotion chains
+    included: a ``convert`` to f64 shows up here even when no source
+    line ever wrote ``float64``)."""
+    out = []
+    for i in mod.all_instructions():
+        for dt in ("f64", "c128"):
+            if i.mentions_dtype(dt):
+                kind = (
+                    "promotion chain (convert)" if i.opcode == "convert"
+                    else "op"
+                )
+                out.append(AuditFinding(
+                    "A2", variant,
+                    f"{dt} {kind} {_fmt(i)}: 64-bit floats are banned on "
+                    "the device path (2x carry bandwidth, f32 parity "
+                    "discipline) — find the promoting input and cast it",
+                ))
+                break
+    return out
+
+
+def check_collectives(
+    mod: HloModule,
+    variant: str,
+    sharded: bool,
+    declared: Optional[Dict[str, Set[str]]] = None,
+) -> List[AuditFinding]:
+    """A3: collectives only where declared, and never degenerate.
+
+    ``declared`` maps an op_name substring (a scope: ``"shmap_body"``,
+    ``"phase_broker"``) to the collective opcodes that scope is allowed
+    to emit — the module-level ``DECLARED_COLLECTIVES`` tables next to
+    the sharded code are the source of truth.
+    """
+    declared = declared or {}
+    out = []
+    for i in mod.all_instructions():
+        op = base_collective(i.opcode)
+        if op not in COLLECTIVE_OPS:
+            continue
+        if i.opcode.endswith("-done"):
+            continue  # the matching -start op carries the checks
+        if not sharded:
+            out.append(AuditFinding(
+                "A3", variant,
+                f"collective {_fmt(i)} in a SINGLE-DEVICE compile: a "
+                "sharding annotation leaked into the unsharded step",
+            ))
+            continue
+        ok = any(
+            scope in i.op_name and op in ops
+            for scope, ops in declared.items()
+        )
+        if not ok:
+            out.append(AuditFinding(
+                "A3", variant,
+                f"undeclared collective {_fmt(i)}: sharded variants may "
+                "only emit the collectives their module declares "
+                f"(declared: { {k: sorted(v) for k, v in declared.items()} })",
+            ))
+        sizes = i.replica_group_sizes()
+        if sizes and max(sizes) <= 1:
+            out.append(AuditFinding(
+                "A3", variant,
+                f"degenerate collective {_fmt(i)} (single-participant "
+                "replica groups): a collective over a 1-wide axis is a "
+                "copy that still pays collective latency",
+            ))
+    return out
+
+
+def check_exact_integer_bound(spec, variant: str) -> List[AuditFinding]:
+    """A4: the fused tick's merged integer-valued f32 reductions must be
+    covered by the static 2^24 bound, re-derived here from spec fields
+    (independent of the engine's own gate, so the two can't drift apart
+    silently — a mismatch IS the finding)."""
+    from fognetsimpp_tpu.core import engine as E
+
+    out = []
+    fused = E._fused_ok(spec)
+    mips_max = (
+        spec.fixed_mips_required
+        if spec.fixed_mips_required is not None
+        else spec.mips_required_max
+    )
+    R = min(spec.arrival_cands, spec.max_sends_per_user)
+    width = min(spec.window, spec.n_users * R)
+    bound = width * max(int(mips_max), 1)
+    if fused and bound >= EXACT_I32_IN_F32:
+        out.append(AuditFinding(
+            "A4", variant,
+            f"fused tick engaged but busy-MIPS bound {bound} >= 2^24: "
+            "the merged f32 reduction is no longer exact-integer — "
+            "engine._fused_mips_exact and the audit's derivation have "
+            "drifted apart",
+        ))
+    if spec.learn_active and spec.task_capacity >= EXACT_I32_IN_F32:
+        out.append(AuditFinding(
+            "A4", variant,
+            f"learn-active spec with task_capacity {spec.task_capacity} "
+            ">= 2^24: the bandit f32 credit counters "
+            "(learn/rewards.credit_batch) lose integer exactness",
+        ))
+    return out
+
+
+def check_manifest(
+    mod: HloModule, variant: str, manifest: Optional[dict]
+) -> List[AuditFinding]:
+    """A5: counts within the golden manifest's slack caps; attributed
+    phase set pinned exactly."""
+    if manifest is None:
+        return [AuditFinding(
+            "A5", variant,
+            "no checked-in audit manifest — regenerate with "
+            "`python -m tools.hloaudit --write` and commit it",
+        )]
+    out = []
+    counts = mod.entry_op_counts()
+    for key, cap_key in (("ops", "max_ops"), ("fusions", "max_fusions")):
+        if counts[key] > manifest[cap_key]:
+            out.append(AuditFinding(
+                "A5", variant,
+                f"ENTRY {key} regressed: {counts[key]} > manifest cap "
+                f"{manifest[cap_key]} (regenerate with --write ONLY if "
+                "the growth is justified and reviewed)",
+            ))
+    got_phases = set(mod.phase_op_counts()) - {"(unattributed)"}
+    want_phases = set(manifest.get("phases", {})) - {"(unattributed)"}
+    if got_phases != want_phases:
+        gone = sorted(want_phases - got_phases)
+        new = sorted(got_phases - want_phases)
+        out.append(AuditFinding(
+            "A5", variant,
+            f"attributed phase set drifted (gone: {gone}, new: {new}): "
+            "a phase's named_scope vanished from (or appeared in) the "
+            "compiled artifact — profiling/telemetry attribution follows "
+            "these scopes",
+        ))
+    return out
+
+
+def audit_module(
+    mod: HloModule,
+    variant: str,
+    spec=None,
+    sharded: bool = False,
+    declared_collectives: Optional[Dict[str, Set[str]]] = None,
+    manifest: Optional[dict] = None,
+    check_manifest_counts: bool = True,
+) -> List[AuditFinding]:
+    """Run the full rule set over one compiled variant."""
+    out: List[AuditFinding] = []
+    out += check_host_transfers(mod, variant)
+    out += check_f64(mod, variant)
+    out += check_collectives(mod, variant, sharded, declared_collectives)
+    if spec is not None:
+        out += check_exact_integer_bound(spec, variant)
+    if check_manifest_counts:
+        out += check_manifest(mod, variant, manifest)
+    return out
+
+
+def render_findings(findings: Sequence[AuditFinding]) -> str:
+    return "\n".join(f.render() for f in findings)

@@ -1,0 +1,216 @@
+"""The ONE compiled-HLO text parser (ISSUE 7).
+
+Both static gates over compiled artifacts — the op-budget kernel-count
+gate (``tools/op_budget.py``) and the hloaudit rule set
+(``tools/hloaudit/audit.py``) — read the optimized module text that
+``jax.jit(...).lower(...).compile().as_text()`` returns.  They used to
+each regex it independently; this module is the single parser both now
+share, so a drift in XLA's text format breaks ONE place and every
+count/check stays mutually consistent.
+
+The grammar we rely on (stable across the XLA versions this repo has
+seen) is::
+
+    HloModule <name>, <attrs>
+
+    %<computation> (<params>) -> <type> {
+      [ROOT ]%<instr> = <type> <opcode>(<operands>), <attrs>,
+          metadata={op_name="jit(f)/.../phase_spawn/mul" ...}
+    }
+
+    ENTRY %main.<n> (<params>) -> <type> { ... }
+
+Phase attribution rides the ``op_name`` metadata: the engine brackets
+every phase call in ``jax.named_scope("phase_<name>")``
+(core/engine.py's ``_ph`` harness), and XLA threads that scope into each
+derived instruction's ``op_name`` — so compiled ops map back to engine
+phases with zero engine changes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional
+
+#: ENTRY instructions that are plumbing, not kernels (the op-budget
+#: convention: "ops" approximates serialized kernel slots).
+TRIVIAL_OPS = ("parameter", "constant", "get-tuple-element", "tuple",
+               "bitcast", "copy")
+
+#: Collective opcodes GSPMD/shard_map can emit (async "-start"/"-done"
+#: halves normalize onto the base opcode via :func:`base_collective`).
+COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute", "collective-broadcast",
+})
+
+
+def base_collective(opcode: str) -> str:
+    """Normalize an async collective half (``all-gather-start`` /
+    ``all-gather-done``) onto its base opcode."""
+    for suffix in ("-start", "-done"):
+        if opcode.endswith(suffix):
+            return opcode[: -len(suffix)]
+    return opcode
+
+# computation headers sit at column 0 (instructions are indented);
+# parameter lists may nest parens (tuple-typed params), so only the
+# leading name and the trailing brace anchor the match
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%([\w.\-]+)\s*\(.*\{\s*$")
+# the result type is non-greedy `.+?`, NOT `\S+`: tuple-typed results
+# contain spaces (`(f32[8]{0}, u32[], token[]) recv(...)`) and every
+# async collective start and send/recv op has one — a `\S+` type would
+# silently drop exactly the ops A1/A3 exist to catch
+_INSTR_RE = re.compile(
+    r"^\s*(ROOT\s+)?%([\w.\-]+)\s*=\s*(.+?)\s+([a-z0-9\-]+)\("
+)
+_OPNAME_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
+_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
+_PHASE_RE = re.compile(r"phase_([A-Za-z0-9_]+)")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Instruction:
+    name: str
+    result: str            # result type text, e.g. ``f32[8]{0}`` / ``(f32[])``
+    opcode: str            # ``fusion``, ``all-gather``, ``custom-call``, ...
+    code: str              # the line up to (not including) ``metadata={``
+    op_name: str           # metadata op_name ("" when absent)
+    computation: str       # owning computation's name
+    is_entry: bool         # owning computation is the ENTRY
+    lineno: int
+
+    @property
+    def phase(self) -> Optional[str]:
+        """Engine phase this op attributes to (``phase_<x>`` scope in its
+        op_name metadata), else None."""
+        m = _PHASE_RE.search(self.op_name)
+        return m.group(1) if m else None
+
+    @property
+    def custom_call_target(self) -> Optional[str]:
+        m = _TARGET_RE.search(self.code)
+        return m.group(1) if m else None
+
+    @property
+    def has_side_effect(self) -> bool:
+        return "custom_call_has_side_effect=true" in self.code
+
+    def replica_group_sizes(self) -> List[int]:
+        """Sizes of a collective's replica groups ([] when unannotated)."""
+        m = _GROUPS_RE.search(self.code)
+        if not m:
+            return []
+        return [
+            len([t for t in g.split(",") if t.strip() != ""])
+            for g in re.findall(r"\{([^}]*)\}", m.group(1))
+        ]
+
+    def mentions_dtype(self, dtype: str) -> bool:
+        """Whether ``dtype`` (e.g. ``f64``) appears in the instruction's
+        CODE — result or operand types — ignoring metadata strings."""
+        return bool(re.search(rf"\b{re.escape(dtype)}\[", self.code))
+
+
+@dataclasses.dataclass
+class Computation:
+    name: str
+    is_entry: bool
+    instructions: List[Instruction]
+
+
+@dataclasses.dataclass
+class HloModule:
+    name: str
+    computations: List[Computation]
+
+    @property
+    def entry(self) -> Computation:
+        for c in self.computations:
+            if c.is_entry:
+                return c
+        raise ValueError("no ENTRY computation in HLO text")
+
+    def all_instructions(self) -> Iterable[Instruction]:
+        for c in self.computations:
+            yield from c.instructions
+
+    # -- the op-budget counting convention ----------------------------
+
+    def entry_op_counts(self) -> Dict[str, int]:
+        """{"ops": nontrivial ENTRY instruction count, "fusions": fusion
+        count} — the pre-refactor ``tools/op_budget.entry_op_counts``
+        convention, except that tuple-typed results (multi-output
+        fusions, async collective starts, send/recv) now count: the old
+        regex silently dropped them, and the checked-in budgets were
+        regenerated under the fixed parser."""
+        ops = [
+            i for i in self.entry.instructions
+            if i.opcode not in TRIVIAL_OPS
+        ]
+        return {
+            "ops": len(ops),
+            "fusions": sum(1 for i in ops if i.opcode == "fusion"),
+        }
+
+    def phase_op_counts(self, entry_only: bool = False) -> Dict[str, int]:
+        """Nontrivial op count per attributed engine phase.
+
+        Ops whose metadata carries no ``phase_*`` scope (glue between
+        phases, scan plumbing, XLA-invented ops that lost metadata) land
+        under ``"(unattributed)"`` so the rows always sum to the total.
+        """
+        out: Dict[str, int] = {}
+        instrs = (
+            self.entry.instructions if entry_only
+            else list(self.all_instructions())
+        )
+        for i in instrs:
+            if i.opcode in TRIVIAL_OPS:
+                continue
+            key = i.phase or "(unattributed)"
+            out[key] = out.get(key, 0) + 1
+        return dict(sorted(out.items()))
+
+
+def parse_hlo(text: str) -> HloModule:
+    """Parse one optimized-HLO module's ``as_text()`` dump."""
+    m = re.search(r"^HloModule\s+([\w.\-]+)", text, re.M)
+    mod = HloModule(m.group(1) if m else "?", [])
+    cur: Optional[Computation] = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        h = _COMP_RE.match(line)
+        if h:
+            cur = Computation(h.group(2), bool(h.group(1)), [])
+            mod.computations.append(cur)
+            continue
+        if cur is None:
+            continue
+        if line.startswith("}"):
+            cur = None
+            continue
+        g = _INSTR_RE.match(line)
+        if not g:
+            continue
+        meta_at = line.find("metadata={")
+        code = line if meta_at < 0 else line[:meta_at]
+        om = _OPNAME_RE.search(line)
+        cur.instructions.append(Instruction(
+            name=g.group(2),
+            result=g.group(3),
+            opcode=g.group(4),
+            code=code,
+            op_name=om.group(1) if om else "",
+            computation=cur.name,
+            is_entry=cur.is_entry,
+            lineno=lineno,
+        ))
+    if not mod.computations:
+        raise ValueError("no computations parsed from HLO text")
+    return mod
+
+
+def entry_op_counts(hlo_text: str) -> Dict[str, int]:
+    """Module-level convenience: parse + ENTRY op/fusion counts."""
+    return parse_hlo(hlo_text).entry_op_counts()

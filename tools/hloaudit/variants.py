@@ -1,0 +1,190 @@
+"""The audited tick-variant registry: every compiled shape CI must prove
+clean.
+
+One variant = one ``jax.jit(...).lower(...).compile()`` of a production
+step program at a pinned CPU shape small enough for tier-1 time:
+
+* ``tick_fused`` / ``tick_unfused`` — the exact-ordering dt=1 ms tick at
+  the op-budget pinned shape (``tools/op_budget.PINNED`` — ONE shape
+  definition shared with the kernel-count gate), fused front-end on/off;
+* ``tick_telemetry`` / ``tick_hist`` — the same tick with the
+  device-resident telemetry plane / streaming latency histogram riding
+  the carry (the variants whose extra accumulators must still compile
+  host-transfer-free);
+* ``fleet_step`` — the replica-sharded fleet scan
+  (``parallel/fleet._fleet_run``) on the 8-virtual-device CPU mesh:
+  its "zero steady-state collectives" claim becomes a static check;
+* ``tp_dryrun`` — the TP fog-sharded argmin
+  (``parallel/tp.sharded_min_busy``): must compile with EXACTLY its
+  declared collectives (``parallel/tp.DECLARED_COLLECTIVES``) — the
+  correctness rail the ROADMAP's task-table-sharding promotion runs on.
+
+Multi-device variants need >= 8 devices: call :func:`ensure_devices`
+BEFORE importing jax (the CLI does; under pytest, conftest.py's forced
+8-virtual-device topology already covers it).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional, Set
+
+_N_DEVICES = 8
+
+#: Shrunk fleet/TP shapes: compile cost only, semantics don't depend on
+#: size (the equivalence tests own the semantics).
+_FLEET = dict(n_users=64, n_fogs=8, horizon=0.02, send_interval=2.5e-3,
+              dt=1e-3, max_sends_per_user=8)
+_FLEET_TICKS = 4
+_TP_FOGS = 16
+_TP_TASKS = 32
+
+
+def ensure_devices() -> None:
+    """Force the 8-virtual-device CPU topology (no-op once jax is up)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_N_DEVICES}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class Variant:
+    name: str
+    description: str
+    compile_fn: Callable[[], "tuple"]  # () -> (hlo_text, spec_or_None)
+    sharded: bool = False
+    declared_collectives: Optional[Dict[str, Set[str]]] = None
+
+
+def _compile_tick(**build_overrides):
+    """Compile ONE tick of the op-budget pinned world; returns
+    (hlo_text, spec).  The same lower/compile path op_budget gates, so
+    the two tools can never audit different programs."""
+    import jax
+
+    from fognetsimpp_tpu.net.topology import associate
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.scenarios import smoke
+    from tools.op_budget import PINNED
+
+    spec, state, net, bounds = smoke.build(**{**PINNED, **build_overrides})
+    step = make_step(spec)
+    cache = associate(
+        net, state.nodes.pos, state.nodes.alive, broker=spec.broker_index
+    )
+    compiled = jax.jit(
+        lambda s: step(s, net, bounds, cache)
+    ).lower(state).compile()
+    return compiled.as_text(), spec
+
+
+def _compile_fleet():
+    """Compile the replica-sharded fleet scan on the 8-device mesh."""
+    import jax
+
+    from fognetsimpp_tpu.parallel.fleet import _fleet_run
+    from fognetsimpp_tpu.parallel.mesh import make_mesh, shard_world
+    from fognetsimpp_tpu.parallel.replicas import replicate_state
+    from fognetsimpp_tpu.scenarios import smoke
+
+    spec, state, net, bounds = smoke.build(**_FLEET)
+    mesh = make_mesh(_N_DEVICES)
+    batch = replicate_state(spec, state, _N_DEVICES)
+    batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
+    compiled = _fleet_run.lower(
+        spec, _FLEET_TICKS, batch, net, bounds
+    ).compile()
+    return compiled.as_text(), spec
+
+
+def _compile_tp():
+    """Compile the fog-sharded two-stage argmin (the TP dryrun step)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from fognetsimpp_tpu.parallel.tp import FOG_AXIS, sharded_min_busy
+
+    mesh = Mesh(np.asarray(jax.devices()[:_N_DEVICES]), (FOG_AXIS,))
+    K, F = _TP_TASKS, _TP_FOGS
+    compiled = jax.jit(
+        lambda m, q, b, v, r: sharded_min_busy(mesh, m, q, b, v, r)
+    ).lower(
+        jnp.ones((K,), bool),
+        jnp.ones((K,), jnp.float32),
+        jnp.zeros((F,), jnp.float32),
+        jnp.full((F,), 1000.0, jnp.float32),
+        jnp.ones((F,), bool),
+    ).compile()
+    return compiled.as_text(), None
+
+
+def _fleet_declared() -> Dict[str, Set[str]]:
+    from fognetsimpp_tpu.parallel.fleet import DECLARED_COLLECTIVES
+
+    return DECLARED_COLLECTIVES
+
+
+def _tp_declared() -> Dict[str, Set[str]]:
+    from fognetsimpp_tpu.parallel.tp import DECLARED_COLLECTIVES
+
+    return DECLARED_COLLECTIVES
+
+
+def variants() -> List[Variant]:
+    return [
+        Variant(
+            "tick_fused",
+            "exact-ordering dt=1ms tick, fused front-end (op-budget shape)",
+            lambda: _compile_tick(),
+        ),
+        Variant(
+            "tick_unfused",
+            "the same tick on the unfused reference path",
+            lambda: _compile_tick(fused_slots=False),
+        ),
+        Variant(
+            "tick_telemetry",
+            "fused tick with the device-resident telemetry plane on",
+            lambda: _compile_tick(telemetry=True),
+        ),
+        Variant(
+            "tick_hist",
+            "fused tick with telemetry + the streaming latency histogram "
+            "(eager acks: the hist phase reads t_ack6 inside the tick)",
+            lambda: _compile_tick(
+                telemetry=True, telemetry_hist=True, derive_acks=False
+            ),
+        ),
+        Variant(
+            "fleet_step",
+            "replica-sharded fleet scan on the 8-virtual-device mesh "
+            "(declared collectives: none — the zero-steady-state claim)",
+            _compile_fleet,
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from fleet.py
+        ),
+        Variant(
+            "tp_dryrun",
+            "TP fog-sharded argmin (parallel/tp.sharded_min_busy)",
+            _compile_tp,
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from tp.py
+        ),
+    ]
+
+
+def declared_for(v: Variant) -> Optional[Dict[str, Set[str]]]:
+    """Resolve a sharded variant's declaration table from its module
+    (kept next to the sharded code, not in this registry)."""
+    if v.declared_collectives is not None:
+        return v.declared_collectives
+    if v.name == "fleet_step":
+        return _fleet_declared()
+    if v.name == "tp_dryrun":
+        return _tp_declared()
+    return None

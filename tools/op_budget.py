@@ -33,7 +33,6 @@ import argparse
 import dataclasses
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -41,9 +40,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "op_budget.json")
 
-#: ENTRY instructions that are plumbing, not kernels.
-_TRIVIAL = ("parameter", "constant", "get-tuple-element", "tuple",
-            "bitcast", "copy")
 
 #: Slack over the recorded fused counts before --check fails (absolute
 #: counts drift a little across XLA versions; the ratio gate does not).
@@ -78,17 +74,14 @@ def entry_op_counts(hlo_text: str) -> dict:
 
     Returns {"ops": nontrivial instruction count, "fusions": fusion
     count} — "ops" approximates the serialized kernel slots the r5
-    calibration priced at ~35 us each.
+    calibration priced at ~35 us each.  Counting is delegated to the
+    ONE shared HLO parser (``tools/hloaudit/hlo.py``, ISSUE 7): the
+    op-budget gate and the compiled-artifact audit read the same parse
+    of the same text, so their numbers can never drift apart.
     """
-    m = re.search(r"^ENTRY [^{]+\{(.*?)^\}", hlo_text, re.M | re.S)
-    if not m:
-        raise ValueError("no ENTRY computation in HLO text")
-    ops = []
-    for line in m.group(1).splitlines():
-        g = re.search(r"= \S+? ([a-z0-9\-]+)\(", line)
-        if g and g.group(1) not in _TRIVIAL:
-            ops.append(g.group(1))
-    return {"ops": len(ops), "fusions": ops.count("fusion")}
+    from tools.hloaudit.hlo import entry_op_counts as _shared
+
+    return _shared(hlo_text)
 
 
 def compile_tick_counts(fused: bool) -> dict:

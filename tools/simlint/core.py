@@ -65,13 +65,42 @@ STATIC_TYPE_TOKENS: Set[str] = {
     "int", "float", "bool", "str", "bytes", "None", "Optional",
     "WorldSpec", "Policy", "Stage", "FogModel", "Mobility", "NodeKind",
     "Callable", "Sequence", "Dict", "List", "Mesh", "str",
+    # plain-dict params are host containers whose STRUCTURE drives
+    # trace-time control flow (the fused views pack: `views:
+    # Optional[dict]`); their leaves re-enter tracedness as soon as
+    # they feed a jnp op
+    "dict",
 }
 
 # Unannotated parameter names assumed static (the spec convention).
+# NOTE: the fused front-end's `views` packs are annotated
+# `Optional[dict]`, which the "dict" token above already classifies —
+# no bare-name exemption, so an unannotated traced `views` array in a
+# future module keeps full R1/R2 coverage.
 STATIC_PARAM_NAMES: Set[str] = {"spec", "self", "cls", "sp"}
 
 # Attribute accesses that yield static metadata even on traced arrays.
 STATIC_ATTRS: Set[str] = {"shape", "ndim", "dtype", "size", "sharding"}
+
+# Calls whose RESULT is host data even when their arguments are traced:
+# fetching/materializing calls.  The call site itself may still be an R1
+# finding (R1 inspects the arguments); what these entries fix is the
+# DOWNSTREAM false-positive — `if jax.device_get(x) > 0` is a host
+# branch, not a traced one, and a name assigned from such a call must
+# not propagate tracedness through the dataflow layer.
+HOST_RESULT_CALLS: Set[str] = {
+    "jax.device_get", "np.asarray", "np.array", "numpy.asarray",
+    "numpy.array", "float", "int", "bool", "len",
+    # host-only introspection: never returns device data
+    "isinstance", "issubclass", "hasattr", "callable", "type",
+    "jax.default_backend", "jax.eval_shape", "jax.devices",
+    "jax.local_devices", "jax.device_count",
+}
+
+# Method calls on traced objects whose RESULT is host data.
+HOST_RESULT_METHODS: Set[str] = {
+    "item", "tolist", "tobytes", "unsafe_buffer_pointer",
+}
 
 # Calls whose function-name arguments become traced (device) code.
 TRACING_COMBINATORS: Set[str] = {
@@ -254,6 +283,7 @@ class ModuleInfo:
         self._locals: Dict[_FuncNode, Set[str]] = {
             f: self._collect_locals(f) for f in self.functions
         }
+        self._traced_env: Dict[_FuncNode, Set[str]] = {}
         self.device_funcs: Set[_FuncNode] = self._classify_device()
 
     # -- scopes --------------------------------------------------------
@@ -363,6 +393,98 @@ class ModuleInfo:
                     roots.add(a.arg)
         return roots
 
+    def traced_env(self, fn: _FuncNode) -> Set[str]:
+        """The v2 dataflow layer: traced names including ASSIGNED ones.
+
+        :meth:`traced_roots` sees only parameters; this adds a fixpoint
+        over the assignments of ``fn`` and its enclosing functions, so
+        ``y = x * 2; if y > 0`` fires R2 just like ``if x * 2 > 0``
+        would.  Propagation is deliberately narrower than
+        :meth:`expr_is_traced`: a value flows tracedness only through
+        arithmetic/indexing/jnp-calls/method-calls — the result of a
+        call to a *local helper function* is unknown and does NOT
+        propagate (that is where v1-style guessing would manufacture
+        false positives on container-returning helpers), and
+        ``HOST_RESULT_CALLS`` results explicitly stop the flow.
+        """
+        if fn in self._traced_env:
+            return self._traced_env[fn]
+        traced = set(self.traced_roots(fn))
+        def target_names(t: ast.AST) -> List[str]:
+            # only true REBINDS of a name — a Subscript/Attribute store
+            # (`views["k"] = ...`) mutates a container and must not
+            # re-type the container's name
+            if isinstance(t, ast.Name):
+                return [t.id]
+            if isinstance(t, (ast.Tuple, ast.List)):
+                return [n for e in t.elts for n in target_names(e)]
+            if isinstance(t, ast.Starred):
+                return target_names(t.value)
+            return []
+
+        assigns: List[Tuple[str, ast.AST, bool]] = []  # (name, value, aug)
+        for f in self.function_chain(fn):
+            for node in ast.walk(f):
+                if self.enclosing_function(node) is not f:
+                    continue
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        for name in target_names(t):
+                            assigns.append((name, node.value, False))
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    if isinstance(node.target, ast.Name):
+                        assigns.append(
+                            (node.target.id, node.value, False)
+                        )
+                elif isinstance(node, ast.AugAssign):
+                    if isinstance(node.target, ast.Name):
+                        assigns.append((node.target.id, node.value, True))
+        changed = True
+        while changed:
+            changed = False
+            for name, value, aug in assigns:
+                if name in traced and not aug:
+                    continue
+                if name in traced or self._value_propagates(value, traced):
+                    if name not in traced:
+                        traced.add(name)
+                        changed = True
+        self._traced_env[fn] = traced
+        return traced
+
+    def _value_propagates(self, value: ast.AST, traced: Set[str]) -> bool:
+        """Whether an assigned VALUE carries tracedness onto its target
+        (the narrowed propagation rule of :meth:`traced_env`)."""
+        if isinstance(value, ast.Call):
+            name = dotted(value.func) or ""
+            if name in HOST_RESULT_CALLS:
+                return False
+            if name.startswith(("jnp.", "jax.", "lax.")):
+                return True
+            if isinstance(value.func, ast.Attribute):
+                # method call on a traced object: x.astype(...), x.sum()
+                return (
+                    value.func.attr not in HOST_RESULT_METHODS
+                    and self.expr_is_traced(value.func.value, traced)
+                )
+            return False  # local-helper call: unknown result, no flow
+        # containers/conditionals recurse through THIS narrowed rule, so
+        # `fv = pack(...) if fused else None` does not leak the generic
+        # call's any-arg-traced guess into the assignment layer
+        if isinstance(value, ast.IfExp):
+            return self._value_propagates(
+                value.body, traced
+            ) or self._value_propagates(value.orelse, traced)
+        if isinstance(value, ast.BoolOp):
+            return any(
+                self._value_propagates(v, traced) for v in value.values
+            )
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return any(
+                self._value_propagates(e, traced) for e in value.elts
+            )
+        return self.expr_is_traced(value, traced)
+
     def expr_is_traced(self, node: ast.AST, roots: Set[str]) -> bool:
         """Conservative syntactic test: does ``node`` produce (or contain)
         a traced value?  Attribute chains through ``.shape``-style static
@@ -379,11 +501,15 @@ class ModuleInfo:
             )
         if isinstance(node, ast.Call):
             name = dotted(node.func) or ""
+            if name in HOST_RESULT_CALLS:
+                return False  # materializes on host; result is not traced
             if name.startswith(("jnp.", "jax.", "lax.")):
                 return True
             if isinstance(node.func, ast.Attribute) and self.expr_is_traced(
                 node.func.value, roots
             ):
+                if node.func.attr in HOST_RESULT_METHODS:
+                    return False  # fetches to host (R1's job to flag)
                 return True  # method call on a traced object (x.sum(), ...)
             return any(
                 self.expr_is_traced(a, roots) for a in node.args

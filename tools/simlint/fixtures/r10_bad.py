@@ -1,0 +1,17 @@
+"""R10 fixture: integer-valued f32 accumulations with no static
+overflow guard anywhere in the module — exact (and reduction-order
+independent) only below 2^24, and nothing pins that bound."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def count_busy(mask):
+    # forcing dtype=f32 on a bool sum is the count-sum idiom: the
+    # output is an integer-valued float
+    return jnp.sum(mask, dtype=jnp.float32)       # R10: unguarded count
+
+
+@jax.jit
+def count_over(x, lo: float):
+    return jnp.sum((x > lo).astype(jnp.float32))  # R10: bool->f32 sum

@@ -1,0 +1,22 @@
+"""R10 fixture: the two sanctioned shapes — a trace-time 2^24 bound
+check adjacent to the f32 count sum (the ``engine._fused_mips_exact``
+pattern), or accumulation in int32."""
+import jax
+import jax.numpy as jnp
+
+
+def _counts_exact(n: int) -> None:
+    """Static guard: n rows of 0/1 summed in f32 stay exact below 2^24."""
+    if n >= 2 ** 24:
+        raise ValueError("f32 count sum loses integer exactness")
+
+
+@jax.jit
+def count_busy(mask):
+    _counts_exact(mask.shape[0])
+    return jnp.sum(mask, dtype=jnp.float32)       # guarded: exact by bound
+
+
+@jax.jit
+def count_over(x, lo: float):
+    return jnp.sum((x > lo).astype(jnp.int32))    # integer accumulator

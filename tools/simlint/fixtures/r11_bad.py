@@ -1,0 +1,18 @@
+"""R11 fixture: host callbacks inside device code that neither declare
+ordering nor sit behind a telemetry/debug gate — XLA may reorder, batch
+or elide them inside the scan."""
+import functools
+
+import jax
+from jax.experimental import io_callback
+
+
+def _tap(x):
+    return None
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def step(carry):
+    io_callback(_tap, None, carry)        # R11: ordering undeclared
+    jax.debug.print("q={q}", q=carry)     # R11: ungated debug tap
+    return carry + 1

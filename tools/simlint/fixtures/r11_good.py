@@ -1,0 +1,19 @@
+"""R11 fixture: the two sanctioned shapes — ``ordered=True`` when the
+callback's sequencing matters, or a telemetry/debug gate when it is an
+idempotent tap."""
+import functools
+
+import jax
+from jax.experimental import io_callback
+
+
+def _tap(x):
+    return None
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def step(carry, spec):
+    io_callback(_tap, None, carry, ordered=True)  # ordering declared
+    if spec.debug:
+        jax.debug.print("q={q}", q=carry)         # debug-gated tap
+    return carry + 1

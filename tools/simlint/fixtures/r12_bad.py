@@ -1,0 +1,21 @@
+"""R12 fixture: reading a donated buffer after its donating call —
+the buffers were aliased into the call's outputs, so the name is dead
+(garbage results, or a deleted-buffer error)."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def advance(state):
+    return state + 1
+
+
+def drive(state):
+    out = advance(state)
+    return out + state          # R12: `state` was donated to advance()
+
+
+def compare(spec, state, net, bounds):
+    final = run_jit(spec, state, net, bounds)   # noqa: F821 (fixture)
+    return final, state.tasks   # R12: `state` donated to the run entry

@@ -1,0 +1,23 @@
+"""R12 fixture: the sanctioned shapes — rebind the result to the
+donated name (later reads see the NEW value), or copy before donating
+when the original must survive."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def advance(state):
+    return state + 1
+
+
+def drive(state):
+    state = advance(state)      # rebind at the donating call
+    return state + 1
+
+
+def drive_keep(state):
+    scratch = jax.tree.map(jnp.copy, state)
+    final = advance(scratch)    # the copy is donated, not the original
+    return final, state

@@ -1,0 +1,26 @@
+"""R9 fixture: axis names unbound by the enclosing mesh — a
+PartitionSpec over a misspelled axis silently replicates instead of
+sharding, and a collective over an unbound axis is a trace-time error
+(or, after a rename, a collective over the WRONG axis)."""
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+FOG_AXIS = "fog"
+
+mesh = Mesh(np.asarray(jax.devices()), (FOG_AXIS,))
+
+
+def sharded_apply(fn, x):
+    f = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P("fogs"),),          # R9: "fogs" is not bound ("fog" is)
+        out_specs=P(FOG_AXIS),
+    )
+    return f(x)
+
+
+def combine(x):
+    return jax.lax.psum(x, axis_name="replica")   # R9: no mesh binds "replica"

@@ -1,0 +1,25 @@
+"""R9 fixture: every axis name a spec or collective uses is bound by
+the enclosing mesh — via the module axis constant, never a re-typed
+string literal."""
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+FOG_AXIS = "fog"
+
+mesh = Mesh(np.asarray(jax.devices()), (FOG_AXIS,))
+
+
+def sharded_apply(fn, x):
+    f = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(FOG_AXIS),),        # bound by the mesh above
+        out_specs=P(FOG_AXIS),
+    )
+    return f(x)
+
+
+def combine(x):
+    return jax.lax.psum(x, axis_name=FOG_AXIS)    # bound axis constant

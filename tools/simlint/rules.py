@@ -1,4 +1,4 @@
-"""The simlint rule catalogue (R1-R8).  See RULES.md for the narrative
+"""The simlint rule catalogue (R1-R12).  See RULES.md for the narrative
 version with offending/sanctioned snippets; docstrings here are the
 machine-adjacent summary."""
 from __future__ import annotations
@@ -49,7 +49,7 @@ class HostSyncRule(Rule):
         for fn, node in mod.device_nodes():
             if not isinstance(node, ast.Call):
                 continue
-            roots = mod.traced_roots(fn)
+            roots = mod.traced_env(fn)
             if (
                 isinstance(node.func, ast.Attribute)
                 and node.func.attr == "item"
@@ -99,7 +99,7 @@ class TracedBranchRule(Rule):
         for fn, node in mod.device_nodes():
             if not isinstance(node, (ast.If, ast.While)):
                 continue
-            roots = mod.traced_roots(fn)
+            roots = mod.traced_env(fn)
             if mod.expr_is_traced(node.test, roots):
                 kind = "if" if isinstance(node, ast.If) else "while"
                 yield mod.finding(
@@ -483,6 +483,464 @@ class ContractCoverageRule(Rule):
                 )
 
 
+# ----------------------------------------------------------------------
+# v2 rules (ISSUE 7): sharding axes, f32 integer sums, callbacks, donation
+# ----------------------------------------------------------------------
+
+_COLLECTIVE_CALLS = {
+    "jax.lax.all_gather", "lax.all_gather", "jax.lax.psum", "lax.psum",
+    "jax.lax.pmean", "lax.pmean", "jax.lax.pmax", "lax.pmax",
+    "jax.lax.pmin", "lax.pmin", "jax.lax.all_to_all", "lax.all_to_all",
+    "jax.lax.ppermute", "lax.ppermute", "jax.lax.axis_index",
+    "lax.axis_index", "jax.lax.psum_scatter", "lax.psum_scatter",
+}
+
+
+def _module_str_consts(mod: ModuleInfo) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings (axis-name constants)."""
+    out: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+def _axis_token(node: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    """Resolve an axis-name argument to a string when statically known."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+class ShardAxisRule(Rule):
+    """R9: `shard_map`/`PartitionSpec` axis names must be bound by the
+    enclosing mesh, and collectives must name a live axis.  Conservative:
+    only fires when both the axis name AND the mesh's axis tuple are
+    statically resolvable (literals or module string constants) — a mesh
+    that arrives as a parameter is unverifiable and stays silent."""
+
+    id = "R9"
+    title = "unbound mesh axis in shard_map/PartitionSpec/collective"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        consts = _module_str_consts(mod)
+        # every Mesh(...) constructed with a literal axis tuple, module-wide
+        mesh_axes: Dict[str, Set[str]] = {}  # bound name -> axes
+        all_mesh_axes: Set[str] = set()
+        saw_mesh = False
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and (dotted(node.func) or "").endswith("Mesh")):
+                continue
+            axes: Set[str] = set()
+            args = list(node.args) + [
+                kw.value for kw in node.keywords
+                if kw.arg in ("axis_names", None)
+            ]
+            for a in args[1:] if node.args else args:
+                for sub in ast.walk(a):
+                    tok = _axis_token(sub, consts)
+                    if tok:
+                        axes.add(tok)
+            if not axes:
+                continue
+            saw_mesh = True
+            all_mesh_axes |= axes
+            parent = mod.parents.get(node)
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        mesh_axes[t.id] = axes
+        if not saw_mesh:
+            return  # no statically-known mesh in this module: unverifiable
+
+        def universe_for(call: ast.Call) -> Set[str]:
+            for kw in call.keywords:
+                if kw.arg == "mesh" and isinstance(kw.value, ast.Name):
+                    if kw.value.id in mesh_axes:
+                        return mesh_axes[kw.value.id]
+            return all_mesh_axes
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if name.endswith("shard_map") or (
+                name in ("functools.partial", "partial")
+                and node.args
+                and (dotted(node.args[0]) or "").endswith("shard_map")
+            ):
+                axes = universe_for(node)
+                for kw in node.keywords:
+                    if kw.arg not in ("in_specs", "out_specs"):
+                        continue
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Call) and (
+                            dotted(sub.func) or ""
+                        ).split(".")[-1] in ("P", "PartitionSpec"):
+                            for a in sub.args:
+                                tok = _axis_token(a, consts)
+                                if tok is not None and tok not in axes:
+                                    yield mod.finding(
+                                        self.id, sub,
+                                        f"PartitionSpec names axis "
+                                        f"{tok!r} but the enclosing mesh "
+                                        f"binds {sorted(axes)} — the "
+                                        "spec silently replicates (or "
+                                        "errors) instead of sharding",
+                                    )
+            elif name in _COLLECTIVE_CALLS:
+                # axis_name's positional slot: args[0] for axis_index
+                # (its ONLY argument), args[1] for x-first collectives
+                pos = (
+                    node.args[0:1] if name.endswith("axis_index")
+                    else node.args[1:2]
+                )
+                cand = [
+                    kw.value for kw in node.keywords
+                    if kw.arg == "axis_name"
+                ] + pos
+                for a in cand:
+                    tok = _axis_token(a, consts)
+                    if tok is not None and tok not in all_mesh_axes:
+                        yield mod.finding(
+                            self.id, node,
+                            f"collective `{name}` names axis {tok!r}, "
+                            f"not bound by any mesh in scope "
+                            f"({sorted(all_mesh_axes)}): unbound-axis "
+                            "NameError at trace time, or a collective "
+                            "over the wrong axis after a rename",
+                        )
+
+
+_F32_TOKENS = {"jnp.float32", "np.float32", "numpy.float32"}
+
+
+def _f32_aliases(mod: ModuleInfo, fn: ast.FunctionDef) -> Set[str]:
+    """Names bound to ``jnp.float32`` in ``fn`` or at module level (the
+    ``f32 = jnp.float32`` convention)."""
+    out: Set[str] = set()
+    scopes: List[ast.AST] = [mod.tree, *mod.function_chain(fn)]
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and dotted(
+                node.value
+            ) in _F32_TOKENS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _has_float_arith(node: ast.AST) -> bool:
+    """Whether an expression visibly involves a non-integral float
+    constant or a true division — i.e. its value is fractional, not an
+    integer-valued count, whatever dtype the accumulator is pinned to."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(
+            sub.value, float
+        ) and not float(sub.value).is_integer():
+            return True
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Div):
+            return True
+    return False
+
+
+class IntF32SumRule(Rule):
+    """R10: integer-valued f32 accumulations need an adjacent static
+    2^24 overflow guard.  ``jnp.sum(mask, dtype=f32)`` and
+    ``jnp.sum(cond.astype(f32))`` produce *integer-valued floats*; they
+    are exact (and backend/reduction-order independent) only below
+    2^24.  The sanctioned pattern is the engine's ``_fused_mips_exact``:
+    a trace-time bound comparison against ``2 ** 24`` in the same module
+    (the rule recognizes the literal bound or a call to a
+    ``*exact*``/``*fused_ok*``-named guard)."""
+
+    id = "R10"
+    title = "unguarded integer-valued f32 accumulation"
+
+    def _module_has_guard(self, mod: ModuleInfo) -> bool:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Pow
+            ):
+                if (
+                    isinstance(node.left, ast.Constant)
+                    and node.left.value == 2
+                    and isinstance(node.right, ast.Constant)
+                    and node.right.value == 24
+                ):
+                    return True
+            if isinstance(node, ast.Constant) and node.value == 16777216:
+                return True
+            if isinstance(node, ast.Call):
+                name = (dotted(node.func) or "").split(".")[-1]
+                if "exact" in name or "fused_ok" in name:
+                    return True
+        return False
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if self._module_has_guard(mod):
+            return
+        for fn, node in mod.device_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if name.split(".")[-1] not in ("sum", "cumsum"):
+                continue
+            if not (name.startswith(("jnp.", "jax.numpy."))
+                    or isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("sum", "cumsum")
+                    and mod.expr_is_traced(
+                        node.func.value, mod.traced_env(fn))):
+                continue
+            f32 = _f32_aliases(mod, fn) | {"float32"}
+            args = node.args or (
+                [node.func.value]
+                if isinstance(node.func, ast.Attribute) else []
+            )
+            integer_f32 = False
+            for kw in node.keywords:
+                if kw.arg != "dtype":
+                    continue
+                tok = dotted(kw.value) or (
+                    kw.value.value
+                    if isinstance(kw.value, ast.Constant) else None
+                )
+                if (tok in _F32_TOKENS or tok in f32) and not (
+                    args and _has_float_arith(args[0])
+                ):
+                    # forcing dtype=f32 on a sum is the count-sum idiom:
+                    # the input is bool/int, the output integer-valued —
+                    # unless the summand visibly does FLOAT arithmetic
+                    # (`w * 0.5`), where dtype=f32 just pins the
+                    # accumulator of genuinely fractional data
+                    integer_f32 = True
+            if not integer_f32 and args:
+                a0 = args[0]
+                if (
+                    isinstance(a0, ast.Call)
+                    and isinstance(a0.func, ast.Attribute)
+                    and a0.func.attr == "astype"
+                    and a0.args
+                    and (
+                        dotted(a0.args[0]) in _F32_TOKENS
+                        or (isinstance(a0.args[0], ast.Name)
+                            and a0.args[0].id in f32)
+                    )
+                    and isinstance(
+                        a0.func.value, (ast.Compare, ast.BoolOp)
+                    )
+                ):
+                    integer_f32 = True
+            if integer_f32:
+                yield mod.finding(
+                    self.id, node,
+                    "integer-valued f32 sum with no static overflow "
+                    "guard in this module: exact (and reduction-order-"
+                    "independent) only below 2^24 — add a trace-time "
+                    "bound check (the `_fused_mips_exact` pattern) or "
+                    "accumulate in int32",
+                )
+
+
+_CALLBACK_CALLS = {
+    "jax.experimental.io_callback", "io_callback",
+    "jax.pure_callback", "pure_callback",
+    "jax.debug.print", "jax.debug.callback", "jax.debug.breakpoint",
+    "jax.experimental.host_callback.call", "host_callback.call",
+    "hcb.call",
+}
+
+
+class ScanCallbackRule(Rule):
+    """R11: host callbacks inside device code must either declare
+    ordering (``ordered=True``) or sit behind a telemetry/debug gate.
+    An unordered callback in a scan body may be reordered, batched or
+    elided by XLA — fine for idempotent telemetry taps, silently wrong
+    for anything stateful — and every callback is a host round-trip the
+    compiled-artifact audit (tools/hloaudit A1) will flag in the
+    audited variants."""
+
+    id = "R11"
+    title = "undeclared host callback in device code"
+
+    def _gated(self, mod: ModuleInfo, node: ast.AST) -> bool:
+        cur = mod.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.If):
+                test = ast.unparse(cur.test)
+                if "telemetry" in test or "debug" in test.lower():
+                    return True
+            cur = mod.parents.get(cur)
+        return False
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn, node in mod.device_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            if name not in _CALLBACK_CALLS:
+                continue
+            ordered = any(
+                kw.arg == "ordered"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            )
+            if ordered or self._gated(mod, node):
+                continue
+            yield mod.finding(
+                self.id, node,
+                f"`{name}` in device code declares no ordering and is "
+                "not telemetry/debug-gated: XLA may reorder, batch or "
+                "elide it inside the scan — pass ordered=True (ordering "
+                "matters) or gate it behind the telemetry/debug flag "
+                "(it is a tap)",
+            )
+
+
+#: Package entry points that donate their state/batch argument (position
+#: of the donated parameter).  `run_chunked` only donates on the
+#: callback-free path, but its contract says "do not reuse after
+#: calling" either way, so the rule covers it unconditionally.
+_KNOWN_DONATING: Dict[str, int] = {
+    "run_jit": 1,
+    "run_chunked": 1,
+    "run_fleet": 1,
+    "run_fleet_series": 1,
+}
+
+
+class DonatedReuseRule(Rule):
+    """R12: a buffer passed to a donating call is DEAD afterwards —
+    XLA aliases it into the outputs, and reading it again returns
+    garbage or raises.  This is the escape class
+    ``engine._dealias_for_donation`` exists for (aliased *inputs*); the
+    rule catches the caller-side variant: reusing the donated name
+    after the call instead of rebinding it."""
+
+    id = "R12"
+    title = "use of a donated buffer after its donating call"
+
+    def _donating_map(self, mod: ModuleInfo) -> Dict[str, Tuple[int, ...]]:
+        out = {k: (v,) for k, v in _KNOWN_DONATING.items()}
+        for site, wrapped, kwargs in _jit_sites(mod):
+            if wrapped is None:
+                continue
+            idxs = ()
+            if "donate_argnums" in kwargs:
+                idxs = const_int_tuple(kwargs["donate_argnums"]) or ()
+            if idxs:
+                out[wrapped.name] = idxs
+        return out
+
+    @staticmethod
+    def _stmt_path(mod: ModuleInfo, node: ast.AST, fn: ast.FunctionDef):
+        """((block id, index), ...) statement coordinates of ``node``
+        inside ``fn`` — used for happens-after ordering that does not
+        confuse sibling branches with sequential statements."""
+        path = []
+        cur = node
+        while cur is not None and cur is not fn:
+            parent = mod.parents.get(cur)
+            if parent is None:
+                break
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                block = getattr(parent, field, None)
+                if isinstance(block, list) and cur in block:
+                    path.append((id(block), block.index(cur)))
+            cur = parent
+        return tuple(reversed(path))
+
+    @staticmethod
+    def _happens_after(path_a, path_b) -> bool:
+        """True when statement coordinates ``path_a`` execute strictly
+        after ``path_b`` (same block, later index, at some shared
+        level)."""
+        for (blk_a, i_a), (blk_b, i_b) in zip(path_a, path_b):
+            if blk_a != blk_b:
+                return False  # sibling branches: no ordering
+            if i_a != i_b:
+                return i_a > i_b
+        return False
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        donating = self._donating_map(mod)
+        for fn in mod.functions:
+            own = [
+                n for n in ast.walk(fn)
+                if mod.enclosing_function(n) is fn
+            ]
+            calls = []
+            for node in own:
+                if not isinstance(node, ast.Call):
+                    continue
+                name = (dotted(node.func) or "").split(".")[-1]
+                if name not in donating:
+                    continue
+                for idx in donating[name]:
+                    if idx >= len(node.args):
+                        continue
+                    arg = node.args[idx]
+                    # unwrap the _dealias_for_donation(state) wrapper:
+                    # dealiasing copies duplicate leaves only; the name's
+                    # buffers are still donated
+                    if isinstance(arg, ast.Call) and len(arg.args) == 1:
+                        arg = arg.args[0]
+                    if isinstance(arg, ast.Name):
+                        calls.append((node, arg.id))
+            for call, donated in calls:
+                call_path = self._stmt_path(mod, call, fn)
+                # an Assign that rebinds the name at the call statement
+                # (`state = go(state)`) makes later uses the NEW value
+                stmt = call
+                while mod.parents.get(stmt) is not None and not isinstance(
+                    stmt, ast.stmt
+                ):
+                    stmt = mod.parents[stmt]
+                if isinstance(stmt, ast.Assign) and any(
+                    isinstance(n, ast.Name) and n.id == donated
+                    for t in stmt.targets
+                    for n in ast.walk(t)  # tuple targets: `b, s = f(b)`
+                ):
+                    continue
+                rebinds = []
+                uses = []
+                for node in own:
+                    if not isinstance(node, ast.Name) or node.id != donated:
+                        continue
+                    p = self._stmt_path(mod, node, fn)
+                    if not self._happens_after(p, call_path):
+                        continue
+                    if isinstance(node.ctx, (ast.Store, ast.Del)):
+                        rebinds.append(p)
+                    else:
+                        uses.append((node, p))
+                for node, p in uses:
+                    # a use at the SAME coordinates as a rebind is the
+                    # rebind's own RHS: it executes BEFORE the store,
+                    # so only a strictly-earlier rebind covers it
+                    if any(self._happens_after(p, r) for r in rebinds):
+                        continue
+                    fname = (dotted(call.func) or "?").split(".")[-1]
+                    yield mod.finding(
+                        self.id, node,
+                        f"`{donated}` is read after `{fname}(...)` "
+                        "donated its buffers: donated inputs are dead "
+                        "(aliased into the outputs) — rebind the result "
+                        "to the same name, copy before donating, or "
+                        "call a non-donating entry",
+                    )
+                    break  # one finding per donated name per call
+
+
 def default_rules() -> Tuple[Rule, ...]:
     return (
         HostSyncRule(),
@@ -493,4 +951,8 @@ def default_rules() -> Tuple[Rule, ...]:
         DonationRule(),
         ConstantChurnRule(),
         ContractCoverageRule(),
+        ShardAxisRule(),
+        IntF32SumRule(),
+        ScanCallbackRule(),
+        DonatedReuseRule(),
     )
