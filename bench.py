@@ -432,6 +432,130 @@ def fleet_measurement(n_devices=None) -> dict:
     }
 
 
+def tp_measurement(n_devices=None) -> dict:
+    """Measured TP (task-table-sharded) single-world throughput (ISSUE 9).
+
+    ONE world whose user/task axis spans the mesh — the capacity path —
+    through :func:`fognetsimpp_tpu.parallel.taskshard.run_tp_sharded`
+    (shard_map megaphases, explicit broker↔fog collectives, ring
+    arrival exchange), replacing the compile-only TP dryrun with real
+    decisions/s.  Default population: 2^20 users (the ≥1M-user single
+    world of the ROADMAP's first open item).  Env knobs:
+    BENCH_TP_USERS / BENCH_TP_FOGS / BENCH_TP_INTERVAL / BENCH_TP_DT /
+    BENCH_TP_HORIZON / BENCH_TP_REPS / BENCH_TP_WINDOW (per-shard
+    exchange window; 0 = never-defer full window).
+
+    Assumes the devices already exist (callers own the
+    ``xla_force_host_platform_device_count`` dance).
+    """
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu.compile_cache import (
+        compile_stats,
+        enable_compile_cache,
+        note_compile,
+    )
+    from fognetsimpp_tpu.parallel import make_mesh, run_tp_sharded
+    from fognetsimpp_tpu.scenarios import smoke
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+    D = int(n_devices or len(jax.devices()))
+    n_users = _env_int("BENCH_TP_USERS", 1_048_576)
+    n_fogs = _env_int("BENCH_TP_FOGS", 64)
+    interval = _env_float("BENCH_TP_INTERVAL", 0.05)
+    dt = _env_float("BENCH_TP_DT", 5e-3)
+    horizon = _env_float("BENCH_TP_HORIZON", 0.25)
+    n_reps = _env_int("BENCH_TP_REPS", 1)
+    mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
+
+    def build():
+        return smoke.build(
+            n_users=n_users,
+            n_fogs=n_fogs,
+            fog_mips=tuple(float(m) for m in (1000, 2000, 3000, 4000)),
+            send_interval=interval,
+            horizon=horizon,
+            dt=dt,
+            max_sends_per_user=int(horizon / interval) + 4,
+            max_sends_per_tick=mspt,
+            queue_capacity=128,
+            start_time_max=min(0.05, horizon / 4),
+            derive_acks=True,
+        )
+
+    spec, state, net, bounds = build()
+    mesh = make_mesh(D, axis_name="node")
+    # per-shard exchange window: auto-size from the spec's own arrival
+    # rate (the WorldSpec.auto_arrival_window discipline, per shard)
+    win_env = _env_int("BENCH_TP_WINDOW", -1)
+    if win_env == 0:
+        window = None  # full candidate list: never defers
+    elif win_env > 0:
+        window = win_env
+    else:
+        u_loc = n_users // D
+        window = max(256, int(1.3 * u_loc * dt / max(interval, 1e-12)) + 64)
+
+    t0 = time.perf_counter()
+    _, final = run_tp_sharded(
+        spec, state, net, bounds, mesh, exchange_window=window, donate=True
+    )
+    decisions = int(np.asarray(final.metrics.n_scheduled))
+    compile_s = time.perf_counter() - t0
+    note_compile(compile_s)
+
+    walls, decs, defs = [], [], []
+    for _rep in range(n_reps):
+        spec, state, net, bounds = build()
+        t0 = time.perf_counter()
+        _, final = run_tp_sharded(
+            spec, state, net, bounds, mesh, exchange_window=window,
+            donate=True,
+        )
+        d = int(np.asarray(final.metrics.n_scheduled))
+        walls.append(time.perf_counter() - t0)
+        decs.append(d)
+        defs.append(int(np.asarray(final.metrics.n_deferred_max)))
+    mid = int(np.argsort(walls)[(len(walls) - 1) // 2])
+    wall, decisions = walls[mid], decs[mid]
+    return {
+        "metric": "tp_task_offload_decisions_per_sec",
+        "value": round(decisions / wall, 1),
+        "unit": "decisions/s",
+        "backend": backend,
+        "n_devices": D,
+        "tp_shards": D,
+        "n_users": spec.n_users,
+        "n_fogs": n_fogs,
+        "horizon_s": horizon,
+        "dt": dt,
+        "interval": interval,
+        "exchange_window": window,
+        "decisions": decisions,
+        "wall_s": round(wall, 4),
+        "per_device_decisions_per_sec": round(decisions / wall / D, 1),
+        "n_deferred_max": max(defs),
+        "compile_s": round(compile_s, 1),
+        "compile_cache": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in compile_stats().items()
+        },
+        "collectives_per_tick": "pinned in tools/op_budget.json tp_tick",
+        "equivalence": "state-hash == single-device engine; "
+        "tests/test_tp.py",
+    }
+
+
+def tp_main() -> None:
+    """``python bench.py --tp`` (or ``BENCH_TP=1``): the TP capacity
+    headline — one ≥1M-user world sharded over BENCH_DEVICES devices."""
+    n = _env_int("BENCH_DEVICES", 8)
+    ensure_mesh_devices(n)
+    print(json.dumps(tp_measurement(n)))
+
+
 def fleet_main() -> None:
     """``python bench.py --fleet`` (or ``BENCH_FLEET=1``): the multi-chip
     headline.  Provisions BENCH_DEVICES virtual CPU devices when needed
@@ -448,5 +572,7 @@ if __name__ == "__main__":
 
     if "--fleet" in sys.argv or os.environ.get("BENCH_FLEET"):
         fleet_main()
+    elif "--tp" in sys.argv or os.environ.get("BENCH_TP"):
+        tp_main()
     else:
         main()

@@ -111,6 +111,15 @@ def main(argv=None) -> int:
                     help="run in N-tick chunks, printing a progress line "
                     "per chunk (the Cmdenv status-line analog; excludes "
                     "--ticks)")
+    ap.add_argument("--tp", type=int, default=None, metavar="N",
+                    help="task-table tensor parallelism: shard ONE "
+                    "world's user/task axis over an N-device mesh "
+                    "(parallel/taskshard.run_tp_sharded: shard_map "
+                    "megaphases, explicit broker<->fog collectives, "
+                    "ring arrival exchange); dense-broker FIFO worlds "
+                    "only — composes with --policy/--telemetry; "
+                    "non-divisible populations are padded with inert "
+                    "users")
     ap.add_argument("--replicas", type=int, default=None, metavar="R",
                     help="Monte-Carlo fleet: advance R replica worlds "
                     "(per-replica PRNG streams) sharded over the device "
@@ -183,12 +192,29 @@ def main(argv=None) -> int:
         args.serve is not None
         or args.replicas is not None
         or args.mesh is not None
+        or args.tp is not None
         or args.sweep
         or args.progress
     ):
         ap.error("--checkify/FNS_CHECKIFY is the single-world debug "
                  "slow path; it does not combine with "
-                 "--serve/--replicas/--mesh/--sweep/--progress")
+                 "--serve/--replicas/--mesh/--tp/--sweep/--progress")
+
+    if args.tp is not None:
+        # ---- TP guard rails: one parallel axis per run ----------------
+        if args.replicas is not None or args.mesh is not None:
+            ap.error("--tp shards ONE world's task table over the mesh; "
+                     "--replicas/--mesh fan out independent worlds — "
+                     "pick one parallel axis per run")
+        if args.serve is not None:
+            ap.error("--serve is a single-device chunked loop; TP "
+                     "serving is a follow-up (run --tp without --serve)")
+        if args.sweep:
+            ap.error("--sweep owns its own replica fan-out; it does not "
+                     "combine with --tp")
+        if args.progress or args.ticks or args.trails:
+            ap.error("--tp runs one jitted sharded scan; "
+                     "--progress/--ticks/--trails do not apply")
 
     text = ""
     if args.config:
@@ -385,6 +411,63 @@ def main(argv=None) -> int:
         # traceback
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.tp is not None:
+        # ---- TP: one world's task table sharded over the mesh ---------
+        import jax
+
+        from .parallel import make_mesh
+        from .parallel.taskshard import run_tp_sharded
+        from .telemetry.profile import profile_trace
+
+        t0 = time.perf_counter()
+        try:
+            with profile_trace(args.profile) as prof:
+                mesh = make_mesh(args.tp, axis_name="node")
+                spec, final = run_tp_sharded(
+                    spec, state, net, bounds, mesh, pad=True
+                )
+                jax.block_until_ready(final)
+        except ValueError as e:
+            # e.g. a policy outside the dense-broker TP family, --hist,
+            # or more shards than devices: one actionable line
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        wall = time.perf_counter() - t0
+        out = {
+            "scenario": cfg.lookup("scenario", "smoke"),
+            "wall_s": round(wall, 3),
+            "tp_shards": args.tp,
+            "n_users": spec.n_users,  # post-padding population
+        }
+        outdir = args.out or cfg.lookup("output.dir")
+        if outdir:
+            run_id = args.run_id or cfg.lookup("output.run_id", "General-0")
+            out.update(record_run(
+                outdir, spec, final, run_id=run_id,
+                attrs={
+                    "argv": sys.argv[1:] if argv is None else list(argv),
+                    "scenario": cfg.lookup("scenario", "smoke"),
+                    "tp_shards": args.tp,
+                },
+            ))
+        if args.trace_out:
+            from .telemetry.timeline import export_trace
+
+            out["trace"] = export_trace(
+                spec, final, args.trace_out,
+                max_tasks=args.trace_max_tasks or None,
+            )
+        if args.profile:
+            out["profile_dir"] = prof["dir"] if prof["active"] else None
+            if prof["error"]:
+                out["profile_error"] = prof["error"]
+        s = summarize(final)
+        out.update(
+            n_published=s["n_published"], n_completed=s["n_completed"],
+        )
+        print(json.dumps(out))
+        return 0
 
     if args.serve is not None:
         # ---- live health plane (telemetry/live.py, ISSUE 6) -----------

@@ -86,6 +86,99 @@ _ST_LOST = np.int8(int(Stage.LOST))
 _STATIC_MAC_ERR = STATIC_MAC_ERR
 
 
+class TpCtx(NamedTuple):
+    """Shard context threaded through the TP-aware phase entry points.
+
+    Built by :mod:`fognetsimpp_tpu.parallel.taskshard` inside its
+    ``shard_map`` body; ``None`` everywhere else (the single-device
+    engine never constructs one).  The per-user/per-task phases run on
+    the LOCAL world view (a spec with ``n_users = U/n_shards`` and
+    locally sliced user/task arrays), and this context carries what a
+    shard-local view cannot: the global population (PRNG draws must
+    keep the reference's full-width shapes to stay bit-exact), the
+    shard's row offsets, and the full broker-delay vector for
+    global-id gathers in the fog-side phases.
+    """
+
+    axis_name: str  # mesh axis the task table is sharded over
+    n_shards: int  # static shard count
+    shard: jax.Array  # () i32 — this shard's index (lax.axis_index)
+    n_users_global: int  # U of the UNsharded world
+    u_off: jax.Array  # () i32 — first global user owned by this shard
+    t_off: jax.Array  # () i32 — first global task row owned
+    d2b_full: jax.Array  # (N_global,) f32 — full broker-delay vector
+
+
+def _tp_user_draw(tp: Optional[TpCtx], draw, n_local: int, *trailing):
+    """Run a per-user PRNG draw at the REFERENCE width, slice the shard.
+
+    Under TP each shard holds ``U/n`` users, but a shard-local draw of
+    shape ``(U_loc, ...)`` would consume a different threefry counter
+    layout than the reference's ``(U, ...)`` draw — so every shard
+    draws the full-width array (cheap: O(U) bits once per tick) and
+    dynamic-slices its own block.  Bit-exact by construction: the
+    local lanes ARE the reference lanes.
+    """
+    if tp is None:
+        return draw((n_local,) + tuple(trailing))
+    full = draw((tp.n_users_global,) + tuple(trailing))
+    return jax.lax.dynamic_slice_in_dim(full, tp.u_off, n_local, axis=0)
+
+
+def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
+    """Why ``spec`` cannot run on the shard_map'd TP tick (None = it can).
+
+    The TP tick covers the dense-broker production family — the same
+    static family as the fused front-end (:func:`_broker_dense_ok` over
+    FIFO fogs with the two-stage arrival front-end) — in the no-window
+    regime, on a static topology.  Everything else keeps the GSPMD
+    fallback (:func:`fognetsimpp_tpu.parallel.taskshard.run_node_sharded`
+    dispatches) or the single-device engine.
+    """
+    if spec.n_fogs <= 0:
+        return "TP tick needs fog nodes (n_fogs >= 1)"
+    if spec.fog_model != int(FogModel.FIFO):
+        return "TP tick covers FIFO fogs only (POOL pools are sequential)"
+    if not _broker_dense_ok(spec):
+        return (
+            "TP tick covers the dense-broker policy family "
+            "(MIN_BUSY/MIN_LATENCY/ENERGY_AWARE with bug_compat."
+            "mips0_divisor, or MAX_MIPS); sequential-pool and learned "
+            "policies keep the single-device / GSPMD paths"
+        )
+    if not spec.two_stage_arrivals:
+        return "TP tick needs the two-stage arrival front-end"
+    if spec.window < spec.task_capacity:
+        return (
+            "TP tick runs the no-window candidate tail: needs "
+            "arrival_window=None (window >= task_capacity)"
+        )
+    if not spec.assume_static:
+        return (
+            "TP tick hoists one association/delay cache for the whole "
+            "run: needs assume_static"
+        )
+    if spec.energy_enabled:
+        return "TP tick does not carry the energy/lifecycle model yet"
+    if spec.wired_queue_enabled:
+        return "TP tick does not carry DropTail backpressure yet"
+    if spec.learn_active:
+        return "TP tick does not carry bandit learner state yet"
+    if spec.telemetry_hist:
+        return (
+            "TP tick does not stream the latency histogram (per-task "
+            "ack scans are shard-local); plain --telemetry composes"
+        )
+    if spec.record_tick_series:
+        return "TP tick records no per-tick series (record via summary)"
+    return None
+
+
+def tp_ok(spec: WorldSpec) -> bool:
+    """Static gate for the shard_map'd TP tick (see tp_reject_reason)."""
+    return tp_reject_reason(spec) is None
+
+
 class TickBuf(NamedTuple):
     """Per-tick message-count accumulators feeding the energy model.
 
@@ -408,7 +501,7 @@ def _phase_adverts(
 def _phase_spawn(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
-    views: Optional[dict] = None,
+    views: Optional[dict] = None, tp: Optional[TpCtx] = None,
 ):
     """Users whose send timer fired publish one task (mqttApp2.cc:353-409).
 
@@ -451,8 +544,13 @@ def _phase_spawn(
     if spec.fixed_mips_required is not None:
         mips_req = jnp.full((U,), float(spec.fixed_mips_required), jnp.float32)
     else:
-        mips_req = jax.random.randint(
-            k_mips, (U,), spec.mips_required_min, spec.mips_required_max + 1
+        mips_req = _tp_user_draw(
+            tp,
+            lambda s: jax.random.randint(
+                k_mips, s, spec.mips_required_min,
+                spec.mips_required_max + 1,
+            ),
+            U,
         ).astype(jnp.float32)
 
     d_ub = cache.d2b[:U]  # (U,)
@@ -508,7 +606,9 @@ def _phase_spawn(
         if has_mac:
             p_eff = 1.0 - (1.0 - p_eff) * (1.0 - cache.mac_loss_p[:U])
         lost = (
-            (jax.random.uniform(k_loss, (U,)) < p_eff)
+            (_tp_user_draw(
+                tp, lambda s: jax.random.uniform(k_loss, s), U
+            ) < p_eff)
             & net.is_wireless[:U]
         )
         if buffered is not None:
@@ -527,7 +627,10 @@ def _phase_spawn(
         p_u = state.nodes.link_drop_p[:U]
         p_b = state.nodes.link_drop_p[spec.broker_index]
         p_eff = 1.0 - (1.0 - p_u) * (1.0 - p_b)
-        lost = lost | (jax.random.uniform(k_dtail, (U,)) < p_eff)
+        lost = lost | (
+            _tp_user_draw(tp, lambda s: jax.random.uniform(k_dtail, s), U)
+            < p_eff
+        )
     if warm_lost is not None:
         lost = lost | (warm_lost & net.is_wireless[:U])
     stage_new = jnp.where(
@@ -567,9 +670,13 @@ def _phase_spawn(
         )
     interval = users.send_interval
     if spec.send_interval_jitter > 0:
-        interval = interval * jax.random.uniform(
-            k_jit, (U,), minval=1.0 - spec.send_interval_jitter,
-            maxval=1.0 + spec.send_interval_jitter,
+        interval = interval * _tp_user_draw(
+            tp,
+            lambda s: jax.random.uniform(
+                k_jit, s, minval=1.0 - spec.send_interval_jitter,
+                maxval=1.0 + spec.send_interval_jitter,
+            ),
+            U,
         )
     users = users.replace(
         next_send=jnp.where(due, t_create + interval, users.next_send),
@@ -606,7 +713,7 @@ def _phase_spawn(
 def _phase_spawn_multi(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
-    views: Optional[dict] = None,
+    views: Optional[dict] = None, tp: Optional[TpCtx] = None,
 ):
     """Closed-form multi-send spawn: up to ``spec.max_sends_per_tick``
     publishes per user per tick, each with its exact event time.
@@ -665,9 +772,13 @@ def _phase_spawn_multi(
     if spec.fixed_mips_required is not None:
         mips2 = jnp.full((U, S), float(spec.fixed_mips_required), jnp.float32)
     else:
-        draws = jax.random.randint(
-            k_mips, (U, R), spec.mips_required_min,
-            spec.mips_required_max + 1,
+        draws = _tp_user_draw(
+            tp,
+            lambda s: jax.random.randint(
+                k_mips, s, spec.mips_required_min,
+                spec.mips_required_max + 1,
+            ),
+            U, R,
         ).astype(jnp.float32)
         mips2 = lane_select(draws, 0.0)
 
@@ -707,7 +818,9 @@ def _phase_spawn_multi(
         p_eff = jnp.full((U,), spec.uplink_loss_prob, jnp.float32)
         if has_mac:
             p_eff = 1.0 - (1.0 - p_eff) * (1.0 - cache.mac_loss_p[:U])
-        draws_l = jax.random.uniform(k_loss, (U, R)) < p_eff[:, None]
+        draws_l = _tp_user_draw(
+            tp, lambda s: jax.random.uniform(k_loss, s), U, R
+        ) < p_eff[:, None]
         lost2 = lane_select(draws_l, False) & net.is_wireless[:U, None]
         if buffered2 is not None:
             lost2 = lost2 & ~buffered2  # buffered frames deliver reliably
@@ -717,7 +830,9 @@ def _phase_spawn_multi(
         p_u = state.nodes.link_drop_p[:U]
         p_b = state.nodes.link_drop_p[spec.broker_index]
         p_eff = 1.0 - (1.0 - p_u) * (1.0 - p_b)
-        draws_d = jax.random.uniform(k_dtail, (U, R))
+        draws_d = _tp_user_draw(
+            tp, lambda s: jax.random.uniform(k_dtail, s), U, R
+        )
         lost2 = lost2 | (lane_select(draws_d, 1.0) < p_eff[:, None])
     if warm_lost2 is not None:
         lost2 = lost2 | (warm_lost2 & net.is_wireless[:U, None])
@@ -1082,6 +1197,7 @@ def _flush_task_views(spec: WorldSpec, tasks, v: dict):
 def _phase_broker_dense(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t1: jax.Array, views: Optional[dict] = None,
+    tp: Optional[TpCtx] = None,
 ):
     """Elementwise broker phase over the ``(U, S)`` task-table view.
 
@@ -1126,6 +1242,11 @@ def _phase_broker_dense(
                 ),
                 axis=1,
             )
+            if tp is not None:
+                # fan-out needs the GLOBAL per-topic publish counts: the
+                # one broker-side combine of the decide megaphase (exact
+                # f32 integers, so the psum total is order-independent)
+                per_topic = jax.lax.psum(per_topic, tp.axis_name)
             deliveries = (
                 users.sub_mask.astype(jnp.float32) @ per_topic
             ).astype(i32)
@@ -1897,6 +2018,39 @@ def _fog_arrivals_front_full(
     )
 
 
+def _arrival_candidates(st2, taf2, fog2, mip2, t1, R: int):
+    """R earliest matured (TASK_INFLIGHT, ``t_at_fog <= t1``) slots per
+    user, reduced from the ``(U, S)`` task-table view.
+
+    The unfused reference formulation of the two-stage front's candidate
+    loop, extracted so the TP sharded tick
+    (:mod:`fognetsimpp_tpu.parallel.taskshard`) runs the IDENTICAL
+    per-pass reductions on its local user block — argmin returns the
+    FIRST min, so time ties break by slot id exactly like the classic
+    selection.  Returns ``(cks, cts, cfs, cms, cvs, n_left)``: per-pass
+    lists of (slot-index, time, fog, MIPS, valid) plus the count of
+    matured slots beyond the per-user cap (they defer one tick).
+    """
+    i32 = jnp.int32
+    S = st2.shape[1]
+    kk = jnp.arange(S, dtype=i32)[None, :]
+    m = (st2 == _ST_TASK_INFLIGHT) & (taf2 <= t1)
+    cks, cts, cfs, cms, cvs = [], [], [], [], []
+    for _ in range(R):
+        key = jnp.where(m, taf2, jnp.inf)
+        ck = jnp.argmin(key, axis=1).astype(i32)  # (U,)
+        ct = jnp.min(key, axis=1)
+        cv = jnp.isfinite(ct)
+        sel = m & (kk == ck[:, None])
+        cf = jnp.sum(jnp.where(sel, fog2, 0), axis=1)  # one-hot: exact
+        cm = jnp.sum(jnp.where(sel, mip2, 0.0), axis=1)
+        cks.append(ck); cts.append(ct); cfs.append(cf)
+        cms.append(cm); cvs.append(cv)
+        m = m & ~sel
+    n_left = jnp.sum(m, dtype=i32)  # matured beyond the per-user cap
+    return cks, cts, cfs, cms, cvs, n_left
+
+
 def _fog_arrivals_front_two_stage(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t1: jax.Array, views: Optional[dict] = None,
@@ -1939,7 +2093,6 @@ def _fog_arrivals_front_two_stage(
         mip2 = tasks.mips_req.reshape(U, S)
     kk = jnp.arange(S, dtype=i32)[None, :]
 
-    m = (st2 == _ST_TASK_INFLIGHT) & (taf2 <= t1)
     # R earliest matured slots per user; argmin returns the FIRST min, so
     # time ties break by slot id exactly like the classic selection.
     # Fused mode halves the reductions per pass: (min, argmin) collapse
@@ -1947,10 +2100,16 @@ def _fog_arrivals_front_two_stage(
     # first-occurrence tie-break) and the two one-hot row sums into one
     # stacked sum (a one-hot sum IS its single element, and fog ids are
     # exact in f32, so both merges are bit-identical).
-    cks, cts, cfs, cms, cvs = [], [], [], [], []
-    for _ in range(R):
-        key = jnp.where(m, taf2, jnp.inf)
-        if views is not None:
+    if views is None:
+        # unfused reference formulation, shared with the TP sharded tick
+        cks, cts, cfs, cms, cvs, n_left = _arrival_candidates(
+            st2, taf2, fog2, mip2, t1, R
+        )
+    else:
+        m = (st2 == _ST_TASK_INFLIGHT) & (taf2 <= t1)
+        cks, cts, cfs, cms, cvs = [], [], [], [], []
+        for _ in range(R):
+            key = jnp.where(m, taf2, jnp.inf)
             ct, ck = row_lexmin(key)  # (U,), (U,) in ONE reduce
             cv = jnp.isfinite(ct)
             sel = m & (kk == ck[:, None])
@@ -1964,17 +2123,10 @@ def _fog_arrivals_front_two_stage(
             )  # (U, 2)
             cf = cfm[:, 0].astype(i32)
             cm = cfm[:, 1]
-        else:
-            ck = jnp.argmin(key, axis=1).astype(i32)  # (U,)
-            ct = jnp.min(key, axis=1)
-            cv = jnp.isfinite(ct)
-            sel = m & (kk == ck[:, None])
-            cf = jnp.sum(jnp.where(sel, fog2, 0), axis=1)  # one-hot: exact
-            cm = jnp.sum(jnp.where(sel, mip2, 0.0), axis=1)
-        cks.append(ck); cts.append(ct); cfs.append(cf)
-        cms.append(cm); cvs.append(cv)
-        m = m & ~sel
-    n_left = jnp.sum(m, dtype=i32)  # matured beyond the per-user cap
+            cks.append(ck); cts.append(ct); cfs.append(cf)
+            cms.append(cm); cvs.append(cv)
+            m = m & ~sel
+        n_left = jnp.sum(m, dtype=i32)  # matured beyond the per-user cap
 
     UR = U * R
     cand_k = jnp.stack(cks, axis=1).reshape(UR)  # (UR,) slot index in [0,S)

@@ -191,6 +191,83 @@ def fused_arrival_plan(
     return rank[0], cnt[0], tmin[0], first[0]
 
 
+def pallas_ring_applicable(ndim: int, n_shards: int) -> bool:
+    """Opt-in (FNS_PALLAS_RING=1) gate for the remote-DMA ring
+    all-gather used by the TP arrival exchange
+    (``parallel/taskshard.ring_all_gather``).  TPU backend only — the
+    portable default is the ``lax.ppermute`` ring; ``interpret=True``
+    runs the identical kernel on CPU (tests/test_tp.py asserts exact
+    equality with both the ppermute ring and a dense reference).
+    Takes the static rank (not the traced array) so the host-side gate
+    never touches traced values (simlint R2)."""
+    if os.environ.get("FNS_PALLAS_RING", "0") != "1":
+        return False
+    if n_shards < 2 or ndim != 2:
+        return False
+    backend = jax.default_backend()
+    if backend != "tpu":
+        _optin_note("FNS_PALLAS_RING", f"backend is {backend!r}, not tpu")
+        return False
+    return True
+
+
+def ring_all_gather_pallas(
+    x: jax.Array,  # (K, C) — this shard's block
+    axis_name: str,
+    n_shards: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """(n*K, C) ring all-gather via Pallas remote DMA (SNIPPETS [2]).
+
+    Each step remote-copies the block received last step (double-
+    buffered comm scratch, per-slot DMA semaphores) to the RIGHT
+    neighbor and files the incoming block at its home offset, so after
+    ``n-1`` hops every shard holds the blocks in global shard order —
+    the same contract as the ``lax.ppermute`` ring it replaces.  Must
+    be called inside a ``shard_map`` body over ``axis_name``.  Opt-in
+    (:func:`pallas_ring_applicable`): the XLA collective-permute path
+    is the measured default until a chip session proves this kernel
+    wins (the fused_arrival_plan discipline).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    K, C = x.shape
+    n = n_shards
+
+    def kernel(x_ref, out_ref, comm_ref, send_sem, recv_sem):
+        my_id = jax.lax.axis_index(axis_name)
+        # local block straight to its home slot
+        out_ref[pl.ds(my_id * K, K), :] = x_ref[...]
+        comm_ref[0] = x_ref[...]
+        for step in range(n - 1):
+            send_slot = step % 2
+            recv_slot = 1 - send_slot
+            dst = jax.lax.rem(my_id + 1, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_ref.at[send_slot],
+                dst_ref=comm_ref.at[recv_slot],
+                send_sem=send_sem.at[send_slot],
+                recv_sem=recv_sem.at[recv_slot],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()
+            src = jax.lax.rem(my_id - step - 1 + n, n)
+            out_ref[pl.ds(src * K, K), :] = comm_ref[recv_slot]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n * K, C), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, K, C), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(x)
+
+
 def _rank_kernel(fog_all, t_all, mask_all, fog_row, t_row, mask_row, rank_ref,
                  *, tk: int, K: int):
     i = pl.program_id(0)

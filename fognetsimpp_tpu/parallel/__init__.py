@@ -12,18 +12,28 @@ The TPU-native scale-out axes this package provides instead:
     reduction, chunked sharded series offload — the measured
     multi-chip headline path (``bench.py --fleet`` /
     ``MULTICHIP_r*.json``).
-  * **TP** — :mod:`tp`: node-axis sharding of the scheduler's score
-    matrix via ``shard_map`` with cross-shard argmin combines, for worlds
-    whose fog population exceeds one chip's comfortable tile.
+  * **TP** — :mod:`taskshard`: ONE world's user/task axis row-sharded
+    over the mesh via an explicit ``shard_map`` tick (hand-placed
+    broker↔fog ``psum`` combines + ring ``ppermute`` arrival exchange,
+    audited/budgeted in CI; GSPMD fallback for worlds outside the
+    dense-broker family) — the HBM-capacity axis, measured at 2^20
+    users on the 8-device mesh (``bench.py --tp``).  :mod:`tp` keeps
+    the fog-axis shard_map scheduler (cross-shard argmin combines) for
+    fog populations exceeding one chip's comfortable tile.
   * **EP** — :func:`sweep.sweep_policies`: the policy axis of the grid
     (the reference's dead ``algo`` parameter made sweepable), and
     :func:`sweep.sweep_explore`: the exploration-rate axis of the
     learned bandit schedulers (``LearnState.explore`` as carry data —
     the whole rate × load grid under one compile).
 
-Collectives ride the mesh (ICI within a slice, DCN across) through XLA —
-``all_gather``/``pmin`` inserted by ``shard_map`` — never hand-written
-transports.
+Collectives ride the mesh (ICI within a slice, DCN across) as XLA
+collectives — hand-placed ``psum``/``ppermute`` inside the shard_map
+ticks, never raw transports — with one opt-in exception: the TP
+arrival exchange's Pallas remote-DMA ring kernel
+(``ops/pallas_kernels.ring_all_gather_pallas``, ``FNS_PALLAS_RING=1``).
+Every collective a sharded program may emit is declared next to its
+module (``DECLARED_COLLECTIVES``) and verified against the compiled
+artifact by ``tools/hloaudit``.
 """
 from .replicas import replicate_state, run_replicated, replica_counters  # noqa: F401
 from .mesh import make_mesh, replica_sharding, shard_replicas, run_sharded  # noqa: F401
@@ -35,5 +45,11 @@ from .fleet import (  # noqa: F401
 )
 from .multihost import global_mesh, initialize  # noqa: F401
 from .sweep import sweep_explore, sweep_policies  # noqa: F401
-from .taskshard import run_node_sharded, shard_state_by_node  # noqa: F401
+from .taskshard import (  # noqa: F401
+    pad_users_to_multiple,
+    ring_all_gather,
+    run_node_sharded,
+    run_tp_sharded,
+    shard_state_by_node,
+)
 from .tp import sharded_min_busy  # noqa: F401

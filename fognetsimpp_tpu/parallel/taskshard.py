@@ -2,38 +2,1074 @@
 
 When the task table outgrows one chip (it dominates world memory:
 ``T = n_users * max_sends`` rows × ~17 columns), the per-task and per-user
-arrays shard across the mesh with ``NamedSharding(P("node"))`` and the
-*unmodified* engine step runs under XLA's SPMD partitioner: per-shard
-phases (spawn, masks, compaction scans) stay local, and GSPMD inserts the
-collectives where a phase genuinely needs a global view (the K-sized
-compacted windows, fog/broker reductions) — exactly the
-"state sharded over mesh axes when node count exceeds one chip's HBM" row
-of SURVEY.md §2.3, with zero hand-written communication.
+arrays shard row-wise across the mesh and ONE world's population spans
+every device — the capacity half of the ROADMAP north star (FogMQ's
+internet-scale-broker regime, arXiv:1610.00620), vs. replica-DP
+(:mod:`fognetsimpp_tpu.parallel.fleet`), which only multiplies
+independent worlds.
 
-Division of labour with the other axes: replica-DP
-(:mod:`fognetsimpp_tpu.parallel.mesh`) is the *throughput* path (zero
-collectives); this module is the *capacity* path (per-device task memory
-= T / n_devices, paying K-sized gathers per tick).  Results are
-bit-identical to the unsharded engine (tested), and input shardings
-propagate to the outputs, so chained calls keep the table distributed.
+Two implementations live here:
+
+* **The explicit shard_map TP tick** (:func:`run_tp_sharded`) — the
+  measured production path for the dense-broker family
+  (:func:`fognetsimpp_tpu.core.engine.tp_ok`).  Each engine megaphase
+  runs shard-local on a LOCAL world view (a spec with ``n_users = U/n``
+  and locally sliced user/task/node rows; fog, broker, metrics and PRNG
+  state replicated), with hand-placed broker↔fog collectives exactly
+  where a global view is genuinely needed:
+
+  - *spawn/connect*: zero collectives (full-width PRNG draws sliced per
+    shard keep the reference bit pattern — ``engine._tp_user_draw``);
+  - *dense broker decide*: zero collectives for the decision itself
+    (the scalar winner is a pure function of the replicated broker
+    view); one ``psum`` for the global per-topic fan-out counts;
+  - *fog completions*: one ``psum``-combine per pass gathering the
+    (MIPS, queue-entry-time) columns of the F global task ids the
+    replicated fog state points at — each id is owned by exactly one
+    shard, so masked-local-gather + psum IS the gather;
+  - *fog arrivals*: the cross-device exchange — each shard's compacted
+    arrival candidates ride a ring of ``lax.ppermute`` neighbor hops
+    (N-1 steps; opt-in Pallas remote-DMA ring kernel,
+    ``ops/pallas_kernels.ring_all_gather``) into a replicated global
+    window, on which every shard runs the reference assignment/FIFO
+    tail identically — so the replicated fog/queue state stays
+    bit-coherent without locks; task-table writes land only on the
+    owning shard (drop-scatter on out-of-shard rows).  Saturated-fog
+    tail-drops are decided shard-local (one ``psum`` for the per-fog
+    busy/count sums) and never occupy exchange slots;
+  - *counters*: ONE end-of-tick ``psum`` folds every shard-partial
+    scalar (metrics deltas + broker message counters) into the
+    replicated totals.
+
+  Results are bit-identical to the single-device engine
+  (tests/test_tp.py state-hash A/B), and ``tools/hloaudit`` proves the
+  compiled tick contains exactly the collectives declared in
+  :data:`DECLARED_COLLECTIVES` with the per-tick count pinned by
+  ``tools/op_budget.py``.
+
+* **The GSPMD fallback** (:func:`run_node_sharded` for worlds outside
+  the TP family) — the original "unmodified engine under the SPMD
+  partitioner" path: correct for every world the engine runs (windowed
+  compaction, mobility, POOL fogs ...), but with XLA choosing the
+  communication.  :func:`run_node_sharded` dispatches: TP-eligible
+  specs take the explicit tick, the rest keep GSPMD.
+
+Division of labour with the other axes: replica-DP is the *throughput*
+path (zero collectives); this module is the *capacity* path (per-device
+task memory = T / n_devices, paying the arrival exchange per tick).
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.engine import run
+from ..core.engine import (
+    TickBuf,
+    TpCtx,
+    _arrival_candidates,
+    _compact,
+    _finalize_derived_acks,
+    _per_fog,
+    _phase_adverts,
+    _phase_broker_dense,
+    _phase_connect,
+    _phase_periodic_adverts,
+    _phase_spawn,
+    _phase_spawn_multi,
+    _STATIC_MAC_ERR,
+    _ST_DONE,
+    _ST_DROPPED,
+    _ST_QUEUED,
+    _ST_RUNNING,
+    _ST_TASK_INFLIGHT,
+    _svc_time,
+    run,
+    tp_ok,
+    tp_reject_reason,
+)
 from ..net.mobility import MobilityBounds
-from ..net.topology import NetParams
+from ..net.topology import LinkCache, NetParams, associate
+from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
 from ..spec import WorldSpec
-from ..state import WorldState
+from ..state import Metrics, NodeState, TaskState, UserState, WorldState
 from .mesh import replica_sharding
+from .tp import shard_map
 
 NODE_AXIS = "node"
 
+#: Collectives the compiled TP tick is ALLOWED to contain, keyed by the
+#: op_name scope they must attribute to — the contract ``tools/hloaudit``
+#: enforces on the compiled artifact (audit rule A3).  The sharded tick
+#: emits exactly two families inside the shard_map body: the ``psum``
+#: combines (fan-out counts, completion-gathers, fast-drop sums, the
+#: end-of-tick counter fold → ``all-reduce``) and the arrival-exchange
+#: ring (``lax.ppermute`` neighbor hops → ``collective-permute``).
+#: Anything else (a GSPMD resharding all-to-all, an accidental
+#: all-gather from a leaked annotation) is a fatal CI finding.  Extend
+#: this table in the same change that adds a collective.
+DECLARED_COLLECTIVES = {
+    "shmap_body": {"all-reduce", "collective-permute"},
+}
+
+_METRIC_FIELDS = tuple(f.name for f in dataclasses.fields(Metrics))
+
+
+# ----------------------------------------------------------------------
+# population padding (arbitrary user counts on a fixed mesh)
+# ----------------------------------------------------------------------
+
+def pad_users_to_multiple(
+    spec: WorldSpec, state: WorldState, net: NetParams, n: int
+) -> Tuple[WorldSpec, WorldState, NetParams]:
+    """Pad the user population up to a multiple of ``n`` with INERT rows.
+
+    Padded users are unregistered ghosts: never started (``start_t`` =
+    +inf), non-publishers, unconnected, with all their task rows
+    ``Stage.UNUSED``/``NO_TASK`` — no phase can ever touch them, so the
+    real users' dynamics are exactly those of the same spec at the
+    padded population (tests/test_tp.py pins the inertness).  The net
+    gains matching unattached node rows (attach = -1).
+
+    Spawn-stream note: PRNG draws are shaped ``(n_users,)``, so padding
+    changes the per-user random stream vs the unpadded world — the same
+    (documented) caveat as ``max_sends_per_tick > 1``.  Scenario anchors
+    pinned to committed traces use divisible populations.
+    """
+    U = spec.n_users
+    pad = (-U) % n
+    if pad == 0:
+        return spec, state, net
+    if spec.learn_active or spec.telemetry_hist:
+        raise ValueError(
+            "pad_users_to_multiple does not extend per-task learner/"
+            "histogram state; pick a divisible population for those specs"
+        )
+    S = spec.max_sends_per_user
+    U2 = U + pad
+    spec2 = dataclasses.replace(spec, n_users=U2).validate()
+    f32, i32 = jnp.float32, jnp.int32
+
+    def ins_nodes(x, fill):
+        blk = jnp.full((pad,) + x.shape[1:], fill, x.dtype)
+        return jnp.concatenate([x[:U], blk, x[U:]], axis=0)
+
+    nd = state.nodes
+    nodes = NodeState(
+        kind=ins_nodes(nd.kind, 0),  # NodeKind.USER
+        pos=ins_nodes(nd.pos, 0.0),
+        alive=ins_nodes(nd.alive, True),
+        mobility=ins_nodes(nd.mobility, 0),
+        vel=ins_nodes(nd.vel, 0.0),
+        circle_center=ins_nodes(nd.circle_center, 0.0),
+        circle_radius=ins_nodes(nd.circle_radius, 0.0),
+        circle_omega=ins_nodes(nd.circle_omega, 0.0),
+        circle_phase=ins_nodes(nd.circle_phase, 0.0),
+        energy=ins_nodes(nd.energy, spec.energy_capacity_j),
+        energy_capacity=ins_nodes(nd.energy_capacity, spec.energy_capacity_j),
+        has_energy=ins_nodes(nd.has_energy, False),
+        link_backlog=ins_nodes(nd.link_backlog, 0.0),
+        link_drop_p=ins_nodes(nd.link_drop_p, 0.0),
+        tx_count=ins_nodes(nd.tx_count, 0),
+        rx_count=ins_nodes(nd.rx_count, 0),
+        assoc_sum=ins_nodes(nd.assoc_sum, 0),
+    )
+
+    def app_users(x, fill):
+        blk = jnp.full((pad,) + x.shape[1:], fill, x.dtype)
+        return jnp.concatenate([x, blk], axis=0)
+
+    us = state.users
+    users = UserState(
+        next_send=app_users(us.next_send, jnp.inf),
+        send_count=app_users(us.send_count, 0),
+        send_interval=app_users(us.send_interval, spec.send_interval),
+        connected=app_users(us.connected, False),
+        start_t=app_users(us.start_t, jnp.inf),
+        connack_at=app_users(us.connack_at, jnp.inf),
+        publisher=app_users(us.publisher, False),
+        pub_topic=app_users(us.pub_topic, 0),
+        sub_mask=app_users(us.sub_mask, False),
+        n_delivered=app_users(us.n_delivered, 0),
+    )
+
+    def app_tasks(x, fill):
+        blk = jnp.full((pad * S,) + x.shape[1:], fill, x.dtype)
+        return jnp.concatenate([x, blk], axis=0)
+
+    tk = state.tasks
+    tasks = TaskState(
+        stage=app_tasks(tk.stage, 0),  # Stage.UNUSED
+        user=jnp.repeat(jnp.arange(U2, dtype=i32), S),
+        fog=app_tasks(tk.fog, NO_TASK),
+        mips_req=app_tasks(tk.mips_req, 0.0),
+        t_create=app_tasks(tk.t_create, jnp.inf),
+        t_at_broker=app_tasks(tk.t_at_broker, jnp.inf),
+        t_at_fog=app_tasks(tk.t_at_fog, jnp.inf),
+        t_service_start=app_tasks(tk.t_service_start, jnp.inf),
+        t_complete=app_tasks(tk.t_complete, jnp.inf),
+        t_q_enter=app_tasks(tk.t_q_enter, jnp.inf),
+        t_ack3=app_tasks(tk.t_ack3, jnp.inf),
+        t_ack4_fwd=app_tasks(tk.t_ack4_fwd, jnp.inf),
+        t_ack4_queued=app_tasks(tk.t_ack4_queued, jnp.inf),
+        t_ack5=app_tasks(tk.t_ack5, jnp.inf),
+        t_ack6=app_tasks(tk.t_ack6, jnp.inf),
+        queue_time_ms=app_tasks(tk.queue_time_ms, jnp.inf),
+        req_open=app_tasks(tk.req_open, 0),
+    )
+
+    net2 = net.replace(
+        node_attach=ins_nodes(net.node_attach, -1),  # unattached ghosts
+        node_acc=ins_nodes(net.node_acc, 0.0),
+        is_wireless=ins_nodes(net.is_wireless, False),
+        ap_nodes=jnp.where(
+            net.ap_nodes >= U, net.ap_nodes + pad, net.ap_nodes
+        ),
+    )
+    state2 = state.replace(
+        nodes=nodes, users=users, tasks=tasks,
+    )
+    _ = f32  # (dtype alias kept for symmetry with init_state)
+    return spec2, state2, net2
+
+
+# ----------------------------------------------------------------------
+# ring arrival exchange
+# ----------------------------------------------------------------------
+
+def ring_all_gather(x: jax.Array, axis_name: str, n_shards: int) -> jax.Array:
+    """Assemble every shard's block along axis 0, in GLOBAL shard order,
+    via ``n-1`` nearest-neighbor ``lax.ppermute`` hops (ring all-gather).
+
+    The portable default for the TP arrival exchange (SNIPPETS [2] is
+    the Pallas remote-DMA rendition of this exact pattern —
+    ``ops/pallas_kernels.ring_all_gather_pallas`` is the opt-in TPU
+    kernel; ``FNS_PALLAS_RING=1``).  Each step sends the block received
+    last step to the right neighbor, so after ``n-1`` hops every shard
+    has written block ``j`` of shard ``j`` at offset ``j * K`` — the
+    concatenation order is shard-major, which for row-sharded user
+    blocks IS the global user-major order the reference window uses.
+    """
+    if n_shards == 1:
+        return x
+    from ..ops.pallas_kernels import (
+        pallas_ring_applicable,
+        ring_all_gather_pallas,
+    )
+
+    if pallas_ring_applicable(x.ndim, n_shards):
+        return ring_all_gather_pallas(x, axis_name, n_shards)
+    K = x.shape[0]
+    me = jax.lax.axis_index(axis_name)
+    out = jnp.zeros((n_shards * K,) + x.shape[1:], x.dtype)
+    out = jax.lax.dynamic_update_slice_in_dim(out, x, me * K, axis=0)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    blk = x
+    for s in range(1, n_shards):
+        blk = jax.lax.ppermute(blk, axis_name, perm)
+        src = (me - s) % n_shards  # the block is s hops from home
+        out = jax.lax.dynamic_update_slice_in_dim(out, blk, src * K, axis=0)
+    return out
+
+
+def _bits(x: jax.Array) -> jax.Array:
+    """f32 -> i32 bit pattern (pack floats into the one exchange array)."""
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _floats(x: jax.Array) -> jax.Array:
+    return jax.lax.bitcast_convert_type(x, jnp.float32)
+
+
+# ----------------------------------------------------------------------
+# TP fog megaphases (replicated fog state, shard-owned task rows)
+# ----------------------------------------------------------------------
+
+def _loc_idx(idx_g: jax.Array, tp: TpCtx, t_loc: int) -> jax.Array:
+    """Global task ids -> local scatter targets (sentinel = ``t_loc``).
+
+    Rows owned by another shard map to the one-past-the-end sentinel so
+    drop-mode scatters discard them — each global row is written by
+    exactly its owner, and every shard computes the identical values,
+    so the union of the shards' writes is the reference's single write.
+    """
+    loc = idx_g - tp.t_off
+    owned = (loc >= 0) & (loc < t_loc)
+    return jnp.where(owned, loc, t_loc)
+
+
+def _gather_psum(tp: TpCtx, rows: list, idx_g: jax.Array, t_loc: int):
+    """Gather task-table columns at GLOBAL ids across the mesh.
+
+    ``rows`` is a list of (T_loc,) local columns; returns the stacked
+    (len(rows), F) gathered values.  Each id is owned by exactly one
+    shard: the owner contributes the value, everyone else contributes
+    0, and one ``psum`` is the gather (x + 0 = x in f32: exact).
+    """
+    loc = idx_g - tp.t_off
+    owned = (loc >= 0) & (loc < t_loc)
+    locc = jnp.clip(loc, 0, t_loc - 1)
+    vals = jnp.stack([jnp.where(owned, r[locc], 0.0) for r in rows])
+    return jax.lax.psum(vals, tp.axis_name)
+
+
+def _tp_completions(
+    spec: WorldSpec, tp: TpCtx, state: WorldState, cache: LinkCache,
+    buf_p: TickBuf, buf_r: TickBuf, m_rep: Metrics, t1: jax.Array,
+):
+    """TP rendition of ``engine._phase_completions`` (FIFO release).
+
+    Same formulas, same masks; the two task-table reads the replicated
+    fog state needs (the finished/promoted tasks' MIPS and the head's
+    queue-entry time) come through ONE ``psum`` gather, and the task
+    writes land on the owning shard only.  ``buf_p``/``buf_r`` split
+    the reference's counters into shard-partial (per-user acks) and
+    replicated (fog/broker totals) halves.
+    """
+    tasks, fogs, b = state.tasks, state.fogs, state.broker
+    F, U = spec.n_fogs, spec.n_users
+    S = spec.max_sends_per_user
+    T_loc = spec.task_capacity
+    T_g = tp.n_users_global * S
+    i32 = jnp.int32
+    fog_alive = state.nodes.alive[U : U + F]
+
+    comp = (fogs.current_task != NO_TASK) & (fogs.busy_until <= t1) & fog_alive
+    done_task = jnp.where(comp, fogs.current_task, T_g)  # global ids
+    t_done = fogs.busy_until
+
+    # FIFO head (pure function of the replicated ring) hoisted before
+    # the busy bookkeeping so both gathers share one psum
+    head, q_head, q_len = batched_pop(fogs.queue, fogs.q_head, fogs.q_len, comp)
+    head_s = jnp.where(head == NO_TASK, T_g, head)
+
+    gathered = _gather_psum(
+        tp,
+        [tasks.mips_req, tasks.t_q_enter],
+        jnp.concatenate([done_task, head_s]),
+        T_loc,
+    )  # (2, 2F): both columns gathered at the [done | head] id vector
+    mips_done = gathered[0, :F]
+    mips_head = gathered[0, F:]
+    tq_head = gathered[1, F:]
+
+    user_of = jnp.clip(done_task, 0, T_g - 1) // S  # global users
+    d_fb = cache.d2b[U : U + F]
+    d_bu = tp.d2b_full[user_of]
+    t_ack6 = t_done + d_fb + d_bu
+
+    svc_done = _svc_time(spec, mips_done, fogs.mips)
+
+    done_loc = _loc_idx(done_task, tp, T_loc)
+    tasks = tasks.replace(
+        t_complete=tasks.t_complete.at[done_loc].set(
+            jnp.where(comp, t_done, 0), mode="drop"
+        ),
+    )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            t_ack6=tasks.t_ack6.at[done_loc].set(
+                jnp.where(comp, t_ack6, 0), mode="drop"
+            ),
+        )
+    busy_time = jnp.where(comp, fogs.busy_time - svc_done, fogs.busy_time)
+
+    promoted = comp & (head != NO_TASK)
+    svc_new = _svc_time(spec, mips_head, fogs.mips)
+    prom_loc = _loc_idx(jnp.where(promoted, head_s, T_g), tp, T_loc)
+    # ONE stage scatter for completed + promoted rows (disjoint sets)
+    scat_stage = jnp.concatenate([done_loc, prom_loc])
+    stage_vals = jnp.concatenate(
+        [jnp.full((F,), _ST_DONE), jnp.full((F,), _ST_RUNNING)]
+    )
+    tasks = tasks.replace(
+        stage=tasks.stage.at[scat_stage].set(stage_vals, mode="drop"),
+        t_service_start=tasks.t_service_start.at[prom_loc].set(
+            jnp.where(comp, t_done, 0), mode="drop"
+        ),
+    )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            queue_time_ms=tasks.queue_time_ms.at[prom_loc].set(
+                jnp.where(promoted, (t_done - tq_head) * 1e3, 0),
+                mode="drop",
+            ),
+        )
+    fogs = fogs.replace(
+        busy_time=busy_time,
+        current_task=jnp.where(
+            comp, jnp.where(promoted, head, NO_TASK), fogs.current_task
+        ),
+        busy_until=jnp.where(
+            comp, jnp.where(promoted, t_done + svc_new, jnp.inf),
+            fogs.busy_until,
+        ),
+        free_since=jnp.where(comp & ~promoted, t_done, fogs.free_since),
+        q_head=q_head,
+        q_len=q_len,
+    )
+    if spec.adv_on_completion:
+        b = b.replace(
+            adv_val_mips=jnp.where(comp, fogs.mips, b.adv_val_mips),
+            adv_val_busy=jnp.where(comp, busy_time, b.adv_val_busy),
+            adv_arrive_t=jnp.where(comp, t_done + d_fb, b.adv_arrive_t),
+        )
+    n_comp = jnp.sum(comp.astype(i32))  # replicated total
+    m_rep = m_rep.replace(n_completed=m_rep.n_completed + n_comp)
+    n_adv = n_comp if spec.adv_on_completion else 0
+    buf_r = buf_r._replace(
+        tx_f=buf_r.tx_f
+        + comp.astype(i32) * (2 if spec.adv_on_completion else 1),
+        tx_b=buf_r.tx_b + n_comp,
+        rx_b=buf_r.rx_b + n_comp + n_adv,
+    )
+    # per-user ack relay: only this shard's users land in its rx_u
+    u_loc = user_of - tp.u_off
+    u_ok = (u_loc >= 0) & (u_loc < U)
+    buf_p = buf_p._replace(
+        rx_u=buf_p.rx_u.at[jnp.where(u_ok, u_loc, U)].add(
+            (comp & u_ok).astype(i32), mode="drop"
+        )
+    )
+    state = state.replace(tasks=tasks, fogs=fogs, broker=b)
+    return state, buf_p, buf_r, m_rep
+
+
+def _tp_fog_arrivals(
+    spec: WorldSpec, tp: TpCtx, state: WorldState, cache: LinkCache,
+    buf_p: TickBuf, buf_r: TickBuf, m_part: Metrics, m_rep: Metrics,
+    t1: jax.Array, k_exchange: int,
+):
+    """TP rendition of the two-stage fog-arrival megaphase.
+
+    Front (shard-local): the per-user candidate reduction
+    (``engine._arrival_candidates`` — literally the reference code on
+    the local user block), the saturated-fog tail-drop decision against
+    the replicated fog state (per-fog busy/count sums combined with one
+    ``psum``), and compaction of the surviving candidates into the
+    fixed ``k_exchange`` window (overflow defers a tick, counted in
+    ``n_deferred`` — the engine's established windowed contract).
+
+    Exchange: the packed (slot, fog, time, MIPS) columns ride the ring
+    (:func:`ring_all_gather`) into a replicated global window whose
+    valid rows sit in global candidate order (shard-major blocks of
+    ascending local order = ascending global order), so every relative
+    tie-break matches the reference window exactly.
+
+    Tail (replicated): the reference assignment/FIFO logic verbatim on
+    the assembled window — identical on every shard, which is what
+    keeps the fog/queue state coherent — with task-table writes mapped
+    to the owning shard and per-user acks to the owning shard's bucket.
+    """
+    tasks, fogs = state.tasks, state.fogs
+    F = spec.n_fogs
+    U, S = spec.n_users, spec.max_sends_per_user
+    T_loc = spec.task_capacity
+    T_g = tp.n_users_global * S
+    R = min(spec.arrival_cands, S)
+    i32 = jnp.int32
+    f32 = jnp.float32
+    fog_alive = state.nodes.alive[U : U + F]
+
+    st2 = tasks.stage.reshape(U, S)
+    taf2 = tasks.t_at_fog.reshape(U, S)
+    fog2 = tasks.fog.reshape(U, S)
+    mip2 = tasks.mips_req.reshape(U, S)
+    kk = jnp.arange(S, dtype=i32)[None, :]
+
+    cks, cts, cfs, cms, cvs, n_left = _arrival_candidates(
+        st2, taf2, fog2, mip2, t1, R
+    )
+    UR = U * R
+    cand_k = jnp.stack(cks, axis=1).reshape(UR)
+    cand_t = jnp.stack(cts, axis=1).reshape(UR)
+    cand_f = jnp.stack(cfs, axis=1).reshape(UR)
+    cand_m = jnp.stack(cms, axis=1).reshape(UR)
+    cand_v = jnp.stack(cvs, axis=1).reshape(UR)
+    cand_u = jnp.repeat(jnp.arange(U, dtype=i32), R)
+    cand_slot_g = cand_u * S + cand_k + tp.t_off  # GLOBAL task ids
+
+    # ---- saturated-fog fast drop (local decision, psum'd fog sums) ----
+    droppy = (
+        (fogs.q_len >= spec.queue_capacity)
+        & (fogs.current_task != NO_TASK)
+        & fog_alive
+    )
+    memb = (
+        cand_f[None, :] == jnp.arange(F, dtype=i32)[:, None]
+    ) & cand_v[None, :]  # (F, UR)
+    memb_f = memb.astype(f32)
+    droppy_c = droppy.astype(f32) @ memb_f > 0.5
+    fast_drop = cand_v & droppy_c
+    rhs = jnp.stack(
+        [fast_drop.astype(f32), jnp.where(fast_drop, cand_m, 0.0)], axis=1
+    )  # (UR, 2)
+    sums_fd = jax.lax.psum(memb_f @ rhs, tp.axis_name)  # (F, 2): the
+    #   global tail-drop count/MIPS sums (exact f32 integers < 2^24, so
+    #   the cross-shard add order cannot change a bit)
+    n_fast_f = sums_fd[:, 0].astype(i32)
+    svc_fast_f = sums_fd[:, 1] / jnp.maximum(fogs.mips, 1e-9)
+    fogs = fogs.replace(
+        busy_time=fogs.busy_time + svc_fast_f,
+        q_drops=fogs.q_drops + n_fast_f,
+    )
+    n_fast = jnp.sum(n_fast_f)
+    # stage -> DROPPED densely over the local (U, S) view
+    fast2 = fast_drop.reshape(U, R)
+    sel_fast = jnp.zeros((U, S), bool)
+    for r in range(R):
+        sel_fast = sel_fast | ((kk == cks[r][:, None]) & fast2[:, r : r + 1])
+    tasks = tasks.replace(
+        stage=jnp.where(sel_fast, _ST_DROPPED, st2).reshape(T_loc)
+    )
+    cand_v = cand_v & ~fast_drop
+
+    # ---- exchange-window compaction ------------------------------------
+    m_part = m_part.replace(n_deferred=m_part.n_deferred + n_left)
+    n_set = jnp.sum(cand_v.astype(i32))
+    m_part = m_part.replace(
+        n_deferred=m_part.n_deferred + jnp.maximum(n_set - k_exchange, 0)
+    )
+    if k_exchange >= UR:
+        # overflow impossible: plain ascending order, which keeps the
+        # assembled window in exact global candidate order (the
+        # bit-exact-vs-reference regime)
+        rot = None
+    else:
+        # bounded window: the engine's tick-keyed scan-origin rotation
+        # (_rot_and_defer) — a fixed origin would systematically seat
+        # low-index users first and starve the rest under sustained
+        # overflow.  state.tick is replicated, so every shard rotates
+        # identically and deferral spreads evenly across its users.
+        rot = (
+            (state.tick.astype(jnp.uint32) * jnp.uint32(2654435761))
+            % jnp.uint32(UR)
+        ).astype(i32)
+    _, idxc_l, valid_l = _compact(cand_v, k_exchange, UR, rot)
+    slot_w = jnp.where(valid_l, cand_slot_g[idxc_l], T_g)
+    packed = jnp.stack(
+        [
+            slot_w,
+            jnp.where(valid_l, cand_f[idxc_l], 0),
+            _bits(jnp.where(valid_l, cand_t[idxc_l], jnp.inf)),
+            _bits(jnp.where(valid_l, cand_m[idxc_l], 0.0)),
+        ],
+        axis=1,
+    )  # (K_ex, 4) i32 — ONE array around the ring per hop
+
+    full = ring_all_gather(packed, tp.axis_name, tp.n_shards)
+    idx = full[:, 0]  # global ids, sentinel T_g
+    valid = idx < T_g
+    fog_g = full[:, 1]
+    t_af_g = _floats(full[:, 2])
+    mips_g = _floats(full[:, 3])
+    user_g = jnp.clip(idx, 0, T_g - 1) // S  # global users
+    W = idx.shape[0]
+
+    # ---- reference assignment/queueing tail on the assembled window ---
+    fog_gc = jnp.clip(fog_g, 0, F - 1)
+    idle = fogs.current_task == NO_TASK
+    alive_g = fog_alive[fog_gc]
+    dead_dst = valid & ~alive_g
+    arr = valid & ~dead_dst
+
+    per_fog_arr = _per_fog(arr, fog_g, F)  # (F, W)
+    mips_sum = jnp.sum(jnp.where(per_fog_arr, mips_g[None, :], 0.0), axis=1)
+
+    plan = plan_arrivals(arr, fog_g, t_af_g, F, idle, per_fog=per_fog_arr)
+
+    a_pos = plan.assign_task
+    assigned = a_pos != NO_TASK
+    a_posc = jnp.clip(a_pos, 0, W - 1)
+    a_task = jnp.where(assigned, idx[a_posc], NO_TASK)  # global task id
+    a_taskc = jnp.clip(a_task, 0, T_g - 1)
+    # the assigned head's (arrival time, MIPS) ARE window columns (the
+    # same values the broker wrote this tick), one stacked gather
+    tm = jnp.stack([t_af_g, mips_g], axis=1)[a_posc]  # (F, 2)
+    taf_a, mips_a = tm[:, 0], tm[:, 1]
+    t_start = jnp.maximum(taf_a, fogs.free_since)
+    svc_a = _svc_time(spec, mips_a, fogs.mips)
+    d_fb = cache.d2b[U : U + F]
+    d_bu_a = tp.d2b_full[a_taskc // S]
+    t_ack5 = t_start + d_fb + d_bu_a
+
+    scat_a = _loc_idx(jnp.where(assigned, a_task, T_g), tp, T_loc)
+    tasks = tasks.replace(
+        t_service_start=tasks.t_service_start.at[scat_a].set(
+            jnp.where(assigned, t_start, 0), mode="drop"
+        ),
+    )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            t_ack5=tasks.t_ack5.at[scat_a].set(
+                jnp.where(assigned, t_ack5, 0), mode="drop"
+            ),
+        )
+    fogs = fogs.replace(
+        current_task=jnp.where(assigned, a_task, fogs.current_task),
+        busy_until=jnp.where(assigned, t_start + svc_a, fogs.busy_until),
+    )
+
+    # queue the rest (rank shifts by 1 where the head got assigned)
+    assigned_g = assigned[fog_gc]
+    a_task_g = a_task[fog_gc]
+    got_head = assigned_g & idle[fog_gc]
+    eff_rank = jnp.where(arr, plan.rank - got_head.astype(i32), -1)
+    to_queue = arr & (eff_rank >= 0) & (idx != a_task_g)
+    queue, q_len, enq_ok, dropped = batched_enqueue(
+        fogs.queue, fogs.q_head, fogs.q_len, to_queue, fog_g, eff_rank, idx
+    )
+    d_bu_q = tp.d2b_full[user_g]
+    d_fb_q = d_fb[fog_gc]
+    assigned_row = arr & (idx == a_task_g)
+    stage_k = jnp.where(
+        enq_ok,
+        _ST_QUEUED,
+        jnp.where(
+            (to_queue & ~enq_ok) | dead_dst,
+            _ST_DROPPED,
+            jnp.where(assigned_row, _ST_RUNNING, _ST_TASK_INFLIGHT),
+        ),
+    )
+    idx_loc = _loc_idx(idx, tp, T_loc)
+    tasks = tasks.replace(
+        stage=tasks.stage.at[idx_loc].set(stage_k, mode="drop"),
+        t_q_enter=tasks.t_q_enter.at[idx_loc].set(
+            jnp.where(enq_ok, t_af_g, jnp.inf), mode="drop"
+        ),
+    )
+    if not spec.derive_acks:
+        tasks = tasks.replace(
+            t_ack4_queued=tasks.t_ack4_queued.at[idx_loc].set(
+                jnp.where(enq_ok, t_af_g + d_fb_q + d_bu_q, jnp.inf),
+                mode="drop",
+            ),
+        )
+    acked = (assigned_g & (idx == a_task_g)) | enq_ok
+    sums = jnp.sum(
+        jnp.stack([to_queue & ~enq_ok, dead_dst, acked]).astype(i32), axis=1
+    )
+    arr_per_fog = jnp.sum(per_fog_arr, axis=1, dtype=i32) + n_fast_f
+    add_busy = mips_sum / jnp.maximum(fogs.mips, 1e-9)
+    fogs = fogs.replace(
+        queue=queue,
+        q_len=q_len,
+        q_drops=fogs.q_drops + dropped,
+        busy_time=fogs.busy_time + add_busy,
+    )
+    m_rep = m_rep.replace(
+        n_dropped=m_rep.n_dropped + sums[0] + sums[1] + n_fast
+    )
+    buf_r = buf_r._replace(
+        tx_f=buf_r.tx_f + arr_per_fog,
+        rx_f=buf_r.rx_f + arr_per_fog,
+        tx_b=buf_r.tx_b + sums[2],
+        rx_b=buf_r.rx_b + sums[2],
+    )
+    u_loc = user_g - tp.u_off
+    u_ok = (u_loc >= 0) & (u_loc < U)
+    buf_p = buf_p._replace(
+        rx_u=buf_p.rx_u.at[jnp.where(u_ok, u_loc, U)].add(
+            (acked & u_ok).astype(i32), mode="drop"
+        )
+    )
+    state = state.replace(tasks=tasks, fogs=fogs)
+    return state, buf_p, buf_r, m_part, m_rep
+
+
+# ----------------------------------------------------------------------
+# the sharded tick + runner
+# ----------------------------------------------------------------------
+
+def _zero_metrics(m: Metrics) -> Metrics:
+    return jax.tree.map(jnp.zeros_like, m)
+
+
+def _zero_buf(U: int, F: int) -> TickBuf:
+    i32 = jnp.int32
+    return TickBuf(
+        tx_u=jnp.zeros((U,), i32),
+        rx_u=jnp.zeros((U,), i32),
+        tx_f=jnp.zeros((F,), i32),
+        rx_f=jnp.zeros((F,), i32),
+        tx_b=jnp.zeros((), i32),
+        rx_b=jnp.zeros((), i32),
+    )
+
+
+def _tp_tick(
+    spec: WorldSpec, tp: TpCtx, state: WorldState, net: NetParams,
+    cache: LinkCache, k_exchange: int,
+) -> WorldState:
+    """One sharded tick over the LOCAL world view.
+
+    Phase order mirrors ``engine.make_step`` for the TP-admitted family
+    (dense broker, FIFO fogs, static topology): connect -> adverts ->
+    spawn -> dense decide -> completions xN -> arrivals -> counters ->
+    telemetry.  Every shard-partial counter rides ONE end-of-tick psum.
+    """
+    t0 = state.tick.astype(jnp.float32) * spec.dt
+    t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
+    i32 = jnp.int32
+    U, F = spec.n_users, spec.n_fogs
+
+    m_carry = state.metrics
+    m_rep = _zero_metrics(m_carry)
+    buf_p = _zero_buf(U, F)
+    buf_r = _zero_buf(U, F)
+    state = state.replace(metrics=_zero_metrics(m_carry))  # partial acc
+
+    # 1-2. static world: the hoisted cache stands in for mobility +
+    # association (spec.assume_static is part of the TP gate)
+
+    # 3. connect handshake (user-partial counters; replicated broker regs)
+    if spec.connect_gating:
+        with jax.named_scope("phase_connect"):
+            state, buf_p = _phase_connect(
+                spec, state, net, cache, buf_p, t0, t1
+            )
+    # 4. advert delivery — its counter is an F-sum, identical on every
+    # shard: route it to the REPLICATED accumulator
+    m_part = state.metrics
+    state = state.replace(metrics=m_rep)
+    with jax.named_scope("phase_adverts"):
+        state = _phase_adverts(state, t1)
+    m_rep, state = state.metrics, state.replace(metrics=m_part)
+    if spec.adv_periodic:
+        with jax.named_scope("phase_adverts"):
+            state = _phase_periodic_adverts(spec, state, net, cache, t0, t1)
+
+    # 5. spawn (full-width PRNG draws sliced per shard — engine._tp_user_draw)
+    with jax.named_scope("phase_spawn"):
+        if spec.max_sends_per_tick > 1:
+            state, buf_p = _phase_spawn_multi(
+                spec, state, net, cache, buf_p, t0, t1, tp=tp
+            )
+        else:
+            state, buf_p = _phase_spawn(
+                spec, state, net, cache, buf_p, t0, t1, tp=tp
+            )
+
+    # 6. dense broker decide (replicated scalar winner; one psum for the
+    # global fan-out counts)
+    with jax.named_scope("phase_broker"):
+        state, buf_p = _phase_broker_dense(
+            spec, state, net, cache, buf_p, t1, tp=tp
+        )
+    m_part = state.metrics
+
+    # 7. fog completions + arrivals (replicated fog state)
+    for _ in range(spec.completions_per_tick):
+        with jax.named_scope("phase_completions"):
+            state, buf_p, buf_r, m_rep = _tp_completions(
+                spec, tp, state, cache, buf_p, buf_r, m_rep, t1
+            )
+    with jax.named_scope("phase_fog_arrivals"):
+        state, buf_p, buf_r, m_part, m_rep = _tp_fog_arrivals(
+            spec, tp, state, cache, buf_p, buf_r, m_part, m_rep, t1,
+            k_exchange,
+        )
+
+    # 8. THE end-of-tick combine: every shard-partial scalar in one psum
+    part_vec = jnp.stack(
+        [getattr(m_part, f) for f in _METRIC_FIELDS]
+        + [buf_p.tx_b, buf_p.rx_b]
+    )
+    tot = jax.lax.psum(part_vec, tp.axis_name)
+    delta = {
+        f: tot[i] + getattr(m_rep, f)
+        for i, f in enumerate(_METRIC_FIELDS)
+    }
+    n_def = delta["n_deferred"]
+    vals = {
+        f: getattr(m_carry, f) + delta[f]
+        for f in _METRIC_FIELDS
+        if f not in ("n_deferred", "n_deferred_max")
+    }
+    vals["n_deferred"] = n_def  # per-tick gauge (reference resets it)
+    vals["n_deferred_max"] = jnp.maximum(m_carry.n_deferred_max, n_def)
+    metrics = Metrics(**vals)
+    tx_b = tot[len(_METRIC_FIELDS)] + buf_r.tx_b
+    rx_b = tot[len(_METRIC_FIELDS) + 1] + buf_r.rx_b
+
+    # per-node message counters: user segment shard-local, the rest
+    # replicated totals (identical on every shard by construction)
+    n_rest_q = spec.n_aps + spec.n_routers
+    rest_zeros = jnp.zeros((n_rest_q,), i32)
+    tx_all = jnp.concatenate(
+        [buf_p.tx_u, buf_r.tx_f, tx_b[None], rest_zeros]
+    )
+    rx_all = jnp.concatenate(
+        [buf_p.rx_u, buf_r.rx_f, rx_b[None], rest_zeros]
+    )
+    nodes2 = state.nodes.replace(
+        tx_count=state.nodes.tx_count + tx_all,
+        rx_count=state.nodes.rx_count + rx_all,
+    )
+    if spec.n_aps > 0:
+        a0, a1 = spec.ap_slice
+        nodes2 = nodes2.replace(
+            assoc_sum=nodes2.assoc_sum.at[a0:a1].add(cache.n_assoc)
+        )
+    state = state.replace(nodes=nodes2, metrics=metrics)
+
+    if spec.telemetry:
+        # plane-1 gauges on the replicated fog state + psum'd totals.
+        # Per-phase work attribution needs the eager per-phase counter
+        # brackets the partial/replicated split removed — phase_work
+        # rows stay zero under TP (documented in the README TP section).
+        from ..telemetry.metrics import accumulate_tick
+
+        with jax.named_scope("phase_telemetry"):
+            state = state.replace(
+                telem=accumulate_tick(
+                    spec, state.telem, state.fogs, state.learn,
+                    state.metrics, state.tick, t1, None,
+                )
+            )
+
+    return state.replace(t=t1, tick=state.tick + 1)
+
+
+@functools.lru_cache(maxsize=32)
+def _tp_program(
+    spec: WorldSpec, n_ticks: int, mesh: Mesh, axis_name: str,
+    k_exchange: int, donate: bool,
+):
+    """Build (and cache) the jitted sharded-horizon program for ``spec``."""
+    n = mesh.shape[axis_name]
+    U_g, S = spec.n_users, spec.max_sends_per_user
+    U_loc = U_g // n
+    T_loc = U_loc * S
+    spec_l = dataclasses.replace(spec, n_users=U_loc)
+
+    def body(users, tasks, nodes_u, rep, net, cache):
+        shard = jax.lax.axis_index(axis_name)
+        u_off = shard * U_loc
+        tp = TpCtx(
+            axis_name=axis_name,
+            n_shards=n,
+            shard=shard,
+            n_users_global=U_g,
+            u_off=u_off,
+            t_off=u_off * S,
+            d2b_full=cache.d2b,
+        )
+
+        def cut(x):
+            return jnp.concatenate(
+                [
+                    jax.lax.dynamic_slice_in_dim(x, u_off, U_loc, axis=0),
+                    x[U_g:],
+                ],
+                axis=0,
+            )
+
+        cache_l = cache.replace(
+            assoc=cut(cache.assoc),
+            attach_now=cut(cache.attach_now),
+            acc_delay=cut(cache.acc_delay),
+            reachable=cut(cache.reachable),
+            d2b=cut(cache.d2b),
+            mac_loss_p=cut(cache.mac_loss_p),
+        )
+        net_l = net.replace(
+            node_attach=cut(net.node_attach),
+            node_acc=cut(net.node_acc),
+            is_wireless=cut(net.is_wireless),
+        )
+        nodes_l = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=0),
+            nodes_u, rep["nodes_rest"],
+        )
+        state_l = WorldState(
+            t=rep["t"], tick=rep["tick"], key=rep["key"],
+            nodes=nodes_l, users=users, fogs=rep["fogs"],
+            broker=rep["broker"], tasks=tasks, metrics=rep["metrics"],
+            learn=rep["learn"], telem=rep["telem"],
+        )
+
+        def tick(st, _):
+            return _tp_tick(spec_l, tp, st, net_l, cache_l, k_exchange), None
+
+        final, _ = jax.lax.scan(tick, state_l, None, length=n_ticks)
+        if spec.derive_acks:
+            final = _finalize_derived_acks(spec_l, final, cache_l)
+        rep_out = {
+            "t": final.t, "tick": final.tick, "key": final.key,
+            "fogs": final.fogs, "broker": final.broker,
+            "metrics": final.metrics, "learn": final.learn,
+            "telem": final.telem,
+            "nodes_rest": jax.tree.map(lambda x: x[U_loc:], final.nodes),
+        }
+        nodes_u_out = jax.tree.map(lambda x: x[:U_loc], final.nodes)
+        return final.users, final.tasks, nodes_u_out, rep_out
+
+    shmapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name), P(), P(), P()),
+        out_specs=(P(axis_name), P(axis_name), P(axis_name), P()),
+        check_vma=False,  # outputs mix sharded task rows and replicated
+        #                   fog/broker state; the fog-side replication
+        #                   invariant is by construction (every shard
+        #                   runs the identical tail on the identical
+        #                   exchanged window), not statically provable
+    )
+
+    # donation covers the SHARDED trees only — the memory that scales
+    # with world size (task/user/user-node rows, T/n per device).  The
+    # replicated tree is KBs of fog/broker state whose donation saves
+    # nothing and whose builder-aliased zero/full leaves (smoke seeds
+    # pool_avail with the mips array itself) XLA's allocation-level
+    # donation tracking rejects even after pointer-level dealiasing.
+    @functools.partial(
+        jax.jit, donate_argnums=(0,) if donate else ()
+    )
+    def go(sharded, rep, net, cache):
+        users, tasks, nodes_u = sharded
+        return shmapped(users, tasks, nodes_u, rep, net, cache)
+
+    return go
+
+
+def run_tp_sharded(
+    spec: WorldSpec,
+    state: WorldState,
+    net: NetParams,
+    bounds: Optional[MobilityBounds] = None,
+    mesh: Optional[Mesh] = None,
+    n_ticks: Optional[int] = None,
+    axis_name: str = NODE_AXIS,
+    exchange_window: Optional[int] = None,
+    donate: bool = False,
+    pad: bool = True,
+) -> Tuple[WorldSpec, WorldState]:
+    """Advance ONE world whose user/task axis spans the mesh.
+
+    The explicit shard_map TP tick (module docstring); requires a
+    TP-admissible spec (:func:`engine.tp_ok` — a one-line ``ValueError``
+    otherwise).  Returns ``(spec, final_state)``: the spec comes back
+    because ``pad=True`` (default) pads a non-divisible population with
+    inert users (:func:`pad_users_to_multiple`) and the padded spec
+    describes the returned state.  Task/user outputs stay row-sharded
+    on the mesh, so chained calls never gather the table.
+
+    ``exchange_window`` bounds the per-shard arrival candidates
+    exchanged per tick (default: the full per-shard candidate list —
+    never defers, bit-exact vs the single-device engine); smaller
+    windows defer overflow arrivals a tick, visible in
+    ``Metrics.n_deferred`` exactly like the engine's K-window.
+
+    ``donate=True`` donates the (sharded) input state's buffers to the
+    run — the memory discipline of ``run_jit`` (simlint R6); do not
+    reuse ``state`` after calling.  Bit-exactness is independent of
+    donation (tests/test_tp.py).
+    """
+    del bounds  # static worlds only (tp gate): mobility never runs
+    go, parts, net_r, cache_r, spec = _tp_setup(
+        spec, state, net, mesh, n_ticks, axis_name, exchange_window,
+        donate, pad,
+    )
+    users, tasks, nodes_u_f, rep = go(*parts, net_r, cache_r)
+    nodes = jax.tree.map(
+        lambda a, b: jnp.concatenate([a, b], axis=0),
+        nodes_u_f, rep["nodes_rest"],
+    )
+    final = WorldState(
+        t=rep["t"], tick=rep["tick"], key=rep["key"], nodes=nodes,
+        users=users, fogs=rep["fogs"], broker=rep["broker"], tasks=tasks,
+        metrics=rep["metrics"], learn=rep["learn"], telem=rep["telem"],
+    )
+    return spec, final
+
+
+def _tp_setup(
+    spec: WorldSpec,
+    state: WorldState,
+    net: NetParams,
+    mesh: Mesh,
+    n_ticks: Optional[int],
+    axis_name: str,
+    exchange_window: Optional[int],
+    donate: bool,
+    pad: bool,
+):
+    """Shared front half of :func:`run_tp_sharded`: gate, pad, place,
+    build the jitted program.  ``tools/hloaudit``/``tools/op_budget``
+    call this too and ``.lower(...).compile()`` the returned program —
+    so the audited artifact IS the production program, never a twin.
+    """
+    spec.validate()
+    reason = tp_reject_reason(spec)
+    if reason is not None:
+        raise ValueError(f"run_tp_sharded: {reason}")
+    if mesh is None:
+        raise ValueError("run_tp_sharded needs a Mesh (parallel.make_mesh)")
+    if net.mac_loss_tab.shape[0] > 0:
+        raise ValueError(_STATIC_MAC_ERR)
+    n = mesh.shape[axis_name]
+    if spec.n_users % n:
+        if not pad:
+            raise ValueError(
+                f"the {n}-device mesh axis must divide n_users "
+                f"({spec.n_users}) — pad_users_to_multiple(spec, state, "
+                "net, n) pads with inert users (pad=True does it for you)"
+            )
+        spec, state, net = pad_users_to_multiple(spec, state, net, n)
+    U_loc = spec.n_users // n
+    R = min(spec.arrival_cands, spec.max_sends_per_user)
+    cap = U_loc * R
+    k_ex = cap if exchange_window is None else max(1, min(exchange_window, cap))
+    ticks = spec.n_ticks if n_ticks is None else n_ticks
+
+    # the run-constant association/delay cache (assume_static is part of
+    # the TP gate), computed once OUTSIDE the audited sharded program
+    cache = associate(
+        net, state.nodes.pos, state.nodes.alive, broker=spec.broker_index
+    )
+
+    leaf = replica_sharding(mesh, axis_name)  # leading-axis row sharding
+    repl = NamedSharding(mesh, P())
+
+    def rows(tree):
+        return jax.tree.map(lambda x: jax.device_put(x, leaf(x)), tree)
+
+    def replicated(tree):
+        return jax.tree.map(lambda x: jax.device_put(x, repl), tree)
+
+    nodes_u = jax.tree.map(lambda x: x[: spec.n_users], state.nodes)
+    nodes_rest = jax.tree.map(lambda x: x[spec.n_users :], state.nodes)
+    sharded = (
+        rows(state.users),
+        rows(state.tasks),
+        rows(nodes_u),
+    )
+    rep = replicated(
+        {
+            "t": state.t, "tick": state.tick, "key": state.key,
+            "fogs": state.fogs, "broker": state.broker,
+            "metrics": state.metrics, "learn": state.learn,
+            "telem": state.telem, "nodes_rest": nodes_rest,
+        }
+    )
+    net_r = replicated(net)
+    cache_r = replicated(cache)
+    if donate:
+        from ..core.engine import _dealias_for_donation
+
+        sharded = _dealias_for_donation(sharded)
+    go = _tp_program(spec, ticks, mesh, axis_name, k_ex, donate)
+    return go, (sharded, rep), net_r, cache_r, spec
+
+
+# ----------------------------------------------------------------------
+# GSPMD fallback (the original capacity path) + dispatch
+# ----------------------------------------------------------------------
 
 def shard_state_by_node(
     spec: WorldSpec, state: WorldState, mesh: Mesh,
@@ -51,7 +1087,8 @@ def shard_state_by_node(
         raise ValueError(
             f"the {n}-device mesh axis must divide n_users "
             f"({spec.n_users}) and task capacity ({spec.task_capacity}) — "
-            "pad users/max_sends_per_user to a multiple"
+            "pad_users_to_multiple(spec, state, net, n) pads with inert "
+            "users"
         )
     leaf = replica_sharding(mesh, axis_name)  # leading-axis row sharding
     repl = NamedSharding(mesh, P())
@@ -95,9 +1132,21 @@ def run_node_sharded(
 ) -> WorldState:
     """Advance a node-sharded world over the horizon.
 
-    The jitted program is cached on (spec, n_ticks) — repeat/chained calls
-    trace once — and GSPMD propagates the input shardings to the outputs,
-    so the table never gathers onto one device between calls.
+    Dispatch: TP-admissible specs (:func:`engine.tp_ok`) take the
+    explicit shard_map tick (:func:`run_tp_sharded` — hand-placed
+    collectives, audited and budgeted in CI); everything else keeps the
+    GSPMD fallback, where the *unmodified* engine step runs under XLA's
+    SPMD partitioner and GSPMD inserts the collectives (correct for
+    every engine world, communication chosen by the compiler).  Both
+    paths are bit-identical to the single-device engine (tested), and
+    input shardings propagate to the outputs, so chained calls keep the
+    table distributed.
     """
+    if tp_ok(spec):
+        _, final = run_tp_sharded(
+            spec, state, net, bounds, mesh, n_ticks=n_ticks,
+            axis_name=axis_name, pad=False,
+        )
+        return final
     state = shard_state_by_node(spec, state, mesh, axis_name)
     return _advance(spec, n_ticks, state, net, bounds)

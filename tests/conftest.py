@@ -77,6 +77,11 @@ _QUICK_FILES = {
     # milliseconds; the live tier compiles one tick + the TP dryrun —
     # the same correctness rail the TP-sharding promotion runs on
     "test_hloaudit.py",
+    # TP sharded tick (ISSUE 9): the shard_map'd million-user capacity
+    # path's state-hash A/B vs the single-device reference on the
+    # 8-virtual-device mesh + the ring-exchange units — the same
+    # tier-1 contract as the fleet runner's equivalence gate
+    "test_tp.py",
 }
 
 

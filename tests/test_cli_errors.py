@@ -112,3 +112,41 @@ def test_sweep_accepts_policy_names(capsys):
     assert rc == 0
     assert '"policy": 0' in captured.out
     assert '"policy": 4' in captured.out
+
+
+def test_tp_conflicts_with_replicas(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tp", "8", "--replicas", "8"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "--tp" in err and "--replicas" in err
+
+
+def test_tp_conflicts_with_serve(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tp", "8", "--serve", "0"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "--serve" in err
+
+
+def test_tp_outside_policy_family_is_clear_error(capsys):
+    """--tp composes with --policy; a policy outside the dense-broker
+    TP family is a one-line error, not a traceback."""
+    rc = main(["--scenario", "smoke", "--tp", "8", "--policy", "ucb",
+               "--set", "scenario.horizon=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "dense-broker" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_tp_with_hist_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--tp", "8", "--hist",
+               "--set", "scenario.horizon=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "histogram" in captured.err
+    assert "Traceback" not in captured.err

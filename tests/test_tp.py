@@ -1,0 +1,252 @@
+"""TP sharded-tick gates (ISSUE 9, ``parallel/taskshard.run_tp_sharded``).
+
+The acceptance contract of the million-user capacity path: the explicit
+``shard_map``'d TP tick — per-user/per-task rows sharded over the
+8-virtual-device ``node`` mesh, hand-placed broker↔fog collectives, ring
+arrival exchange — must be BIT-EXACT vs the single-device reference
+engine (state-hash A/B over the dense-broker policy-family worlds,
+against ``run`` / ``run_jit`` / ``run_chunked``), with padding, chaining
+and the exchange-window deferral contract each pinned separately.  The
+ring exchange itself is unit-tested against a dense reference,
+including the opt-in Pallas remote-DMA kernel in interpret mode.
+
+Compile budget: every TP call here donates its carry (``donate=True``),
+so the A/B doubles as the donated-carry bit-exactness gate AND the
+worlds sharing a spec share one cached program (the padding test's
+padded spec IS the MIN_BUSY world's spec).  The ``donate=False`` path
+is covered by ``test_parallel.py``'s ``run_node_sharded`` dispatch.
+"""
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, run
+from fognetsimpp_tpu.core.engine import run_chunked, run_jit, tp_ok
+from fognetsimpp_tpu.parallel import (
+    make_mesh,
+    pad_users_to_multiple,
+    ring_all_gather,
+    run_tp_sharded,
+)
+from fognetsimpp_tpu.parallel.tp import shard_map
+from fognetsimpp_tpu.scenarios import smoke
+from jax.sharding import PartitionSpec as P
+
+SMALL = dict(
+    n_users=16, n_fogs=3, send_interval=0.01, horizon=0.2,
+    start_time_max=0.05,
+)
+
+#: The three dense-broker policy-family worlds the TP tick admits: the
+#: faithful mips0-divisor argmin family (MIN_BUSY, MIN_LATENCY) and the
+#: v1/v2 MAX_MIPS scan.
+WORLDS = [
+    dict(policy=int(Policy.MIN_BUSY)),
+    # jitter exercises the full-width-draw-and-slice k_jit stream
+    dict(policy=int(Policy.MIN_LATENCY), send_interval_jitter=0.1),
+    dict(policy=int(Policy.MAX_MIPS)),
+]
+
+
+def _hash(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+def _tp(spec, state, net, bounds, mesh, **kw):
+    """All TP calls donate a copy: the run_jit memory discipline, and
+    one cached program per (spec, ticks) across the module."""
+    kw.setdefault("donate", True)
+    return run_tp_sharded(
+        spec, jax.tree.map(jnp.copy, state), net, bounds, mesh, **kw
+    )
+
+
+@pytest.fixture(scope="module")
+def node_mesh():
+    assert len(jax.devices()) == 8, "conftest must provision 8 devices"
+    return make_mesh(8, axis_name="node")
+
+
+def test_tp_gate_is_pinned():
+    """The static TP family: dense-broker FIFO no-window static worlds."""
+    on = _build()[0]
+    assert tp_ok(on)
+    assert tp_ok(_build(policy=int(Policy.MAX_MIPS))[0])
+    assert not tp_ok(_build(policy=int(Policy.ROUND_ROBIN))[0])
+    assert not tp_ok(_build(policy=int(Policy.UCB))[0])
+    assert not tp_ok(
+        _build(policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0)[0]
+    )
+    assert not tp_ok(dataclasses.replace(on, arrival_window=8))
+    assert not tp_ok(dataclasses.replace(on, two_stage_arrivals=False))
+    assert not tp_ok(dataclasses.replace(on, assume_static=False))
+    assert not tp_ok(
+        dataclasses.replace(on, telemetry=True, telemetry_hist=True)
+    )
+    # plain telemetry composes (gauges + counters; phase_work stays 0)
+    assert tp_ok(dataclasses.replace(on, telemetry=True))
+
+
+def test_tp_bitexact_vs_reference(node_mesh):
+    """State-hash A/B over the three policy-family worlds, with the
+    TP carry donated (bit-exactness is donation-independent)."""
+    for kw in WORLDS:
+        spec, state, net, bounds = _build(**kw)
+        ref, _ = run(spec, state, net, bounds)
+        spec2, got = _tp(spec, state, net, bounds, node_mesh)
+        assert spec2 == spec
+        assert _hash(ref) == _hash(got), kw
+        # the table really is distributed over the mesh
+        assert len(got.tasks.stage.sharding.device_set) == 8
+        assert int(np.asarray(got.metrics.n_scheduled)) > 0
+
+
+@pytest.mark.slow  # adds run_jit/run_chunked compiles + a half-horizon
+#   TP program: full-suite tier (the quick tier keeps the 3-world A/B)
+def test_tp_bitexact_vs_jit_and_chunked(node_mesh):
+    """The sharded tick also matches the donated run_jit and the
+    chunked runner (the same carry either way), and a chained pair of
+    half-horizon TP calls matches one full-horizon TP run."""
+    spec, state, net, bounds = _build()
+    _, got = _tp(spec, state, net, bounds, node_mesh)
+    jit_ref = run_jit(spec, jax.tree.map(jnp.copy, state), net, bounds)
+    assert _hash(jit_ref) == _hash(got)
+    chunk_ref = run_chunked(
+        spec, jax.tree.map(jnp.copy, state), net, bounds,
+        chunk_ticks=spec.n_ticks // 2,
+    )
+    assert _hash(chunk_ref) == _hash(got)
+    n = spec.n_ticks
+    assert n % 2 == 0  # both halves share one compiled program
+    _, half = _tp(spec, state, net, bounds, node_mesh, n_ticks=n // 2)
+    _, full = _tp(spec, half, net, bounds, node_mesh, n_ticks=n // 2)
+    assert _hash(full) == _hash(got)
+
+
+def test_pad_users_to_multiple_inert(node_mesh):
+    """A non-divisible population pads with INERT users: the padded
+    world bit-matches the single-device reference at the padded spec
+    (which here IS the MIN_BUSY world's spec — one shared program), and
+    the ghost rows never leave Stage.UNUSED."""
+    spec, state, net, bounds = _build(n_users=13)
+    spec_p, state_p, net_p = pad_users_to_multiple(spec, state, net, 8)
+    assert spec_p.n_users == 16
+    ref, _ = run(spec_p, state_p, net_p, bounds)
+    spec2, got = _tp(spec, state, net, bounds, node_mesh)
+    assert spec2 == spec_p
+    assert _hash(ref) == _hash(got)
+    S = spec_p.max_sends_per_user
+    st = np.asarray(got.tasks.stage).reshape(16, S)
+    assert (st[13:] == 0).all()  # ghosts stay UNUSED
+    assert not np.asarray(got.users.connected)[13:].any()
+    # real users published; ghosts never did
+    assert (np.asarray(got.users.send_count)[:13] > 0).any()
+    assert (np.asarray(got.users.send_count)[13:] == 0).all()
+    # pad=False keeps the hard error (the GSPMD-era contract)
+    with pytest.raises(ValueError, match="divide"):
+        run_tp_sharded(spec, state, net, bounds, node_mesh, pad=False)
+
+
+def test_tp_rejects_outside_family(node_mesh):
+    spec, state, net, bounds = _build(policy=int(Policy.ROUND_ROBIN))
+    with pytest.raises(ValueError, match="dense-broker"):
+        run_tp_sharded(spec, state, net, bounds, node_mesh)
+
+
+@pytest.mark.slow  # its own (coarse-dt) program: full-suite tier
+def test_tp_multi_send_coarse_dt_bitexact(node_mesh):
+    """dt > send_interval: the closed-form multi-send spawn's (U, R)
+    draw lanes slice per shard bit-exactly (the windowed bench shape)."""
+    spec, state, net, bounds = _build(dt=0.02, max_sends_per_tick=3)
+    ref, _ = run(spec, state, net, bounds)
+    _, got = _tp(spec, state, net, bounds, node_mesh)
+    assert _hash(ref) == _hash(got)
+
+
+@pytest.mark.slow  # its own (spec, window) program: full-suite tier
+def test_exchange_window_defers_not_drops(node_mesh):
+    """A starved exchange window defers arrivals (the engine's K-window
+    contract): decisions land later ticks, nothing is lost, and the
+    backlog gauge shows it."""
+    spec, state, net, bounds = _build(start_time_max=0.0, horizon=0.15)
+    ref, _ = run(spec, state, net, bounds)
+    _, got = _tp(
+        spec, state, net, bounds, node_mesh, exchange_window=1
+    )
+    assert int(np.asarray(got.metrics.n_deferred_max)) > 0
+    # every publish still got decided and completed like the reference
+    assert int(np.asarray(got.metrics.n_scheduled)) == int(
+        np.asarray(ref.metrics.n_scheduled)
+    )
+    assert int(np.asarray(got.metrics.n_completed)) == int(
+        np.asarray(ref.metrics.n_completed)
+    )
+
+
+@pytest.mark.slow  # its own (telemetry) spec/program: full-suite tier
+def test_telemetry_composes_except_phase_work(node_mesh):
+    """--tp --telemetry: gauges, reservoir and counters are bit-equal to
+    the single-device telemetry run; only the per-phase work attribution
+    stays zero (the documented TP limitation)."""
+    spec, state, net, bounds = _build(telemetry=True, horizon=0.15)
+    ref, _ = run(spec, state, net, bounds)
+    _, got = _tp(spec, state, net, bounds, node_mesh)
+    for f in dataclasses.fields(ref.telem):
+        a = np.asarray(getattr(ref.telem, f.name))
+        b = np.asarray(getattr(got.telem, f.name))
+        if f.name == "phase_work":
+            assert (b == 0).all()
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=f.name)
+    assert _hash(ref.replace(telem=got.telem)) == _hash(got)
+
+
+def test_ring_exchange_matches_dense_reference(node_mesh):
+    """ring_all_gather (ppermute ring) == the dense concatenation, for
+    every shard, in global shard order."""
+    n, K, C = 8, 6, 4
+    x = jnp.arange(n * K * C, dtype=jnp.int32).reshape(n * K, C)
+
+    f = jax.jit(
+        shard_map(
+            lambda b: ring_all_gather(b, "node", n),
+            mesh=node_mesh,
+            in_specs=P("node"),
+            out_specs=P(None),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_array_equal(np.asarray(f(x)), np.asarray(x))
+
+
+def test_ring_exchange_pallas_interpret_exact(node_mesh):
+    """The opt-in Pallas remote-DMA ring kernel (SNIPPETS [2]) is exact
+    in interpret mode on the CPU mesh — same contract as the ppermute
+    ring it replaces."""
+    from fognetsimpp_tpu.ops.pallas_kernels import ring_all_gather_pallas
+
+    n, K, C = 8, 4, 4
+    x = jnp.arange(n * K * C, dtype=jnp.int32).reshape(n * K, C)
+    f = jax.jit(
+        shard_map(
+            lambda b: ring_all_gather_pallas(b, "node", n, interpret=True),
+            mesh=node_mesh,
+            in_specs=P("node"),
+            out_specs=P(None),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_array_equal(np.asarray(f(x)), np.asarray(x))

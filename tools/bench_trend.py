@@ -37,7 +37,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 #: round that did not record dt is never silently compared to a new one.
 SHAPE_FIELDS = (
     "metric", "backend", "n_users", "n_fogs", "dt", "arrival_window",
-    "policy", "n_devices", "n_replicas",
+    "policy", "n_devices", "n_replicas", "tp_shards",
 )
 
 #: Shape values a capture that predates the field is known to have run
@@ -46,7 +46,14 @@ SHAPE_FIELDS = (
 #: (min_busy) — without this backfill the first policy-recording
 #: capture would form a fresh one-entry trajectory and the regression
 #: gate would silently stop comparing against all prior history.
-SHAPE_DEFAULTS = {"policy": "min_busy"}
+SHAPE_DEFAULTS = {
+    "policy": "min_busy",
+    # TP task-table sharding arrived in r6 (ISSUE 9): every prior
+    # capture ran unsharded single worlds or replica fleets — backfill
+    # None so the r6 TP captures form their own trajectory and the
+    # replica-fleet/single-chip histories keep comparing like-for-like.
+    "tp_shards": None,
+}
 
 
 def _round_of(path: str) -> Optional[int]:
@@ -96,7 +103,8 @@ def load_rounds(root: str = ".") -> List[Dict]:
 def _shape_str(shape: Tuple) -> str:
     d = dict(shape)
     bits = [str(d.get("metric") or "?"), str(d.get("backend") or "?")]
-    for k in ("n_users", "n_fogs", "dt", "arrival_window", "n_devices"):
+    for k in ("n_users", "n_fogs", "dt", "arrival_window", "n_devices",
+              "tp_shards"):
         if d.get(k) is not None:
             bits.append(f"{k}={d[k]}")
     return " ".join(bits)

@@ -38,6 +38,11 @@ _FLEET = dict(n_users=64, n_fogs=8, horizon=0.02, send_interval=2.5e-3,
 _FLEET_TICKS = 4
 _TP_FOGS = 16
 _TP_TASKS = 32
+#: Shrunk TP sharded-tick shape (divisible over the 8-device mesh).
+_TP_TICK = dict(n_users=16, n_fogs=4, horizon=0.02, send_interval=2.5e-3,
+                dt=1e-3, max_sends_per_user=8, start_time_max=0.01,
+                queue_capacity=8)
+_TP_TICK_TICKS = 2
 
 
 def ensure_devices() -> None:
@@ -123,6 +128,24 @@ def _compile_tp():
     return compiled.as_text(), None
 
 
+def _compile_tp_tick():
+    """Compile the shard_map'd TP sharded tick (the ISSUE 9 production
+    path) through taskshard's OWN program builder — the audited
+    artifact is the program ``run_tp_sharded`` executes, never a twin."""
+    from fognetsimpp_tpu.parallel.mesh import make_mesh
+    from fognetsimpp_tpu.parallel.taskshard import NODE_AXIS, _tp_setup
+    from fognetsimpp_tpu.scenarios import smoke
+
+    spec, state, net, bounds = smoke.build(**_TP_TICK)
+    mesh = make_mesh(_N_DEVICES, axis_name=NODE_AXIS)
+    go, parts, net_r, cache_r, spec = _tp_setup(
+        spec, state, net, mesh, _TP_TICK_TICKS, NODE_AXIS,
+        None, False, False,
+    )
+    compiled = go.lower(*parts, net_r, cache_r).compile()
+    return compiled.as_text(), spec
+
+
 def _fleet_declared() -> Dict[str, Set[str]]:
     from fognetsimpp_tpu.parallel.fleet import DECLARED_COLLECTIVES
 
@@ -175,6 +198,15 @@ def variants() -> List[Variant]:
             sharded=True,
             declared_collectives=None,  # resolved lazily from tp.py
         ),
+        Variant(
+            "tp_tick",
+            "shard_map'd TP sharded tick on the 8-device node mesh "
+            "(parallel/taskshard.run_tp_sharded: psum combines + ring "
+            "arrival exchange)",
+            _compile_tp_tick,
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from taskshard.py
+        ),
     ]
 
 
@@ -187,4 +219,10 @@ def declared_for(v: Variant) -> Optional[Dict[str, Set[str]]]:
         return _fleet_declared()
     if v.name == "tp_dryrun":
         return _tp_declared()
+    if v.name == "tp_tick":
+        from fognetsimpp_tpu.parallel.taskshard import (
+            DECLARED_COLLECTIVES as tp_tick_declared,
+        )
+
+        return tp_tick_declared
     return None

@@ -103,9 +103,60 @@ def compile_tick_counts(fused: bool) -> dict:
     return entry_op_counts(compiled.as_text())
 
 
-def measure() -> dict:
+def compile_tp_counts() -> dict:
+    """Compile the shard_map'd TP sharded tick and count its HLO ops +
+    collectives (ISSUE 9).
+
+    The program is a 2-tick ``lax.scan``, which lowers to a while loop
+    whose body is counted ONCE — so the collective tally is the
+    PER-TICK collective count, pinned EXACTLY by ``--check`` (a new
+    collective in the sharded tick must arrive together with its
+    ``DECLARED_COLLECTIVES`` entry and a reviewed budget regeneration;
+    hloaudit A3 checks the kinds, this pins the count).
+    """
+    from tools.hloaudit.hlo import (
+        COLLECTIVE_OPS,
+        base_collective,
+        parse_hlo,
+    )
+    from tools.hloaudit.variants import _compile_tp_tick
+
+    text, _spec = _compile_tp_tick()
+    mod = parse_hlo(text)
+    counts = mod.entry_op_counts()
+    colls: dict = {}
+    for i in mod.all_instructions():
+        op = base_collective(i.opcode)
+        if op in COLLECTIVE_OPS and not i.opcode.endswith("-done"):
+            colls[op] = colls.get(op, 0) + 1
+    return {
+        "ops": counts["ops"],
+        "fusions": counts["fusions"],
+        "collectives": dict(sorted(colls.items())),
+        "collective_count": sum(colls.values()),
+    }
+
+
+def measure(tp: bool = True) -> dict:
+    """Compile and count the gated programs.
+
+    ``tp=False`` skips the TP sharded-tick compile (tier-1's
+    test_op_budget fixture: test_tp.py already compiles TP programs,
+    and the TP budget gate still runs in CI via
+    ``python tools/op_budget.py --check``).
+    """
     fused = compile_tick_counts(fused=True)
     unfused = compile_tick_counts(fused=False)
+    out_tp = {}
+    if tp:
+        t = compile_tp_counts()
+        out_tp = {
+            "tp_tick": {
+                **t,
+                "max_ops": int(t["ops"] * COUNT_SLACK),
+                "max_fusions": int(t["fusions"] * COUNT_SLACK),
+            }
+        }
     return {
         "shape": {k: (list(v) if isinstance(v, tuple) else v)
                   for k, v in PINNED.items()},
@@ -118,6 +169,7 @@ def measure() -> dict:
         "max_ops": int(fused["ops"] * COUNT_SLACK),
         "max_fusions": int(fused["fusions"] * COUNT_SLACK),
         "max_fused_ratio": MAX_FUSED_RATIO,
+        **out_tp,
     }
 
 
@@ -144,6 +196,30 @@ def check(measured: dict, budget: dict) -> list:
             f"fused/unfused ops ratio {ratio:.3f} > {cap} — the "
             f"fused front-end lost its kernel-count reduction"
         )
+    # --- the TP sharded tick (ISSUE 9) ---------------------------------
+    tp = measured.get("tp_tick")
+    btp = budget.get("tp_tick")
+    if tp is not None:
+        if btp is None:
+            errs.append(
+                "budget file predates the TP sharded tick — regenerate "
+                "with --write"
+            )
+        else:
+            for k, cap_key in (("ops", "max_ops"),
+                               ("fusions", "max_fusions")):
+                if tp[k] > btp[cap_key]:
+                    errs.append(
+                        f"TP sharded tick {k} regressed: {tp[k]} > "
+                        f"budget {btp[cap_key]}"
+                    )
+            if tp["collectives"] != btp["collectives"]:
+                errs.append(
+                    "TP sharded tick per-tick collectives drifted: "
+                    f"{tp['collectives']} != pinned {btp['collectives']} "
+                    "— a collective change must land with its "
+                    "DECLARED_COLLECTIVES entry and a reviewed --write"
+                )
     return errs
 
 
@@ -158,6 +234,11 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the TP sharded tick compiles on the 8-virtual-device mesh: the
+    # topology flag must land before the first backend init
+    from tools.hloaudit.variants import ensure_devices
+
+    ensure_devices()
     measured = measure()
     print(json.dumps(measured, indent=1))
     if args.write:
