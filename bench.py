@@ -470,7 +470,9 @@ def tp_measurement(n_devices=None) -> dict:
     n_reps = _env_int("BENCH_TP_REPS", 1)
     mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
 
-    def build():
+    tp_telem_ab = os.environ.get("BENCH_TP_TELEMETRY", "") not in ("", "0")
+
+    def build(telemetry=False):
         return smoke.build(
             n_users=n_users,
             n_fogs=n_fogs,
@@ -483,6 +485,7 @@ def tp_measurement(n_devices=None) -> dict:
             queue_capacity=128,
             start_time_max=min(0.05, horizon / 4),
             derive_acks=True,
+            telemetry=telemetry,
         )
 
     spec, state, net, bounds = build()
@@ -520,9 +523,43 @@ def tp_measurement(n_devices=None) -> dict:
         defs.append(int(np.asarray(final.metrics.n_deferred_max)))
     mid = int(np.argsort(walls)[(len(walls) - 1) // 2])
     wall, decisions = walls[mid], decs[mid]
+
+    telem_fields = {}
+    if tp_telem_ab:
+        # interleaved telemetry off/on A/B (ISSUE 11): the measured
+        # TP telemetry-on overhead — per-shard exchange gauges + the
+        # phase-work fold psums — quoted by BENCHMARKS.md and gated
+        # by tools/bench_trend.py (<= OVERHEAD_BAR).  One untimed
+        # telemetry-on run first eats the extra compile.
+        sp, st, nt, bd = build(telemetry=True)
+        run_tp_sharded(
+            sp, st, nt, bd, mesh, exchange_window=window, donate=True
+        )
+        n_ab = max(3, n_reps)
+        w_off, w_on = [], []
+        for _rep in range(n_ab):
+            for telem, sink in ((False, w_off), (True, w_on)):
+                sp, st, nt, bd = build(telemetry=telem)
+                t0 = time.perf_counter()
+                _, f = run_tp_sharded(
+                    sp, st, nt, bd, mesh, exchange_window=window,
+                    donate=True,
+                )
+                jax.block_until_ready(f.metrics.n_scheduled)
+                sink.append(time.perf_counter() - t0)
+        off_med = float(np.median(w_off))
+        on_med = float(np.median(w_on))
+        telem_fields = {
+            "telemetry_overhead": round(on_med / max(off_med, 1e-9), 4),
+            "telemetry_off_wall_s": round(off_med, 4),
+            "telemetry_on_wall_s": round(on_med, 4),
+            "telemetry_ab_reps": n_ab,
+        }
+
     return {
         "metric": "tp_task_offload_decisions_per_sec",
         "value": round(decisions / wall, 1),
+        **telem_fields,
         "unit": "decisions/s",
         "backend": backend,
         "n_devices": D,

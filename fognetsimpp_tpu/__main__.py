@@ -117,9 +117,18 @@ def main(argv=None) -> int:
                     "(parallel/taskshard.run_tp_sharded: shard_map "
                     "megaphases, explicit broker<->fog collectives, "
                     "ring arrival exchange); dense-broker FIFO worlds "
-                    "only — composes with --policy/--telemetry; "
+                    "only — composes with --policy/--telemetry/--hist "
+                    "and --serve (the sharded health plane: per-shard "
+                    "fns_tp_exchange_* gauges + defer-rate watchdog); "
                     "non-divisible populations are padded with inert "
                     "users")
+    ap.add_argument("--tp-window", type=int, metavar="K", default=None,
+                    help="per-shard TP arrival-exchange window (slots "
+                    "per shard per tick; default: the full candidate "
+                    "list, which never defers).  Bounded windows defer "
+                    "overflow arrivals a tick (Metrics.n_deferred, the "
+                    "fns_tp_exchange_* gauges, and — under --serve — "
+                    "the defer-rate watchdog make it observable)")
     ap.add_argument("--replicas", type=int, default=None, metavar="R",
                     help="Monte-Carlo fleet: advance R replica worlds "
                     "(per-replica PRNG streams) sharded over the device "
@@ -206,15 +215,15 @@ def main(argv=None) -> int:
             ap.error("--tp shards ONE world's task table over the mesh; "
                      "--replicas/--mesh fan out independent worlds — "
                      "pick one parallel axis per run")
-        if args.serve is not None:
-            ap.error("--serve is a single-device chunked loop; TP "
-                     "serving is a follow-up (run --tp without --serve)")
         if args.sweep:
             ap.error("--sweep owns its own replica fan-out; it does not "
                      "combine with --tp")
         if args.progress or args.ticks or args.trails:
             ap.error("--tp runs one jitted sharded scan; "
                      "--progress/--ticks/--trails do not apply")
+    elif args.tp_window is not None:
+        ap.error("--tp-window sizes the TP arrival exchange; it needs "
+                 "--tp N")
 
     text = ""
     if args.config:
@@ -412,6 +421,95 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    def _announce(health):
+        # one status line per chunk, the Cmdenv-progress analog
+        print(json.dumps(health), flush=True)
+
+    def _finish_serve(spec_f, final, status, t0, prof, extra=None):
+        """Shared --serve epilogue (single-device and --tp branches):
+        summary dict, recording, trace/profile export, server shutdown,
+        one JSON line — edited in ONE place for both paths."""
+        wall = time.perf_counter() - t0
+        out = {
+            "scenario": cfg.lookup("scenario", "smoke"),
+            "wall_s": round(wall, 3),
+            **(extra or {}),
+            "port": status["port"],
+            "chunks": status["chunks"],
+            "anomalies": status["anomalies"],
+            "slo_breaches": status["slo_breaches"],
+            "dumps": status["dumps"],
+        }
+        outdir = args.out or cfg.lookup("output.dir")
+        if outdir:
+            run_id = args.run_id or cfg.lookup(
+                "output.run_id", "General-0"
+            )
+            out.update(record_run(
+                outdir, spec_f, final, run_id=run_id,
+                attrs={
+                    "argv": sys.argv[1:] if argv is None else list(argv),
+                    "scenario": cfg.lookup("scenario", "smoke"),
+                    "served_port": status["port"],
+                    **{
+                        k: v for k, v in (extra or {}).items()
+                        if k == "tp_shards"
+                    },
+                },
+            ))
+        if args.trace_out:
+            # TP runs: the per-shard exchange lanes ride this export
+            from .telemetry.timeline import export_trace
+
+            out["trace"] = export_trace(
+                spec_f, final, args.trace_out,
+                max_tasks=args.trace_max_tasks or None,
+            )
+        if args.profile:
+            out["profile_dir"] = prof["dir"] if prof["active"] else None
+            if prof["error"]:
+                out["profile_error"] = prof["error"]
+        s = summarize(final)
+        out.update(
+            n_published=s["n_published"], n_completed=s["n_completed"],
+        )
+        if status["server"] is not None:
+            status["server"].close()
+        print(json.dumps(out))
+        return 0
+
+    if args.tp is not None and args.serve is not None:
+        # ---- sharded health plane: --serve --tp N (ISSUE 11) ----------
+        from .parallel import make_mesh
+        from .telemetry.live import serve_tp_run
+        from .telemetry.profile import profile_trace
+
+        t0 = time.perf_counter()
+        try:
+            with profile_trace(args.profile) as prof:
+                mesh = make_mesh(args.tp, axis_name="node")
+                spec, final, status = serve_tp_run(
+                    spec, state, net, bounds, mesh,
+                    exchange_window=args.tp_window,
+                    chunk_ticks=args.serve_chunk,
+                    port=args.serve,
+                    slo_ms=args.slo,
+                    dump_dir=args.postmortem,
+                    on_chunk=_announce,
+                )
+        except ValueError as e:
+            # e.g. a policy outside the dense-broker TP family, or more
+            # shards than devices: one actionable line
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        return _finish_serve(
+            spec, final, status, t0, prof,
+            extra={
+                "tp_shards": args.tp,
+                "n_users": spec.n_users,  # post-padding population
+            },
+        )
+
     if args.tp is not None:
         # ---- TP: one world's task table sharded over the mesh ---------
         import jax
@@ -425,7 +523,8 @@ def main(argv=None) -> int:
             with profile_trace(args.profile) as prof:
                 mesh = make_mesh(args.tp, axis_name="node")
                 spec, final = run_tp_sharded(
-                    spec, state, net, bounds, mesh, pad=True
+                    spec, state, net, bounds, mesh,
+                    exchange_window=args.tp_window, pad=True,
                 )
                 jax.block_until_ready(final)
         except ValueError as e:
@@ -478,57 +577,19 @@ def main(argv=None) -> int:
             ap.error("--serve is a single-world loop; fleet serving is "
                      "a follow-up (run --replicas without --serve)")
         from .telemetry.live import serve_run
+        from .telemetry.profile import profile_trace
 
         t0 = time.perf_counter()
-
-        def _announce(health):
-            # one status line per chunk, the Cmdenv-progress analog
-            print(json.dumps(health), flush=True)
-
-        final, status = serve_run(
-            spec, state, net, bounds,
-            chunk_ticks=args.serve_chunk,
-            port=args.serve,
-            slo_ms=args.slo,
-            dump_dir=args.postmortem,
-            on_chunk=_announce,
-        )
-        wall = time.perf_counter() - t0
-        out = {
-            "scenario": cfg.lookup("scenario", "smoke"),
-            "wall_s": round(wall, 3),
-            "port": status["port"],
-            "chunks": status["chunks"],
-            "anomalies": status["anomalies"],
-            "slo_breaches": status["slo_breaches"],
-            "dumps": status["dumps"],
-        }
-        outdir = args.out or cfg.lookup("output.dir")
-        if outdir:
-            run_id = args.run_id or cfg.lookup("output.run_id", "General-0")
-            out.update(record_run(
-                outdir, spec, final, run_id=run_id,
-                attrs={
-                    "argv": sys.argv[1:] if argv is None else list(argv),
-                    "scenario": cfg.lookup("scenario", "smoke"),
-                    "served_port": status["port"],
-                },
-            ))
-        if args.trace_out:
-            from .telemetry.timeline import export_trace
-
-            out["trace"] = export_trace(
-                spec, final, args.trace_out,
-                max_tasks=args.trace_max_tasks or None,
+        with profile_trace(args.profile) as prof:
+            final, status = serve_run(
+                spec, state, net, bounds,
+                chunk_ticks=args.serve_chunk,
+                port=args.serve,
+                slo_ms=args.slo,
+                dump_dir=args.postmortem,
+                on_chunk=_announce,
             )
-        s = summarize(final)
-        out.update(
-            n_published=s["n_published"], n_completed=s["n_completed"],
-        )
-        if status["server"] is not None:
-            status["server"].close()
-        print(json.dumps(out))
-        return 0
+        return _finish_serve(spec, final, status, t0, prof)
 
     if args.replicas is not None or args.mesh is not None:
         # ---- replica-sharded fleet run (parallel/fleet.py) ------------

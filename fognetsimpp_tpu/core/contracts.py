@@ -319,12 +319,17 @@ def check_telemetry_contract(spec: WorldSpec, state) -> None:
     the whole WorldState structure, or the scan carry would mismatch /
     silently recompile mid-run.
     """
-    from ..telemetry.metrics import PHASES, RES_FIELDS
+    from ..telemetry.metrics import EXG_OCC_BINS, PHASES, RES_FIELDS
 
     t = state.telem
     F = spec.n_fogs if spec.telemetry else 0
     P = len(PHASES) if spec.telemetry else 0
     R = spec.telemetry_slots
+    # TP exchange-plane leaves (ISSUE 11): zero-row unless the spec is a
+    # stamped TP world view (spec.tp_shards, set by run_tp_sharded) with
+    # telemetry on — nested inside spec.telemetry like the hist gate
+    S = spec.telemetry_tp_shards
+    Rs = R if S else 0
     expect = {
         "q_len_sum": (F,), "q_len_max": (F,), "q_len_min": (F,),
         "busy_ticks": (F,), "pool_occ_sum": (F,), "pick_hist": (F,),
@@ -336,6 +341,11 @@ def check_telemetry_contract(spec: WorldSpec, state) -> None:
         "lat_hist": (spec.telemetry_hist_fogs, spec.telemetry_hist_nbins),
         "lat_sum": (spec.telemetry_hist_fogs,),
         "lat_seen": (spec.telemetry_hist_tasks,),
+        "exg_occ_hist": (S, EXG_OCC_BINS),
+        "exg_occ_sum": (S,), "exg_cand_sum": (S,),
+        "exg_defer_sum": (S,), "exg_defer_max": (S,),
+        "exg_util_sum": (S,), "exg_age_max": (S,),
+        "exg_occ_res": (Rs, S),
     }
     for name, shape in expect.items():
         got = tuple(getattr(t, name).shape)

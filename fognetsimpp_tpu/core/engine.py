@@ -164,11 +164,6 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
         return "TP tick does not carry DropTail backpressure yet"
     if spec.learn_active:
         return "TP tick does not carry bandit learner state yet"
-    if spec.telemetry_hist:
-        return (
-            "TP tick does not stream the latency histogram (per-task "
-            "ack scans are shard-local); plain --telemetry composes"
-        )
     if spec.record_tick_series:
         return "TP tick records no per-tick series (record via summary)"
     return None

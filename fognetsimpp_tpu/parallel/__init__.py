@@ -49,6 +49,7 @@ from .taskshard import (  # noqa: F401
     pad_users_to_multiple,
     ring_all_gather,
     run_node_sharded,
+    run_tp_chunked,
     run_tp_sharded,
     shard_state_by_node,
 )

@@ -204,6 +204,27 @@ def fleet_busy_fractions_per_replica(
     return busy / ticks[:, None]
 
 
+def fleet_phase_work(
+    spec: WorldSpec, final_batch: WorldState
+) -> Optional[np.ndarray]:
+    """Per-replica per-phase work counters, shape ``(R, P)``.
+
+    The fleet half of the ISSUE 11 phase-attribution story: each
+    replica's vmapped tick books its own ``phase_work`` vector, and the
+    fleet OpenMetrics exposition publishes one sample per
+    ``(fleet=replica, phase)`` label pair
+    (``fns_fleet_phase_work{fleet="r",phase="spawn"}``) — so a replica
+    whose work profile shifted (a policy sweep cell gone degenerate, a
+    replica starving on drops) is visible in the scrape instead of
+    averaged away, the ``fleet_busy_fractions_per_replica``
+    discipline.  One host gather; ``None`` when ``spec.telemetry`` was
+    off.
+    """
+    if not spec.telemetry:
+        return None
+    return np.asarray(final_batch.telem.phase_work, np.int64)  # (R, P)
+
+
 def fleet_latency_hist(
     spec: WorldSpec, final_batch: WorldState
 ) -> Optional[Dict]:

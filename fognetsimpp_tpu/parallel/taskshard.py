@@ -62,7 +62,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -97,6 +97,15 @@ from ..net.topology import LinkCache, NetParams, associate
 from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
 from ..spec import WorldSpec
 from ..state import Metrics, NodeState, TaskState, UserState, WorldState
+from ..telemetry.health import latency_hist_delta
+from ..telemetry.metrics import (
+    PHASE_INDEX,
+    PHASES,
+    accumulate_exchange,
+    accumulate_tick,
+    init_exchange_leaves,
+    tick_activity,
+)
 from .mesh import replica_sharding
 from .tp import shard_map
 
@@ -117,6 +126,24 @@ DECLARED_COLLECTIVES = {
 }
 
 _METRIC_FIELDS = tuple(f.name for f in dataclasses.fields(Metrics))
+
+
+class ExgStats(NamedTuple):
+    """One shard's per-tick exchange-plane scalars (ISSUE 11).
+
+    Computed by :func:`_tp_fog_arrivals` when the telemetry plane is
+    on; the end-of-tick telemetry fold assembles the per-shard vectors
+    (one-hot columns + one ``psum``) and
+    :func:`telemetry.metrics.accumulate_exchange` books them.
+    """
+
+    occ: jax.Array  # () f32 window occupancy fraction (n_set / K; > 1
+    #   means overflow -> deferral)
+    util: jax.Array  # () f32 ppermute payload utilization (seated / K)
+    age: jax.Array  # () f32 max tick-age of a deferred candidate
+    cand: jax.Array  # () f32 integer-valued candidate-production count
+    defer: jax.Array  # () f32 integer-valued deferred-at-window count
+    seated: jax.Array  # () i32 slots seated in the exchange window
 
 
 # ----------------------------------------------------------------------
@@ -144,10 +171,10 @@ def pad_users_to_multiple(
     pad = (-U) % n
     if pad == 0:
         return spec, state, net
-    if spec.learn_active or spec.telemetry_hist:
+    if spec.learn_active:
         raise ValueError(
-            "pad_users_to_multiple does not extend per-task learner/"
-            "histogram state; pick a divisible population for those specs"
+            "pad_users_to_multiple does not extend per-task learner "
+            "state; pick a divisible population for learned policies"
         )
     S = spec.max_sends_per_user
     U2 = U + pad
@@ -233,8 +260,58 @@ def pad_users_to_multiple(
     state2 = state.replace(
         nodes=nodes, users=users, tasks=tasks,
     )
+    if spec.telemetry and spec.telemetry_hist:
+        # the per-task exactly-once flag grows with the task table:
+        # ghost rows stay UNUSED forever, so their flags stay 0 and the
+        # histogram never sees them (tests/test_tp_telemetry.py)
+        state2 = state2.replace(
+            telem=state2.telem.replace(
+                lat_seen=jnp.concatenate(
+                    [
+                        state.telem.lat_seen,
+                        jnp.zeros((pad * S,), jnp.int8),
+                    ]
+                )
+            )
+        )
     _ = f32  # (dtype alias kept for symmetry with init_state)
     return spec2, state2, net2
+
+
+def stamp_tp_telemetry(
+    spec: WorldSpec, state: WorldState, n: int
+) -> Tuple[WorldSpec, WorldState]:
+    """Stamp the shard axis on a telemetry-on world (ISSUE 11).
+
+    Sets ``spec.tp_shards`` and sizes the per-shard exchange-plane
+    telemetry leaves (:func:`telemetry.metrics.init_exchange_leaves`)
+    so the stamped spec describes the stamped state.  Idempotent — a
+    chained call with an already-stamped pair changes nothing — and a
+    no-op with the telemetry plane off.  The ONE stamping sequence
+    shared by :func:`_tp_setup` and ``telemetry.live.serve_tp_run``;
+    the population must already divide over ``n``
+    (:func:`pad_users_to_multiple`).
+    """
+    if not spec.telemetry:
+        return spec, state
+    if spec.tp_shards != n:
+        spec = dataclasses.replace(spec, tp_shards=n).validate()
+    if state.telem.exg_cand_sum.shape[0] != n:
+        state = state.replace(
+            telem=state.telem.replace(**init_exchange_leaves(spec))
+        )
+    R = min(spec.arrival_cands, spec.max_sends_per_user)
+    cap = (spec.n_users // n) * R
+    if cap >= 2 ** 24:
+        # the exchange gauges ride an f32 one-hot psum: per-tick
+        # candidate counts must stay exact integers in f32 (the
+        # engine._fused_mips_exact discipline, simlint R10)
+        raise ValueError(
+            f"per-shard candidate capacity {cap} >= 2^24: the "
+            "telemetry exchange fold loses f32 integer exactness — "
+            "run telemetry off at this shape or raise the shard count"
+        )
+    return spec, state
 
 
 # ----------------------------------------------------------------------
@@ -484,6 +561,7 @@ def _tp_fog_arrivals(
     cks, cts, cfs, cms, cvs, n_left = _arrival_candidates(
         st2, taf2, fog2, mip2, t1, R
     )
+    telem_on = spec.telemetry
     UR = U * R
     cand_k = jnp.stack(cks, axis=1).reshape(UR)
     cand_t = jnp.stack(cts, axis=1).reshape(UR)
@@ -492,6 +570,10 @@ def _tp_fog_arrivals(
     cand_v = jnp.stack(cvs, axis=1).reshape(UR)
     cand_u = jnp.repeat(jnp.arange(U, dtype=i32), R)
     cand_slot_g = cand_u * S + cand_k + tp.t_off  # GLOBAL task ids
+    # exchange-plane telemetry: this shard's candidate production,
+    # counted BEFORE the saturated-fog fast drop (the drop is part of
+    # what the gauge should make visible)
+    n_cand = jnp.sum(cand_v.astype(i32)) if telem_on else None
 
     # ---- saturated-fog fast drop (local decision, psum'd fog sums) ----
     droppy = (
@@ -531,9 +613,8 @@ def _tp_fog_arrivals(
     # ---- exchange-window compaction ------------------------------------
     m_part = m_part.replace(n_deferred=m_part.n_deferred + n_left)
     n_set = jnp.sum(cand_v.astype(i32))
-    m_part = m_part.replace(
-        n_deferred=m_part.n_deferred + jnp.maximum(n_set - k_exchange, 0)
-    )
+    n_defer_exg = jnp.maximum(n_set - k_exchange, 0)
+    m_part = m_part.replace(n_deferred=m_part.n_deferred + n_defer_exg)
     if k_exchange >= UR:
         # overflow impossible: plain ascending order, which keeps the
         # assembled window in exact global candidate order (the
@@ -561,7 +642,34 @@ def _tp_fog_arrivals(
         axis=1,
     )  # (K_ex, 4) i32 — ONE array around the ring per hop
 
-    full = ring_all_gather(packed, tp.axis_name, tp.n_shards)
+    exg = None
+    if telem_on:
+        # shard-local exchange-plane scalars (ISSUE 11): window
+        # occupancy/utilization, the overflow backlog, and the age of
+        # the oldest candidate the window could not seat this tick
+        f32_ = jnp.float32
+        seated = jnp.minimum(n_set, k_exchange)
+        seat_mask = (
+            jnp.zeros((UR + 1,), bool)
+            .at[jnp.where(valid_l, idxc_l, UR)]
+            .set(True)[:UR]
+        )
+        waiting = cand_v & ~seat_mask
+        age_t = jnp.max(
+            jnp.where(waiting, t1 - cand_t, -jnp.inf)
+        )
+        age_ticks = jnp.maximum(age_t / spec.dt, 0.0).astype(f32_)
+        exg = ExgStats(
+            occ=n_set.astype(f32_) / k_exchange,
+            util=seated.astype(f32_) / k_exchange,
+            age=jnp.where(jnp.any(waiting), age_ticks, 0.0),
+            cand=n_cand.astype(f32_),
+            defer=n_defer_exg.astype(f32_),
+            seated=seated,
+        )
+
+    with jax.named_scope("phase_tp_exchange"):
+        full = ring_all_gather(packed, tp.axis_name, tp.n_shards)
     idx = full[:, 0]  # global ids, sentinel T_g
     valid = idx < T_g
     fog_g = full[:, 1]
@@ -678,7 +786,7 @@ def _tp_fog_arrivals(
         )
     )
     state = state.replace(tasks=tasks, fogs=fogs)
-    return state, buf_p, buf_r, m_part, m_rep
+    return state, buf_p, buf_r, m_part, m_rep, exg
 
 
 # ----------------------------------------------------------------------
@@ -710,12 +818,19 @@ def _tp_tick(
     Phase order mirrors ``engine.make_step`` for the TP-admitted family
     (dense broker, FIFO fogs, static topology): connect -> adverts ->
     spawn -> dense decide -> completions xN -> arrivals -> counters ->
-    telemetry.  Every shard-partial counter rides ONE end-of-tick psum.
+    telemetry.  Every shard-partial counter rides ONE end-of-tick psum;
+    with the telemetry plane on, two more psums (one i32, one f32) fold
+    the per-phase work deltas, the exchange-plane gauges and the
+    latency-histogram deltas (ISSUE 11) — the telemetry-OFF tick
+    compiles to exactly the PR 8 program (bit-exact, per-tick
+    collective count unchanged).
     """
     t0 = state.tick.astype(jnp.float32) * spec.dt
     t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
     i32 = jnp.int32
     U, F = spec.n_users, spec.n_fogs
+    telem_on = spec.telemetry
+    hist_on = spec.telemetry and spec.telemetry_hist
 
     m_carry = state.metrics
     m_rep = _zero_metrics(m_carry)
@@ -723,27 +838,61 @@ def _tp_tick(
     buf_r = _zero_buf(U, F)
     state = state.replace(metrics=_zero_metrics(m_carry))  # partial acc
 
+    # ---- per-phase work brackets (ISSUE 11) ---------------------------
+    # The single-device engine brackets every phase with the
+    # metrics+TickBuf activity sum (telemetry/metrics.tick_activity).
+    # Under TP that sum splits into a shard-PARTIAL half (per-user
+    # counters and buffers) and a REPLICATED half (fog/broker totals,
+    # identical on every shard by construction).  Each shard books its
+    # partial delta; only shard 0 books the replicated delta — so the
+    # end-of-tick psum over shards reproduces the single-device bracket
+    # EXACTLY (integer adds commute), and phase_work under TP equals
+    # the single-device profile bit-for-bit
+    # (tests/test_tp_telemetry.py pins it per phase).
+    ph_work: dict = {}
+    gate = tp.shard == 0
+
+    def _act(m_part_v, m_rep_v):
+        # THE single-device bracket measure (telemetry.metrics
+        # .tick_activity) applied to each half; closes over the
+        # CURRENT buf_p/buf_r bindings at call time
+        return tick_activity(m_part_v, buf_p) + jnp.where(
+            gate, tick_activity(m_rep_v, buf_r), 0
+        )
+
+    def _book(name, a0, a1):
+        i = PHASE_INDEX[name]
+        d = a1 - a0
+        ph_work[i] = ph_work[i] + d if i in ph_work else d
+
     # 1-2. static world: the hoisted cache stands in for mobility +
     # association (spec.assume_static is part of the TP gate)
 
     # 3. connect handshake (user-partial counters; replicated broker regs)
     if spec.connect_gating:
+        a0 = _act(state.metrics, m_rep) if telem_on else None
         with jax.named_scope("phase_connect"):
             state, buf_p = _phase_connect(
                 spec, state, net, cache, buf_p, t0, t1
             )
+        if telem_on:
+            _book("connect", a0, _act(state.metrics, m_rep))
     # 4. advert delivery — its counter is an F-sum, identical on every
     # shard: route it to the REPLICATED accumulator
     m_part = state.metrics
     state = state.replace(metrics=m_rep)
+    a0 = _act(m_part, state.metrics) if telem_on else None
     with jax.named_scope("phase_adverts"):
         state = _phase_adverts(state, t1)
     m_rep, state = state.metrics, state.replace(metrics=m_part)
     if spec.adv_periodic:
         with jax.named_scope("phase_adverts"):
             state = _phase_periodic_adverts(spec, state, net, cache, t0, t1)
+    if telem_on:
+        _book("adverts", a0, _act(state.metrics, m_rep))
 
     # 5. spawn (full-width PRNG draws sliced per shard — engine._tp_user_draw)
+    a0 = _act(state.metrics, m_rep) if telem_on else None
     with jax.named_scope("phase_spawn"):
         if spec.max_sends_per_tick > 1:
             state, buf_p = _phase_spawn_multi(
@@ -753,25 +902,50 @@ def _tp_tick(
             state, buf_p = _phase_spawn(
                 spec, state, net, cache, buf_p, t0, t1, tp=tp
             )
+    if telem_on:
+        _book("spawn", a0, _act(state.metrics, m_rep))
 
     # 6. dense broker decide (replicated scalar winner; one psum for the
     # global fan-out counts)
+    a0 = _act(state.metrics, m_rep) if telem_on else None
     with jax.named_scope("phase_broker"):
         state, buf_p = _phase_broker_dense(
             spec, state, net, cache, buf_p, t1, tp=tp
         )
+    if telem_on:
+        _book("broker", a0, _act(state.metrics, m_rep))
     m_part = state.metrics
 
     # 7. fog completions + arrivals (replicated fog state)
+    a0 = _act(m_part, m_rep) if telem_on else None
     for _ in range(spec.completions_per_tick):
         with jax.named_scope("phase_completions"):
             state, buf_p, buf_r, m_rep = _tp_completions(
                 spec, tp, state, cache, buf_p, buf_r, m_rep, t1
             )
+    if telem_on:
+        _book("completions", a0, _act(m_part, m_rep))
+    a0 = _act(m_part, m_rep) if telem_on else None
     with jax.named_scope("phase_fog_arrivals"):
-        state, buf_p, buf_r, m_part, m_rep = _tp_fog_arrivals(
+        state, buf_p, buf_r, m_part, m_rep, exg = _tp_fog_arrivals(
             spec, tp, state, cache, buf_p, buf_r, m_part, m_rep, t1,
             k_exchange,
+        )
+    if telem_on:
+        _book("fog_arrivals", a0, _act(m_part, m_rep))
+
+    # 7b. streaming latency histogram (spec.telemetry_hist under TP,
+    # ISSUE 11): shard-local deltas over the owned task rows; the fold
+    # below psums them into the replicated histogram.  The per-task
+    # exactly-once flag stays shard-local (each task has one owner).
+    hist_d = sum_d = None
+    if hist_on:
+        with jax.named_scope("phase_latency_hist"):
+            hist_d, sum_d, seen = latency_hist_delta(
+                spec, state.telem, state.tasks, t1
+            )
+        state = state.replace(
+            telem=state.telem.replace(lat_seen=seen)
         )
 
     # 8. THE end-of-tick combine: every shard-partial scalar in one psum
@@ -817,18 +991,69 @@ def _tp_tick(
         )
     state = state.replace(nodes=nodes2, metrics=metrics)
 
-    if spec.telemetry:
-        # plane-1 gauges on the replicated fog state + psum'd totals.
-        # Per-phase work attribution needs the eager per-phase counter
-        # brackets the partial/replicated split removed — phase_work
-        # rows stay zero under TP (documented in the README TP section).
-        from ..telemetry.metrics import accumulate_tick
-
+    if telem_on:
+        # 9a. the telemetry fold (ISSUE 11): ONE i32 psum for the
+        # per-phase work deltas + latency-histogram bucket deltas, ONE
+        # f32 psum for the exchange-plane one-hot columns + latency
+        # sums.  The one-hot layout makes the psum a gather: shard s
+        # fills only column s, so the summed result is the full
+        # replicated per-shard view and every shard folds identical
+        # values into the replicated TelemetryState.  The per-shard
+        # exchange LEAVES exist only on a stamped world view
+        # (spec.tp_shards, run_tp_sharded's default; run_node_sharded
+        # dispatches unstamped to keep its single-return API) — the
+        # phase slots and histogram fold book either way.
+        exg_on = spec.telemetry_tp_shards > 0
+        with jax.named_scope("phase_tp_fold"):
+            n_ph = len(PHASES)
+            ph_work[PHASE_INDEX["tp_exchange"]] = exg.seated
+            ph_work[PHASE_INDEX["tp_defer"]] = exg.defer.astype(i32)
+            ph_vec = jnp.zeros((n_ph,), i32)
+            for i in sorted(ph_work):
+                ph_vec = ph_vec.at[i].set(ph_work[i])
+            S_n = tp.n_shards
+            ints = [ph_vec]
+            flts = []
+            if exg_on:
+                col = jnp.stack(
+                    [exg.occ, exg.util, exg.age, exg.cand, exg.defer]
+                )
+                flts.append(
+                    jnp.zeros((5, S_n), jnp.float32)
+                    .at[:, tp.shard].set(col).reshape(-1)
+                )
+            if hist_on:
+                ints.append(hist_d.reshape(-1))
+                flts.append(sum_d)
+            int_tot = jax.lax.psum(jnp.concatenate(ints), tp.axis_name)
+            flt_tot = (
+                jax.lax.psum(jnp.concatenate(flts), tp.axis_name)
+                if flts else None
+            )
+            ph_tot = int_tot[:n_ph]
+            telem = state.telem
+            if hist_on:
+                telem = telem.replace(
+                    lat_hist=telem.lat_hist
+                    + int_tot[n_ph:].reshape(F, spec.telemetry_hist_bins),
+                    lat_sum=telem.lat_sum + flt_tot[-F:],
+                )
+            if exg_on:
+                exg_g = flt_tot[: 5 * S_n].reshape(5, S_n)
+                telem = accumulate_exchange(
+                    spec, telem, exg_g[0], exg_g[1], exg_g[2], exg_g[3],
+                    exg_g[4], state.tick,
+                )
+            state = state.replace(telem=telem)
+        # 9b. plane-1 gauges on the replicated fog state + psum'd
+        # totals, with the folded per-phase work vector booked exactly
+        # like the single-device harness books its bracket deltas
         with jax.named_scope("phase_telemetry"):
             state = state.replace(
                 telem=accumulate_tick(
                     spec, state.telem, state.fogs, state.learn,
-                    state.metrics, state.tick, t1, None,
+                    state.metrics, state.tick, t1,
+                    {i: ph_tot[i] for i in range(n_ph)},
                 )
             )
 
@@ -846,8 +1071,9 @@ def _tp_program(
     U_loc = U_g // n
     T_loc = U_loc * S
     spec_l = dataclasses.replace(spec, n_users=U_loc)
+    hist_on = spec.telemetry and spec.telemetry_hist
 
-    def body(users, tasks, nodes_u, rep, net, cache):
+    def run_shard(users, tasks, nodes_u, lat_seen, rep, net, cache):
         shard = jax.lax.axis_index(axis_name)
         u_off = shard * U_loc
         tp = TpCtx(
@@ -886,11 +1112,17 @@ def _tp_program(
             lambda a, b: jnp.concatenate([a, b], axis=0),
             nodes_u, rep["nodes_rest"],
         )
+        telem_l = rep["telem"]
+        if hist_on:
+            # the per-task exactly-once flag travels with the SHARDED
+            # tree (each task row has exactly one owner); the rest of
+            # the telemetry state stays replicated
+            telem_l = telem_l.replace(lat_seen=lat_seen)
         state_l = WorldState(
             t=rep["t"], tick=rep["tick"], key=rep["key"],
             nodes=nodes_l, users=users, fogs=rep["fogs"],
             broker=rep["broker"], tasks=tasks, metrics=rep["metrics"],
-            learn=rep["learn"], telem=rep["telem"],
+            learn=rep["learn"], telem=telem_l,
         )
 
         def tick(st, _):
@@ -899,26 +1131,49 @@ def _tp_program(
         final, _ = jax.lax.scan(tick, state_l, None, length=n_ticks)
         if spec.derive_acks:
             final = _finalize_derived_acks(spec_l, final, cache_l)
+        telem_out = final.telem
+        lat_seen_out = None
+        if hist_on:
+            lat_seen_out = telem_out.lat_seen
+            telem_out = telem_out.replace(
+                lat_seen=jnp.zeros((0,), jnp.int8)
+            )
         rep_out = {
             "t": final.t, "tick": final.tick, "key": final.key,
             "fogs": final.fogs, "broker": final.broker,
             "metrics": final.metrics, "learn": final.learn,
-            "telem": final.telem,
+            "telem": telem_out,
             "nodes_rest": jax.tree.map(lambda x: x[U_loc:], final.nodes),
         }
         nodes_u_out = jax.tree.map(lambda x: x[:U_loc], final.nodes)
-        return final.users, final.tasks, nodes_u_out, rep_out
+        return final.users, final.tasks, nodes_u_out, lat_seen_out, rep_out
+
+    # check_vma=False on both variants: outputs mix sharded task rows
+    # and replicated fog/broker state; the fog-side replication
+    # invariant is by construction (every shard runs the identical tail
+    # on the identical exchanged window), not statically provable
+    if hist_on:
+        def body(users, tasks, nodes_u, lat_seen, rep, net, cache):
+            return run_shard(users, tasks, nodes_u, lat_seen, rep, net,
+                             cache)
+
+        in_specs = (P(axis_name),) * 4 + (P(), P(), P())
+        out_specs = (P(axis_name),) * 4 + (P(),)
+    else:
+        def body(users, tasks, nodes_u, rep, net, cache):
+            u, t, nu, _, r = run_shard(users, tasks, nodes_u, None, rep,
+                                       net, cache)
+            return u, t, nu, r
+
+        in_specs = (P(axis_name),) * 3 + (P(), P(), P())
+        out_specs = (P(axis_name),) * 3 + (P(),)
 
     shmapped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P(axis_name), P(), P(), P()),
-        out_specs=(P(axis_name), P(axis_name), P(axis_name), P()),
-        check_vma=False,  # outputs mix sharded task rows and replicated
-        #                   fog/broker state; the fog-side replication
-        #                   invariant is by construction (every shard
-        #                   runs the identical tail on the identical
-        #                   exchanged window), not statically provable
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
     )
 
     # donation covers the SHARDED trees only — the memory that scales
@@ -931,8 +1186,7 @@ def _tp_program(
         jax.jit, donate_argnums=(0,) if donate else ()
     )
     def go(sharded, rep, net, cache):
-        users, tasks, nodes_u = sharded
-        return shmapped(users, tasks, nodes_u, rep, net, cache)
+        return shmapped(*sharded, rep, net, cache)
 
     return go
 
@@ -948,6 +1202,7 @@ def run_tp_sharded(
     exchange_window: Optional[int] = None,
     donate: bool = False,
     pad: bool = True,
+    stamp: bool = True,
 ) -> Tuple[WorldSpec, WorldState]:
     """Advance ONE world whose user/task axis spans the mesh.
 
@@ -955,9 +1210,12 @@ def run_tp_sharded(
     TP-admissible spec (:func:`engine.tp_ok` — a one-line ``ValueError``
     otherwise).  Returns ``(spec, final_state)``: the spec comes back
     because ``pad=True`` (default) pads a non-divisible population with
-    inert users (:func:`pad_users_to_multiple`) and the padded spec
-    describes the returned state.  Task/user outputs stay row-sharded
-    on the mesh, so chained calls never gather the table.
+    inert users (:func:`pad_users_to_multiple`) and — telemetry on —
+    the shard axis is stamped (``spec.tp_shards``, sizing the
+    per-shard exchange-plane telemetry leaves the returned state now
+    carries); the returned spec describes the returned state either
+    way.  Task/user outputs stay row-sharded on the mesh, so chained
+    calls never gather the table.
 
     ``exchange_window`` bounds the per-shard arrival candidates
     exchanged per tick (default: the full per-shard candidate list —
@@ -969,13 +1227,25 @@ def run_tp_sharded(
     run — the memory discipline of ``run_jit`` (simlint R6); do not
     reuse ``state`` after calling.  Bit-exactness is independent of
     donation (tests/test_tp.py).
+
+    ``stamp=False`` skips the telemetry shard-axis stamping — the
+    caller's spec keeps describing the returned state (no per-shard
+    exchange leaves; phase attribution and the latency histogram still
+    book).  :func:`run_node_sharded` uses it to keep its
+    single-return dispatch API consistent.
     """
     del bounds  # static worlds only (tp gate): mobility never runs
     go, parts, net_r, cache_r, spec = _tp_setup(
         spec, state, net, mesh, n_ticks, axis_name, exchange_window,
-        donate, pad,
+        donate, pad, stamp,
     )
-    users, tasks, nodes_u_f, rep = go(*parts, net_r, cache_r)
+    out = go(*parts, net_r, cache_r)
+    if spec.telemetry and spec.telemetry_hist:
+        users, tasks, nodes_u_f, lat_seen, rep = out
+        telem = rep["telem"].replace(lat_seen=lat_seen)
+    else:
+        users, tasks, nodes_u_f, rep = out
+        telem = rep["telem"]
     nodes = jax.tree.map(
         lambda a, b: jnp.concatenate([a, b], axis=0),
         nodes_u_f, rep["nodes_rest"],
@@ -983,9 +1253,57 @@ def run_tp_sharded(
     final = WorldState(
         t=rep["t"], tick=rep["tick"], key=rep["key"], nodes=nodes,
         users=users, fogs=rep["fogs"], broker=rep["broker"], tasks=tasks,
-        metrics=rep["metrics"], learn=rep["learn"], telem=rep["telem"],
+        metrics=rep["metrics"], learn=rep["learn"], telem=telem,
     )
     return spec, final
+
+
+def run_tp_chunked(
+    spec: WorldSpec,
+    state: WorldState,
+    net: NetParams,
+    bounds: Optional[MobilityBounds] = None,
+    mesh: Optional[Mesh] = None,
+    chunk_ticks: int = 1000,
+    callback: Optional[Callable[[WorldState, int], None]] = None,
+    n_ticks: Optional[int] = None,
+    axis_name: str = NODE_AXIS,
+    exchange_window: Optional[int] = None,
+    donate: bool = True,
+) -> Tuple[WorldSpec, WorldState]:
+    """TP analog of ``engine.run_chunked``: the sharded horizon in
+    fixed-size chunks, ``callback(state, ticks_done)`` between chunks.
+
+    The serving substrate of the sharded health plane (ISSUE 11):
+    ``telemetry.live.serve_tp_run`` runs its watchdog/exposition loop
+    on these chunk boundaries, exactly like ``serve_run`` does on
+    ``run_chunked``'s.  Each chunk is one :func:`run_tp_sharded` call,
+    so equal-size chunks share ONE cached program (plus one for a
+    ragged tail) and the carry stays row-sharded on the mesh between
+    chunks — the table is never gathered.  The first chunk pads and
+    (telemetry on) stamps the spec; the returned spec describes the
+    returned state.  Bit-identical to one full-horizon TP call — the
+    carry is the same pytree either way (tests/test_tp_telemetry.py).
+
+    ``donate=True`` (default) donates each chunk's input carry; the
+    callback may read the PASSED state freely (the fetch completes
+    before the next chunk consumes it) but must not retain device
+    references across chunks.
+    """
+    total = spec.n_ticks if n_ticks is None else n_ticks
+    chunk = max(1, min(chunk_ticks, total))
+    done = 0
+    while done < total:
+        ticks = min(chunk, total - done)
+        spec, state = run_tp_sharded(
+            spec, state, net, bounds, mesh, n_ticks=ticks,
+            axis_name=axis_name, exchange_window=exchange_window,
+            donate=donate,
+        )
+        done += ticks
+        if callback is not None:
+            callback(state, done)
+    return spec, state
 
 
 def _tp_setup(
@@ -998,6 +1316,7 @@ def _tp_setup(
     exchange_window: Optional[int],
     donate: bool,
     pad: bool,
+    stamp: bool = True,
 ):
     """Shared front half of :func:`run_tp_sharded`: gate, pad, place,
     build the jitted program.  ``tools/hloaudit``/``tools/op_budget``
@@ -1027,6 +1346,9 @@ def _tp_setup(
     k_ex = cap if exchange_window is None else max(1, min(exchange_window, cap))
     ticks = spec.n_ticks if n_ticks is None else n_ticks
 
+    if stamp:
+        spec, state = stamp_tp_telemetry(spec, state, n)
+
     # the run-constant association/delay cache (assume_static is part of
     # the TP gate), computed once OUTSIDE the audited sharded program
     cache = associate(
@@ -1044,17 +1366,27 @@ def _tp_setup(
 
     nodes_u = jax.tree.map(lambda x: x[: spec.n_users], state.nodes)
     nodes_rest = jax.tree.map(lambda x: x[spec.n_users :], state.nodes)
-    sharded = (
+    hist_on = spec.telemetry and spec.telemetry_hist
+    telem_rep = state.telem
+    sharded = [
         rows(state.users),
         rows(state.tasks),
         rows(nodes_u),
-    )
+    ]
+    if hist_on:
+        # the per-task exactly-once flag rides the sharded tree; the
+        # replicated telemetry copy carries a zero-row stand-in
+        sharded.append(rows(state.telem.lat_seen))
+        telem_rep = state.telem.replace(
+            lat_seen=jnp.zeros((0,), jnp.int8)
+        )
+    sharded = tuple(sharded)
     rep = replicated(
         {
             "t": state.t, "tick": state.tick, "key": state.key,
             "fogs": state.fogs, "broker": state.broker,
             "metrics": state.metrics, "learn": state.learn,
-            "telem": state.telem, "nodes_rest": nodes_rest,
+            "telem": telem_rep, "nodes_rest": nodes_rest,
         }
     )
     net_r = replicated(net)
@@ -1143,9 +1475,14 @@ def run_node_sharded(
     table distributed.
     """
     if tp_ok(spec):
+        # stamp=False: this entry returns only the state, so the
+        # CALLER's spec must keep describing it — no per-shard
+        # exchange leaves (use run_tp_sharded directly for the
+        # exchange plane); phase attribution and the latency
+        # histogram still book
         _, final = run_tp_sharded(
             spec, state, net, bounds, mesh, n_ticks=n_ticks,
-            axis_name=axis_name, pad=False,
+            axis_name=axis_name, pad=False, stamp=False,
         )
         return final
     state = shard_state_by_node(spec, state, mesh, axis_name)

@@ -205,7 +205,25 @@ def per_module_scalars(
         }
         for a in range(a0, a1)
     ]
-    return {"user": users, "fog": fogs, "broker": broker, "ap": aps}
+    out = {"user": users, "fog": fogs, "broker": broker, "ap": aps}
+    # per-shard TP exchange-plane rows (ISSUE 11): present only on
+    # stamped TP runs — same exchange_summary() dict the OpenMetrics
+    # fns_tp_exchange_* families render, so the two cannot drift
+    ex = telem.get("tp_exchange") if telem is not None else None
+    if ex is not None:
+        out["tp_shard"] = [
+            {
+                "occ_mean": float(ex["occ_mean"][s]),
+                "occ_hist": [int(c) for c in ex["occ_hist"][s]],
+                "candidates": int(ex["cand"][s]),
+                "deferred": int(ex["defer_sum"][s]),
+                "deferred_max": int(ex["defer_max"][s]),
+                "util_mean": float(ex["util_mean"][s]),
+                "defer_age_ticks_max": float(ex["age_max_ticks"][s]),
+            }
+            for s in range(ex["n_shards"])
+        ]
+    return out
 
 
 def _json_sanitize(obj):
@@ -415,7 +433,10 @@ def record_fleet_run(
     # OpenMetrics exposition (telemetry plane 3): aggregated counters
     # plus PER-REPLICA fog gauges (fleet="r" label — the second PR-4
     # follow-up; replicas are not averaged away in the scrape)
-    from ..parallel.fleet import fleet_busy_fractions_per_replica
+    from ..parallel.fleet import (
+        fleet_busy_fractions_per_replica,
+        fleet_phase_work,
+    )
     from ..telemetry.openmetrics import render_fleet_openmetrics
 
     # .fleet.-namespaced like the other fleet artifacts, so a
@@ -428,6 +449,7 @@ def record_fleet_run(
                 sca["fleet"],
                 fleet_busy_fractions_per_replica(spec, final_batch),
                 hist=hist,
+                phase_work=fleet_phase_work(spec, final_batch),
             )
         )
     paths["om"] = om_path

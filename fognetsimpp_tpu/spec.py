@@ -487,6 +487,15 @@ class WorldSpec:
     telemetry_hist_bins: int = 24
     telemetry_hist_min_ms: float = 0.1  # lowest finite bucket edge
     telemetry_hist_max_ms: float = 10_000.0  # highest finite bucket edge
+    # --- distributed observability (ISSUE 11) --------------------------
+    # Shard count of the TP (task-table-sharded) world view this spec
+    # describes: 0 for unsharded worlds; run_tp_sharded stamps the mesh
+    # size here (telemetry-on runs only) so the per-shard exchange-plane
+    # telemetry leaves (TelemetryState.exg_*) carry real dimensions and
+    # host readers (.sca.json rows, fns_tp_exchange_* OpenMetrics
+    # families, Perfetto shard lanes) know the shard axis.  Static under
+    # jit; the single-device engine never reads it.
+    tp_shards: int = 0
 
     # --- misc ----------------------------------------------------------
     bug_compat: BugCompat = BugCompat()
@@ -621,6 +630,14 @@ class WorldSpec:
         )
 
     @property
+    def telemetry_tp_shards(self) -> int:
+        """Rows of the per-shard TP exchange-plane telemetry leaves
+        (``TelemetryState.exg_*``): the stamped shard count when the
+        telemetry plane is on, zero otherwise — the same zero-row inert
+        discipline as every other telemetry dimension."""
+        return self.tp_shards if self.telemetry else 0
+
+    @property
     def auto_arrival_window(self) -> int:
         """Window sized from the spec's own arrival rate (VERDICT r3 #4).
 
@@ -650,6 +667,9 @@ class WorldSpec:
         assert self.telemetry_reservoir >= 1, (
             "telemetry_reservoir sizes the per-tick sample reservoir "
             "(>= 1 row)"
+        )
+        assert self.tp_shards >= 0, (
+            "tp_shards is a shard count (0 = unsharded world view)"
         )
         if self.telemetry_hist:
             assert self.telemetry, (

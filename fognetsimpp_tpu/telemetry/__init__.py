@@ -39,6 +39,15 @@ observed what happens *inside* the jitted tick loop.  Three planes:
   and compile-latency stats (``compile_cache.compile_stats``) in every
   exposition.
 
+* **Distributed observability** (ISSUE 11): all three planes extend to
+  the sharded execution paths — per-shard ``phase_work`` attribution
+  under TP (bit-equal to the single-device profile), device-resident
+  exchange-plane gauges (:func:`.metrics.exchange_summary`,
+  ``fns_tp_exchange_*{shard}``), the latency histogram riding the
+  shards, and the sharded health plane (:func:`.live.serve_tp_run`:
+  ``--serve --tp N`` with a defer-rate watchdog and per-shard flight
+  recorder hashes).
+
 Only :mod:`.metrics` and :mod:`.health`'s device half are imported
 here: the exporter modules import ``state``/``recorder`` and would
 otherwise cycle with ``state.py``'s ``TelemetryState`` import.
@@ -54,6 +63,7 @@ from .metrics import (  # noqa: F401
     RES_FIELDS,
     TelemetryState,
     busy_fractions,
+    exchange_summary,
     init_telemetry_state,
     telemetry_summary,
 )

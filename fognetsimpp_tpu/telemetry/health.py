@@ -78,7 +78,36 @@ def accumulate_latency(spec: WorldSpec, telem, tasks, t1: jax.Array):
     Pure function of its arguments (simlint R3) and a
     :class:`TelemetryState` endomorphism, so it rides the scan carry
     and the fleet's replica ``vmap`` unchanged.  Only traced when
-    ``spec.telemetry_hist`` is on.
+    ``spec.telemetry_hist`` is on.  Delegates the due/bucket
+    arithmetic to :func:`latency_hist_delta` — ONE definition shared
+    with the TP tick's fold, so the two paths cannot drift.
+    """
+    hist_d, sum_d, seen = latency_hist_delta(spec, telem, tasks, t1)
+    return telem.replace(
+        lat_hist=telem.lat_hist + hist_d,
+        lat_sum=telem.lat_sum + sum_d,
+        lat_seen=seen,
+    )
+
+
+def latency_hist_delta(spec: WorldSpec, telem, tasks, t1: jax.Array):
+    """The streaming-histogram accumulation arithmetic, as DELTAS.
+
+    The single definition of the due mask (DONE, fog-executed, status-6
+    ack landed, not yet seen), the log-bucket ``searchsorted`` (first
+    edge >= latency — the cumulative ``le`` semantics of the
+    exposition, bucket B-1 = +Inf) and the per-fog scatter-adds.
+    :func:`accumulate_latency` folds the deltas in place
+    (single-device / fleet); the TP tick ``psum``s them into the
+    replicated histogram (ISSUE 11) — integer scatter-adds, so the
+    cross-shard fold is bit-identical to the single-device scatter,
+    while the f32 ``sum_delta`` fold is order-sensitive and documented
+    as 1e-6-agreeing, not bit-exact (tests/test_tp_telemetry.py pins
+    both).
+
+    Returns ``(hist_delta (F, B) i32, sum_delta (F,) f32,
+    lat_seen' (T,) i8)``; under TP the seen flag stays shard-local
+    (each task is owned by exactly one shard).
     """
     B, F = spec.telemetry_hist_bins, spec.n_fogs
     i32 = jnp.int32
@@ -90,17 +119,17 @@ def accumulate_latency(spec: WorldSpec, telem, tasks, t1: jax.Array):
         & (telem.lat_seen == 0)
     )
     lat = tasks.t_ack6 - tasks.t_create  # (T,) f32 seconds
-    # searchsorted(side='left'): first bucket whose edge >= lat — the
-    # cumulative `le` semantics of the exposition, bucket B-1 = +Inf
     b = jnp.searchsorted(edges, lat).astype(i32)
     fog = jnp.clip(tasks.fog, 0, F - 1)
     add = due.astype(i32)
-    hist = telem.lat_hist.reshape(-1).at[fog * B + b].add(add)
-    return telem.replace(
-        lat_hist=hist.reshape(F, B),
-        lat_sum=telem.lat_sum.at[fog].add(jnp.where(due, lat, 0.0)),
-        lat_seen=jnp.maximum(telem.lat_seen, due.astype(jnp.int8)),
+    hist_d = (
+        jnp.zeros((F * B,), i32).at[fog * B + b].add(add).reshape(F, B)
     )
+    sum_d = jnp.zeros((F,), jnp.float32).at[fog].add(
+        jnp.where(due, lat, 0.0)
+    )
+    seen = jnp.maximum(telem.lat_seen, due.astype(jnp.int8))
+    return hist_d, sum_d, seen
 
 
 # ----------------------------------------------------------------------
@@ -214,6 +243,44 @@ def state_hash(state) -> str:
     for leaf in jax.tree.leaves(state):
         h.update(np.asarray(leaf).tobytes())
     return h.hexdigest()
+
+
+def shard_state_hashes(spec: WorldSpec, state, n_shards: int) -> list:
+    """Per-shard sha256 fingerprints of a TP world state (host fetch).
+
+    Hashes exactly the rows shard ``s`` OWNS under the task-table
+    sharding (``parallel/taskshard``): its user block, its task block,
+    its user-node block and (when the latency histogram rides the
+    shard) its ``lat_seen`` block.  The replicated fog/broker state is
+    deliberately excluded — it is bit-coherent by construction, so a
+    divergence there would show in every shard at once and tell the
+    post-mortem nothing.  The flight recorder stores one list per
+    chunk; ``tools/postmortem.py --diff`` walks two runs' lists and
+    reports WHICH shard diverged first.
+    """
+    U, S = spec.n_users, spec.max_sends_per_user
+    if n_shards <= 0 or U % n_shards:
+        return []
+    U_loc = U // n_shards
+    T_loc = U_loc * S
+    users = [np.asarray(x) for x in jax.tree.leaves(state.users)]
+    tasks = [np.asarray(x) for x in jax.tree.leaves(state.tasks)]
+    nodes = [np.asarray(x) for x in jax.tree.leaves(state.nodes)]
+    seen = np.asarray(state.telem.lat_seen)
+    out = []
+    for s in range(n_shards):
+        u0, t0 = s * U_loc, s * T_loc
+        h = hashlib.sha256()
+        for leaf in users:
+            h.update(leaf[u0 : u0 + U_loc].tobytes())
+        for leaf in tasks:
+            h.update(leaf[t0 : t0 + T_loc].tobytes())
+        for leaf in nodes:  # node layout: [users | fogs | broker | ...]
+            h.update(leaf[u0 : u0 + U_loc].tobytes())
+        if seen.size:
+            h.update(seen[t0 : t0 + T_loc].tobytes())
+        out.append(h.hexdigest())
+    return out
 
 
 def find_nonfinite(state) -> Dict[str, str]:

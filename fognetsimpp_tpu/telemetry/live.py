@@ -40,7 +40,13 @@ import numpy as np
 from ..spec import WorldSpec
 
 #: Signals the watchdog tracks, derived per chunk from reservoir rows.
-WATCH_SIGNALS = ("q_depth", "busy_frac", "drop_rate", "defer")
+#: ``defer_rate`` (ISSUE 11) is the per-row DELTA of the cumulative
+#: ``defer_total`` reservoir column — the per-tick ``n_deferred`` gauge
+#: (the ``defer`` signal) sits constant under sustained exchange-window
+#: overflow because the tick-keyed rotation spreads deferral evenly, so
+#: only the rate signal can page before a shard starves.
+WATCH_SIGNALS = ("q_depth", "busy_frac", "drop_rate", "defer",
+                 "defer_rate")
 
 
 class Ewma:
@@ -110,14 +116,39 @@ class Watchdog:
         z_threshold: float = 4.0,
         alpha: float = 0.3,
         warmup: int = 3,
+        defer_rate_floor: float = 1.0,
+        row_ticks: float = 1.0,
+        anomaly_capacity: int = 256,
     ):
         self.n_fogs = max(int(n_fogs), 1)
         self.z_threshold = float(z_threshold)
+        # the defer-rate signal gets an ABSOLUTE trip on top of the
+        # z-score (ISSUE 11): sustained exchange-window overflow from
+        # tick 0 is a CONSTANT rate — zero variance, z ~ 0 forever —
+        # yet it is exactly the condition that starves a shard.  Any
+        # chunk whose mean deferred-PER-TICK rate exceeds the floor
+        # pages, warmup or not.  ``row_ticks`` (the reservoir stride:
+        # ticks covered per row) normalizes the per-row cumulative
+        # delta into that per-tick unit, so the floor means the same
+        # thing at any horizon — serve_run passes the spec's stride.
+        # The EWMA floors (Ewma rel/abs) still apply to its z-score
+        # like every other signal.
+        self.defer_rate_floor = float(defer_rate_floor)
+        self.row_ticks = max(float(row_ticks), 1.0)
         self._trackers = {
             s: Ewma(alpha=alpha, warmup=warmup) for s in WATCH_SIGNALS
         }
         self._last_dropped: Optional[float] = None
-        self.anomalies: List[Dict] = []
+        self._last_deferred: Optional[float] = None
+        # bounded ring (the FlightRecorder discipline): the defer-rate
+        # FLOOR fires on EVERY chunk of a sustained-overflow run by
+        # design — unbounded growth would leak host memory and bloat
+        # late post-mortem manifests.  anomaly_count keeps the true
+        # total for /healthz.
+        self.anomalies: collections.deque = collections.deque(
+            maxlen=int(anomaly_capacity)
+        )
+        self.anomaly_count = 0
         self.last_signals: Dict[str, float] = {}
         self.last_z: Dict[str, float] = {}
 
@@ -139,6 +170,20 @@ class Watchdog:
         )
         sig["drop_rate"] = float(dropped[-1] - prev) / max(dropped.size, 1)
         self._last_dropped = float(dropped[-1])
+        # cumulative-deferred delta (the defer RATE, per TICK: the
+        # chunk's delta over the ticks its rows cover, so the absolute
+        # floor is stride-independent) — rows recorded by a
+        # pre-ISSUE-11 build have no defer_total column; skip then
+        if "defer_total" in rows:
+            deferred = np.asarray(rows["defer_total"], dtype=float)
+            prev_d = (
+                self._last_deferred if self._last_deferred is not None
+                else float(deferred[0])
+            )
+            sig["defer_rate"] = float(deferred[-1] - prev_d) / max(
+                deferred.size * self.row_ticks, 1.0
+            )
+            self._last_deferred = float(deferred[-1])
         return sig
 
     def update(self, signals: Dict[str, float], ticks_done: int) -> List[Dict]:
@@ -150,18 +195,29 @@ class Watchdog:
                 continue
             z = tracker.update(value)
             self.last_z[name] = z
-            if abs(z) > self.z_threshold:
+            tripped = abs(z) > self.z_threshold
+            kind = "z"
+            if (
+                name == "defer_rate"
+                and value > self.defer_rate_floor
+            ):
+                # absolute floor trip: a sustained overflow is constant
+                # (z ~ 0) but still pages — see __init__
+                tripped, kind = True, "floor"
+            if tripped:
                 fired.append(
                     {
                         "signal": name,
                         "value": value,
                         "z": z,
+                        "kind": kind,
                         "mean": tracker.mean,
                         "ticks_done": int(ticks_done),
                     }
                 )
         self.last_signals = dict(signals)
         self.anomalies.extend(fired)
+        self.anomaly_count += len(fired)
         return fired
 
     def update_from_rows(
@@ -242,7 +298,8 @@ class FlightRecorder:
         }
         if watchdog is not None:
             manifest["watchdog"] = {
-                "anomalies": watchdog.anomalies,
+                "anomalies": list(watchdog.anomalies),
+                "anomaly_count": watchdog.anomaly_count,
                 "last_signals": watchdog.last_signals,
                 "last_z": watchdog.last_z,
                 "z_threshold": watchdog.z_threshold,
@@ -355,8 +412,20 @@ def serve_run(
     server: Optional[HealthServer] = None,
     on_chunk: Optional[Callable[[Dict], None]] = None,
     hash_every_chunk: bool = True,
+    run_fn: Optional[Callable] = None,
+    shard_hash_fn: Optional[Callable] = None,
 ):
     """The production serving loop over ``run_chunked``.
+
+    ``run_fn`` swaps the chunked runner: it must accept
+    ``(spec, state, net, bounds, chunk_ticks=..., callback=...)`` and
+    return the final state — :func:`serve_tp_run` passes the TP
+    sharded chunk runner here, so the watchdog/exposition loop is ONE
+    code path whatever the execution substrate.  ``shard_hash_fn``
+    (TP): called with each chunk's host-fetched state, returns the
+    per-shard hash list the flight recorder stores next to the global
+    state hash (``tools/postmortem.py --diff`` bisects WHICH shard
+    diverged first); needs ``hash_every_chunk``.
 
     Returns ``(final_state, status)`` where ``status`` carries the
     server (still live, so late scrapes see the final exposition —
@@ -392,7 +461,13 @@ def serve_run(
             "slo_ms needs spec.telemetry_hist=True (SLO breaches are "
             "derived from the streaming latency histogram)"
         )
-    watchdog = watchdog or Watchdog(spec.n_fogs, z_threshold=z_threshold)
+    if watchdog is None:
+        # the reservoir stride (ticks per row) normalizes the
+        # defer-rate signal to per-tick units, whatever the horizon
+        stride = max(1, -(-spec.n_ticks // max(spec.telemetry_slots, 1)))
+        watchdog = Watchdog(
+            spec.n_fogs, z_threshold=z_threshold, row_ticks=stride
+        )
     recorder = recorder or FlightRecorder()
     if server is None and port is not None:
         server = HealthServer(port=port)
@@ -421,9 +496,18 @@ def serve_run(
             host = jax.device_get(s)
             h = health_state_hash(host)
             bad = find_nonfinite(host)
+            shard_hashes = (
+                shard_hash_fn(host) if shard_hash_fn is not None else None
+            )
         else:
-            h, bad = None, {}
-        recorder.note_chunk(ticks_done, rows=rows, state_hash=h)
+            h, bad, shard_hashes = None, {}, None
+        recorder.note_chunk(
+            ticks_done, rows=rows, state_hash=h,
+            extra=(
+                {"shard_hashes": shard_hashes}
+                if shard_hashes else None
+            ),
+        )
         fired = watchdog.update_from_rows(rows, ticks_done)
         if fired:
             _dump("anomaly", s, detail={"anomalies": fired})
@@ -452,7 +536,7 @@ def serve_run(
             "wall_s": round(time.perf_counter() - progress["t0"], 3),
             "signals": watchdog.last_signals,
             "z": watchdog.last_z,
-            "anomalies": len(watchdog.anomalies),
+            "anomalies": watchdog.anomaly_count,
             "nonfinite": sorted(bad),
             **(
                 {"slo_ms": slo_ms, "slo_breaches": breaches}
@@ -488,7 +572,7 @@ def serve_run(
             on_chunk(health)
 
     try:
-        final = run_chunked(
+        final = (run_fn or run_chunked)(
             spec, state, net, bounds,
             chunk_ticks=chunk_ticks, callback=_chunk_cb,
         )
@@ -511,9 +595,84 @@ def serve_run(
         "watchdog": watchdog,
         "recorder": recorder,
         "chunks": progress["chunks"],
-        "anomalies": len(watchdog.anomalies),
+        "anomalies": watchdog.anomaly_count,
         "slo_breaches": slo_state["breaches"],
         "dumps": list(recorder.dumps),
         "scalars": summarize(final),
     }
     return final, status
+
+
+def serve_tp_run(
+    spec: WorldSpec,
+    state,
+    net,
+    bounds=None,
+    mesh=None,
+    exchange_window: Optional[int] = None,
+    **kw,
+):
+    """The sharded health plane (ISSUE 11): :func:`serve_run` over the
+    TP task-table-sharded tick.
+
+    ONE serving loop, two substrates: the chunk runner becomes
+    ``parallel/taskshard.run_tp_chunked`` (each chunk one cached
+    shard_map program, carry row-sharded between chunks), the flight
+    recorder additionally stores PER-SHARD state hashes
+    (:func:`telemetry.health.shard_state_hashes`) so
+    ``tools/postmortem.py --diff`` can bisect which shard diverged
+    first, and the exposition gains the ``fns_tp_exchange_*{shard}``
+    families because the stamped spec carries the shard axis.  The
+    spec is padded/stamped UP FRONT (before the loop) so every render
+    sees the world it is actually serving; returns
+    ``(spec, final_state, status)``.
+
+    Accepts every :func:`serve_run` keyword (``chunk_ticks``, ``port``,
+    ``slo_ms``, ``dump_dir``, ``on_chunk``, ...).  The watchdog's
+    defer-rate signal matters most here: the TP exchange window DEFERS
+    overflow instead of dropping, so the drop-rate signal is blind to
+    a starving shard — the defer-rate floor is the trip that pages.
+    """
+    import functools
+
+    from ..parallel.taskshard import (
+        pad_users_to_multiple,
+        run_tp_chunked,
+        stamp_tp_telemetry,
+    )
+    from .health import shard_state_hashes
+
+    if mesh is None:
+        raise ValueError("serve_tp_run needs a Mesh (parallel.make_mesh)")
+    if not spec.telemetry:
+        raise ValueError(
+            "serve_tp_run needs spec.telemetry=True (the health plane "
+            "reads the device-resident reservoir)"
+        )
+    # pad + stamp ONCE, before the loop, so the first render already
+    # sees the world actually being served (the chunk runner's own
+    # setup re-derives the identical spec/state — idempotent)
+    n_shards = int(
+        mesh.shape["node"] if "node" in mesh.shape else mesh.devices.size
+    )
+    if spec.n_users % n_shards:
+        spec, state, net = pad_users_to_multiple(spec, state, net, n_shards)
+    spec, state = stamp_tp_telemetry(spec, state, n_shards)
+
+    def _runner(sp, st, nt, bd, chunk_ticks, callback):
+        _, final = run_tp_chunked(
+            sp, st, nt, bd, mesh, chunk_ticks=chunk_ticks,
+            callback=callback, exchange_window=exchange_window,
+        )
+        return final
+
+    final, status = serve_run(
+        spec, state, net, bounds,
+        run_fn=_runner,
+        shard_hash_fn=functools.partial(
+            shard_state_hashes, spec, n_shards=n_shards
+        ),
+        **kw,
+    )
+    status["tp_shards"] = n_shards
+    return spec, final, status

@@ -52,6 +52,15 @@ PHASES = (
     "local_completions",
     "learn_credit",
     "latency_hist",
+    # --- TP exchange-plane slots (ISSUE 11): booked only by the
+    # sharded tick (parallel/taskshard._tp_tick), zero on every
+    # single-device path.  The established slots above book the SAME
+    # work deltas under TP as on one device (shard-partial deltas
+    # folded in the end-of-tick psum), so summing those over shards
+    # reproduces the single-device profile bit-for-bit; these two
+    # carry the TP-only quantities a single device has no analog for.
+    "tp_exchange",  # candidate slots seated in the exchange window
+    "tp_defer",  # candidates deferred at the exchange window (overflow)
 )
 PHASE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(PHASES)}
 
@@ -59,9 +68,25 @@ PHASE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(PHASES)}
 #: queue-overflow count) joined in r6: the live watchdog derives its
 #: per-chunk drop RATE from consecutive rows' deltas
 #: (telemetry/live.py), so the signal must ride the reservoir.
+#: ``defer_total`` (cumulative deferred-arrival count, the running
+#: ``defer_sum``) joined in ISSUE 11: the per-tick ``n_deferred`` gauge
+#: sits CONSTANT under sustained exchange-window overflow (the
+#: tick-keyed rotation spreads deferral evenly), so a z-score watchdog
+#: on the gauge never fires — the defer RATE from consecutive
+#: cumulative samples is the signal that pages.
 RES_FIELDS = (
     "t", "q_len_total", "n_busy", "n_deferred", "n_completed", "n_dropped",
+    "defer_total",
 )
+
+#: Finite bucket upper edges of the per-shard exchange-window OCCUPANCY
+#: histogram (occupancy fraction = candidates CONTENDING for the window
+#: — i.e. surviving the saturated-fog fast drop — / window slots;
+#: > 1.0 means overflow -> deferral.  The separate ``exg_cand_sum``
+#: counter is the PRE-drop production count).  Static, shared by the
+#: device accumulation and every host reader; the last bucket is +Inf.
+EXG_OCC_EDGES = (0.25, 0.5, 0.75, 0.9, 1.0)
+EXG_OCC_BINS = len(EXG_OCC_EDGES) + 1
 
 
 @struct.dataclass
@@ -93,6 +118,26 @@ class TelemetryState:
     lat_sum: jax.Array  # (Fh,) f32 per-fog latency sum (seconds) — the
     #   OpenMetrics histogram `_sum` series
     lat_seen: jax.Array  # (Th,) i8 per-task counted flag (exactly-once)
+    # --- TP exchange-plane telemetry (spec.tp_shards, ISSUE 11) -------
+    # Per-shard gauges of the ring arrival exchange, accumulated by the
+    # sharded tick's end-of-tick telemetry fold (parallel/taskshard).
+    # All leaves are zero-row unless the spec is a stamped TP world view
+    # with telemetry on (spec.telemetry_tp_shards > 0).
+    exg_occ_hist: jax.Array  # (Sm, EXG_OCC_BINS) i32 per-shard histogram
+    #   of per-tick exchange-window occupancy fraction (last = overflow)
+    exg_occ_sum: jax.Array  # (Sm,) f32 occupancy-fraction sum over ticks
+    #   (the fns_tp_exchange_occupancy histogram `_sum`)
+    exg_cand_sum: jax.Array  # (Sm,) i32 arrival candidates produced
+    exg_defer_sum: jax.Array  # (Sm,) i32 candidates deferred at the
+    #   exchange window (overflow; the engine's K-window defer contract)
+    exg_defer_max: jax.Array  # (Sm,) i32 max per-tick deferred count
+    exg_util_sum: jax.Array  # (Sm,) f32 ppermute payload utilization
+    #   (seated slots / window slots) summed over ticks
+    exg_age_max: jax.Array  # (Sm,) f32 max tick-age of a deferred
+    #   candidate (how long the oldest waiting arrival sat unseated)
+    exg_occ_res: jax.Array  # (Rm, Sm) f32 strided per-tick per-shard
+    #   occupancy rows (same stride as `res`): the Perfetto per-shard
+    #   counter lanes and live dashboards read these
 
 
 def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
@@ -117,6 +162,27 @@ def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
         ),
         lat_sum=jnp.zeros((spec.telemetry_hist_fogs,), f32),
         lat_seen=jnp.zeros((spec.telemetry_hist_tasks,), jnp.int8),
+        **init_exchange_leaves(spec),
+    )
+
+
+def init_exchange_leaves(spec: WorldSpec) -> Dict[str, jax.Array]:
+    """The t=0 TP exchange-plane leaves for ``spec`` (zero-row when the
+    spec is not a telemetry-on TP world view).  Split out so
+    ``run_tp_sharded`` can extend a single-device world's telemetry
+    state in place when it stamps ``spec.tp_shards``."""
+    Sm = spec.telemetry_tp_shards
+    Rm = spec.telemetry_slots if Sm else 0
+    f32, i32 = jnp.float32, jnp.int32
+    return dict(
+        exg_occ_hist=jnp.zeros((Sm, EXG_OCC_BINS), i32),
+        exg_occ_sum=jnp.zeros((Sm,), f32),
+        exg_cand_sum=jnp.zeros((Sm,), i32),
+        exg_defer_sum=jnp.zeros((Sm,), i32),
+        exg_defer_max=jnp.zeros((Sm,), i32),
+        exg_util_sum=jnp.zeros((Sm,), f32),
+        exg_age_max=jnp.zeros((Sm,), f32),
+        exg_occ_res=jnp.zeros((Rm, Sm), f32),
     )
 
 
@@ -211,12 +277,72 @@ def accumulate_tick(
                 metrics.n_deferred.astype(f32),
                 metrics.n_completed.astype(f32),
                 metrics.n_dropped.astype(f32),
+                # cumulative deferred count INCLUDING this tick (the
+                # defer_sum update above ran first): the watchdog's
+                # defer-rate signal needs a monotone column, like
+                # n_dropped next to it
+                telem.defer_sum.astype(f32),
             ]
         )
         telem = telem.replace(
             res=telem.res.at[jnp.where(write, slot, R)].set(
                 row, mode="drop"
             )
+        )
+    return telem
+
+
+def accumulate_exchange(
+    spec: WorldSpec,
+    telem: TelemetryState,
+    occ: jax.Array,
+    util: jax.Array,
+    age: jax.Array,
+    cand: jax.Array,
+    defer: jax.Array,
+    tick: jax.Array,
+) -> TelemetryState:
+    """Fold one tick's psum-gathered per-shard exchange vectors.
+
+    All five inputs are replicated ``(S,)`` f32 vectors — the sharded
+    tick builds them as one-hot columns (each shard fills only its own
+    slot) and a single ``psum`` assembles the full per-shard view, so
+    every shard folds identical values and the replicated telemetry
+    state stays bit-coherent.  ``cand``/``defer`` are integer-valued
+    f32 (bounded by the per-shard candidate capacity, far below 2^24 —
+    ``taskshard._tp_setup`` asserts the bound at build time) and cast
+    back exactly.  Pure function of its arguments and a
+    :class:`TelemetryState` endomorphism; only traced when the spec is
+    a telemetry-on TP world view.
+    """
+    f32, i32 = jnp.float32, jnp.int32
+    edges = jnp.asarray(EXG_OCC_EDGES, f32)
+    # searchsorted(side='left'): first bucket whose edge >= occ — the
+    # same cumulative `le` convention as the latency histogram
+    b = jnp.searchsorted(edges, occ).astype(i32)
+    onehot = (
+        b[:, None] == jnp.arange(EXG_OCC_BINS, dtype=i32)[None, :]
+    ).astype(i32)
+    telem = telem.replace(
+        exg_occ_hist=telem.exg_occ_hist + onehot,
+        exg_occ_sum=telem.exg_occ_sum + occ,
+        exg_cand_sum=telem.exg_cand_sum + cand.astype(i32),
+        exg_defer_sum=telem.exg_defer_sum + defer.astype(i32),
+        exg_defer_max=jnp.maximum(
+            telem.exg_defer_max, defer.astype(i32)
+        ),
+        exg_util_sum=telem.exg_util_sum + util,
+        exg_age_max=jnp.maximum(telem.exg_age_max, age),
+    )
+    Rm = telem.exg_occ_res.shape[0]
+    if Rm > 0:
+        stride = max(1, -(-spec.n_ticks // Rm))
+        slot = (tick // stride).astype(i32)
+        write = (tick % stride) == 0
+        telem = telem.replace(
+            exg_occ_res=telem.exg_occ_res.at[
+                jnp.where(write, slot, Rm)
+            ].set(occ, mode="drop")
         )
     return telem
 
@@ -267,6 +393,48 @@ def busy_fractions(spec: WorldSpec, final) -> Optional[np.ndarray]:
     return np.asarray(final.telem.busy_ticks, np.float64) / ticks
 
 
+def exchange_summary(spec: WorldSpec, final) -> Optional[Dict]:
+    """Host roll-up of the per-shard TP exchange-plane telemetry.
+
+    ``None`` unless ``final`` carries stamped exchange leaves
+    (``spec.telemetry_tp_shards > 0``).  The returned per-shard vectors
+    are THE values every exposition publishes — ``runtime/recorder.py``
+    (``.sca.json`` ``tp_shard`` rows), ``telemetry/openmetrics.py``
+    (``fns_tp_exchange_*`` families) and ``telemetry/timeline.py``
+    (per-shard Perfetto lanes) all read this one dict, the
+    ``busy_fractions`` single-source discipline.
+    """
+    if not spec.telemetry or spec.telemetry_tp_shards == 0:
+        return None
+    t = final.telem
+    S = t.exg_cand_sum.shape[0]
+    if S == 0:
+        return None
+    ticks = max(int(np.asarray(t.ticks)), 1)
+    res = np.asarray(t.res, np.float64)
+    occ_res = np.asarray(t.exg_occ_res, np.float64)
+    Rm = occ_res.shape[0]
+    stride = max(1, -(-spec.n_ticks // Rm)) if Rm else 1
+    n_rows = min(Rm, -(-ticks // stride)) if Rm else 0
+    return {
+        "n_shards": S,
+        "ticks": ticks,
+        "occ_edges": list(EXG_OCC_EDGES),
+        "occ_hist": np.asarray(t.exg_occ_hist, np.int64),  # (S, B)
+        "occ_sum": np.asarray(t.exg_occ_sum, np.float64),
+        "occ_mean": np.asarray(t.exg_occ_sum, np.float64) / ticks,
+        "cand": np.asarray(t.exg_cand_sum, np.int64),
+        "defer_sum": np.asarray(t.exg_defer_sum, np.int64),
+        "defer_max": np.asarray(t.exg_defer_max, np.int64),
+        "util_mean": np.asarray(t.exg_util_sum, np.float64) / ticks,
+        "age_max_ticks": np.asarray(t.exg_age_max, np.float64),
+        # strided per-tick rows for the Perfetto lanes: (rows, S)
+        # occupancy plus the matching reservoir timestamps
+        "occ_rows": occ_res[:n_rows],
+        "occ_rows_t": res[:n_rows, 0] if n_rows else np.zeros((0,)),
+    }
+
+
 def telemetry_summary(spec: WorldSpec, final) -> Optional[Dict]:
     """Host-side roll-up of a finished telemetry-on run.
 
@@ -299,4 +467,6 @@ def telemetry_summary(spec: WorldSpec, final) -> Optional[Dict]:
         "reservoir": {
             f: res[:n_rows, i] for i, f in enumerate(RES_FIELDS)
         },
+        # per-shard TP exchange-plane roll-up (None off the TP path)
+        "tp_exchange": exchange_summary(spec, final),
     }

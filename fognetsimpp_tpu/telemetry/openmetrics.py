@@ -118,6 +118,65 @@ def _render_latency_hist(
             )
 
 
+def _render_tp_exchange(lines: List[str], ex: Dict) -> None:
+    """Emit the per-shard TP exchange-plane families (ISSUE 11).
+
+    ``ex`` is :func:`telemetry.metrics.exchange_summary`'s dict — the
+    single source the recorder's ``.sca.json`` ``tp_shard`` rows and
+    the Perfetto shard lanes also read.  The occupancy family is a
+    real OpenMetrics histogram (one label-group per shard) and obeys
+    the bucket contract ``tools/check_openmetrics.py`` enforces:
+    cumulative counts, ascending ``le``, ``+Inf`` terminal, ``_count``
+    == the +Inf bucket, ``_sum`` present.
+    """
+    import numpy as np
+
+    S = ex["n_shards"]
+    _family(lines, "tp_shards", help_text="task-table shard count")
+    _sample(lines, "tp_shards", S)
+    fam = "tp_exchange_occupancy"
+    _family(
+        lines, fam, "histogram",
+        help_text="per-tick exchange-window occupancy fraction per "
+        "shard (candidates surviving the saturated-fog fast drop / "
+        "window slots; > 1 defers)",
+    )
+    edges = ex["occ_edges"]
+    for s in range(S):
+        cum = np.cumsum(ex["occ_hist"][s])
+        for b, e in enumerate(edges):
+            _sample(
+                lines, f"{fam}_bucket", cum[b],
+                labels=f'{{shard="{s}",le="{_fmt_le(e)}"}}',
+            )
+        _sample(
+            lines, f"{fam}_bucket", cum[-1],
+            labels=f'{{shard="{s}",le="+Inf"}}',
+        )
+        _sample(
+            lines, f"{fam}_sum", ex["occ_sum"][s],
+            labels=f'{{shard="{s}"}}',
+        )
+        _sample(
+            lines, f"{fam}_count", cum[-1], labels=f'{{shard="{s}"}}'
+        )
+    for name, vec, kind, h in (
+        ("tp_exchange_candidates", ex["cand"], "counter",
+         "arrival candidates produced per shard"),
+        ("tp_exchange_deferred", ex["defer_sum"], "counter",
+         "candidates deferred at the exchange window per shard"),
+        ("tp_exchange_deferred_max", ex["defer_max"], "gauge",
+         "max per-tick deferred candidates per shard"),
+        ("tp_exchange_utilization", ex["util_mean"], "gauge",
+         "mean ppermute payload utilization per shard"),
+        ("tp_exchange_defer_age_ticks_max", ex["age_max_ticks"],
+         "gauge", "max tick-age of a deferred candidate per shard"),
+    ):
+        _family(lines, name, kind, help_text=h)
+        for s in range(S):
+            _sample(lines, name, vec[s], labels=f'{{shard="{s}"}}')
+
+
 def _render_compile_stats(lines: List[str]) -> None:
     """Compile-latency observability (ISSUE 6): the persistent-cache
     hit/miss counters and backend compile seconds from
@@ -181,6 +240,10 @@ def render_openmetrics(
         _sample(lines, "telemetry_ticks", summ["ticks"])
         _family(lines, "deferred_sum")
         _sample(lines, "deferred_sum", summ["defer_sum"])
+        # per-shard TP exchange-plane families (ISSUE 11): present only
+        # on stamped TP runs (spec.tp_shards > 0)
+        if summ.get("tp_exchange") is not None:
+            _render_tp_exchange(lines, summ["tp_exchange"])
     # streaming latency histogram (spec.telemetry_hist, ISSUE 6)
     if hist is None:
         from .health import hist_summary
@@ -201,6 +264,7 @@ def render_fleet_openmetrics(
     fleet_scalars: Dict,
     busy_frac: Optional[np.ndarray] = None,
     hist: Optional[Dict] = None,
+    phase_work: Optional[np.ndarray] = None,
 ) -> str:
     """OpenMetrics text for a fleet run's scalars.
 
@@ -246,6 +310,22 @@ def render_fleet_openmetrics(
                 _sample(
                     lines, "fleet_fog_busy_fraction", bf[f],
                     labels=f'{{fog="{f}"}}',
+                )
+    if phase_work is not None:
+        # per-replica phase attribution (ISSUE 11): one sample per
+        # (fleet=replica, phase), the per-replica busy-frac discipline
+        from .metrics import PHASES
+
+        pw = np.asarray(phase_work)
+        _family(
+            lines, "fleet_phase_work",
+            help_text="per-replica per-phase work counters",
+        )
+        for r in range(pw.shape[0]):
+            for p, name in enumerate(PHASES):
+                _sample(
+                    lines, "fleet_phase_work", pw[r, p],
+                    labels=f'{{fleet="{r}",phase="{name}"}}',
                 )
     if hist is not None:
         _render_latency_hist(lines, hist, family="fleet_task_latency")

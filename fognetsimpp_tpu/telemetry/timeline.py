@@ -250,6 +250,40 @@ def _replica_events(
     return events
 
 
+def _tp_exchange_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
+    """Per-SHARD exchange-plane counter lanes (ISSUE 11).
+
+    One dedicated "tp-exchange" process whose threads are counter
+    tracks ``shard{s} exchange_occ`` — the strided per-tick
+    exchange-window occupancy rows the sharded tick folded into
+    ``TelemetryState.exg_occ_res``, timestamped from the matching
+    reservoir rows.  Empty on non-TP (or telemetry-off) runs, so every
+    existing trace is byte-identical.
+    """
+    from .metrics import exchange_summary
+
+    ex = exchange_summary(spec, final)
+    if ex is None or ex["occ_rows"].size == 0:
+        return []
+    events: List[Dict] = []
+    ts = _us(ex["occ_rows_t"])
+    for s in range(ex["n_shards"]):
+        events.extend(
+            _counter(
+                f"shard{s} exchange_occ", pid, ts[i], "occ",
+                ex["occ_rows"][i, s],
+            )
+            for i in range(len(ts))
+        )
+    events.append(
+        {
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": "tp-exchange"},
+        }
+    )
+    return events
+
+
 def build_trace(
     spec: WorldSpec, final: WorldState, max_tasks: Optional[int] = None
 ) -> Dict:
@@ -274,6 +308,9 @@ def build_trace(
         events.extend(
             _replica_events(spec, rep_cols, pid=r, max_tasks=max_tasks)
         )
+    if not batched:
+        # per-shard exchange lanes on TP runs (no-op everywhere else)
+        events.extend(_tp_exchange_events(spec, final, pid=n_rep))
     # metadata first, then spans by (ts, -dur): a parent span sorts
     # before its children, and Perfetto/golden checks see monotone ts
     events.sort(

@@ -82,6 +82,12 @@ _QUICK_FILES = {
     # 8-virtual-device mesh + the ring-exchange units — the same
     # tier-1 contract as the fleet runner's equivalence gate
     "test_tp.py",
+    # distributed observability (ISSUE 11): per-shard phase-work /
+    # exchange-gauge / hist A/Bs vs the single-device profile, the
+    # serve --tp defer-rate watchdog + postmortem shard bisection, and
+    # the host-side exposition/linter units — the sharded paths must
+    # stay as inspectable as one device, gated in the edit loop
+    "test_tp_telemetry.py",
 }
 
 

@@ -1,0 +1,525 @@
+"""Distributed observability gates (ISSUE 11).
+
+Three planes over the sharded execution paths:
+
+* **Per-shard phase attribution** — under TP every established
+  ``phase_work`` slot must equal the single-device profile BIT-FOR-BIT
+  (shard-partial bracket deltas folded in the end-of-tick psum; the
+  replicated half booked once), while the two new exchange slots
+  (``tp_exchange``/``tp_defer``) carry the TP-only quantities a single
+  device has no analog for.
+* **Exchange-plane telemetry** — per-shard occupancy histogram /
+  candidate / defer / utilization / age gauges riding
+  ``TelemetryState`` (zero-row and bit-exact when off), exposed as
+  ``fns_tp_exchange_*{shard=...}`` OpenMetrics families, ``.sca.json``
+  ``tp_shard`` rows and Perfetto per-shard counter lanes.
+* **Sharded health plane** — ``serve_tp_run`` (``--serve --tp N``)
+  serves live OpenMetrics + ``/healthz`` over the TP chunk runner; a
+  forced sustained-overflow world trips the defer-RATE watchdog (the
+  per-tick gauge is constant under rotation, so only the cumulative
+  delta can page) and the flight recorder's per-shard hashes let
+  ``tools/postmortem.py --diff`` name the diverging shard.
+
+Compile budget: the quick tier compiles THREE TP programs (telemetry,
+hist, the overflow/serve world); the run_jit/run_chunked cross-entry
+A/Bs and the CLI composition smoke ride the slow tier.
+"""
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, run
+from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+from fognetsimpp_tpu.parallel import (
+    make_mesh,
+    run_tp_chunked,
+    run_tp_sharded,
+)
+from fognetsimpp_tpu.scenarios import smoke
+from fognetsimpp_tpu.telemetry.metrics import (
+    EXG_OCC_BINS,
+    PHASE_INDEX,
+    PHASES,
+    RES_FIELDS,
+    exchange_summary,
+    telemetry_summary,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "tools")
+)
+
+SMALL = dict(
+    n_users=16, n_fogs=3, send_interval=0.01, horizon=0.2,
+    start_time_max=0.05,
+)
+
+#: TP-only phase_work slots: zero on every single-device path.
+_TP_SLOTS = (PHASE_INDEX["tp_exchange"], PHASE_INDEX["tp_defer"])
+_SHARED = [i for i in range(len(PHASES)) if i not in _TP_SLOTS]
+
+
+def _hash(state, skip=()) -> str:
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if any(s in jax.tree_util.keystr(path) for s in skip):
+            continue
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+def _tp(spec, state, net, bounds, mesh, **kw):
+    kw.setdefault("donate", True)
+    return run_tp_sharded(
+        spec, jax.tree.map(jnp.copy, state), net, bounds, mesh, **kw
+    )
+
+
+@pytest.fixture(scope="module")
+def node_mesh():
+    assert len(jax.devices()) == 8, "conftest must provision 8 devices"
+    return make_mesh(8, axis_name="node")
+
+
+# ----------------------------------------------------------------------
+# per-shard phase attribution
+# ----------------------------------------------------------------------
+
+def test_phase_work_books_identically_under_tp(node_mesh):
+    """Sum over shards of per-phase work == the single-device profile,
+    bit-for-bit, on every established slot; the TP-only exchange slots
+    are nonzero under TP and zero on the reference; every OTHER
+    telemetry leaf (gauges, reservoir incl. the new defer_total column,
+    counters) is bit-equal; the non-telemetry state is bit-exact."""
+    spec, state, net, bounds = _build(telemetry=True)
+    ref, _ = run(spec, state, net, bounds)
+    spec2, got = _tp(spec, state, net, bounds, node_mesh)
+    assert spec2.tp_shards == 8
+
+    pw_ref = np.asarray(ref.telem.phase_work)
+    pw_tp = np.asarray(got.telem.phase_work)
+    np.testing.assert_array_equal(pw_ref[_SHARED], pw_tp[_SHARED])
+    assert pw_ref[_SHARED].sum() > 0  # the profile is not trivially zero
+    assert (pw_ref[list(_TP_SLOTS)] == 0).all()
+    assert pw_tp[PHASE_INDEX["tp_exchange"]] > 0
+    # hloaudit attributes the same phases in the compiled tp_tick
+    # manifest via the jax.named_scope bracket this booking shares
+
+    # every other telemetry leaf bit-equal (exchange leaves are TP-only)
+    for f in dataclasses.fields(ref.telem):
+        if f.name in ("phase_work",) or f.name.startswith("exg_"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.telem, f.name)),
+            np.asarray(getattr(got.telem, f.name)),
+            err_msg=f.name,
+        )
+    assert "defer_total" in RES_FIELDS  # the watchdog's rate column
+    # ...and the simulation itself is bit-exact
+    assert _hash(ref, skip=("telem",)) == _hash(got, skip=("telem",))
+
+    # exchange-plane roll-up sanity on the same run
+    ex = exchange_summary(spec2, got)
+    ticks = int(np.asarray(got.telem.ticks))
+    assert ex["n_shards"] == 8
+    assert ex["occ_hist"].shape == (8, EXG_OCC_BINS)
+    np.testing.assert_array_equal(ex["occ_hist"].sum(axis=1), ticks)
+    # candidates were produced (a decided task becomes a candidate the
+    # tick its broker->fog hop lands, so the total trails n_scheduled
+    # only by the in-flight tail at horizon end)
+    assert ex["cand"].sum() > 0
+    assert (ex["defer_sum"] == 0).all()  # full window never defers
+    assert (ex["util_mean"] <= 1.0).all()
+    # the strided occupancy rows feed the Perfetto shard lanes
+    assert ex["occ_rows"].shape[1] == 8 and ex["occ_rows"].shape[0] > 0
+    # single-device worlds have no exchange plane at all
+    assert exchange_summary(spec, ref) is None
+    assert np.asarray(ref.telem.exg_cand_sum).shape == (0,)
+
+
+def test_run_node_sharded_keeps_callers_spec_consistent(node_mesh):
+    """The single-return dispatch entry runs UNSTAMPED (stamp=False):
+    the caller's spec must keep describing the returned state — no
+    per-shard exchange leaves materialize behind its back (the
+    telemetry contract would reject them), while phase attribution
+    still books, tp_exchange slot included."""
+    from fognetsimpp_tpu.core.contracts import check_telemetry_contract
+    from fognetsimpp_tpu.parallel.taskshard import run_node_sharded
+
+    spec, state, net, bounds = _build(telemetry=True)
+    ref, _ = run(spec, state, net, bounds)
+    got = run_node_sharded(
+        spec, jax.tree.map(jnp.copy, state), net, bounds, node_mesh
+    )
+    check_telemetry_contract(spec, got)
+    assert np.asarray(got.telem.exg_cand_sum).shape == (0,)
+    pw_r = np.asarray(ref.telem.phase_work)
+    pw_g = np.asarray(got.telem.phase_work)
+    np.testing.assert_array_equal(pw_r[_SHARED], pw_g[_SHARED])
+    assert pw_g[PHASE_INDEX["tp_exchange"]] > 0
+
+
+def test_hist_books_identically_under_tp(node_mesh):
+    """spec.telemetry_hist under TP: per-fog bucket counts and the
+    exactly-once seen flags are BIT-equal to the single-device run
+    (integer scatter-adds commute across the psum fold); the f32
+    lat_sum agrees to 1e-6 (the cross-shard fold changes the float
+    addition grouping — documented, not bit-pinned)."""
+    spec, state, net, bounds = _build(
+        send_interval=0.25, horizon=2.0,
+        telemetry=True, telemetry_hist=True, derive_acks=False,
+    )
+    ref, _ = run(spec, state, net, bounds)
+    spec2, got = _tp(spec, state, net, bounds, node_mesh)
+    a = np.asarray(ref.telem.lat_hist)
+    b = np.asarray(got.telem.lat_hist)
+    np.testing.assert_array_equal(a, b)
+    assert a.sum() > 0  # real samples streamed
+    np.testing.assert_array_equal(
+        np.asarray(ref.telem.lat_seen), np.asarray(got.telem.lat_seen)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref.telem.lat_sum), np.asarray(got.telem.lat_sum),
+        rtol=1e-6,
+    )
+    # phase profile equality holds with the hist phase traced too
+    np.testing.assert_array_equal(
+        np.asarray(ref.telem.phase_work)[_SHARED],
+        np.asarray(got.telem.phase_work)[_SHARED],
+    )
+    assert _hash(ref, skip=("telem",)) == _hash(got, skip=("telem",))
+
+
+@pytest.mark.slow  # extra compiles: full-suite tier
+def test_tp_telemetry_across_worlds_and_entries(node_mesh):
+    """The 3 dense-family policy worlds x run/run_jit/run_chunked:
+    phase_work + hist equality is entry-independent, and a chunked TP
+    run bit-matches the one-shot TP run."""
+    worlds = [
+        dict(policy=int(Policy.MIN_BUSY)),
+        dict(policy=int(Policy.MIN_LATENCY), send_interval_jitter=0.1),
+        dict(policy=int(Policy.MAX_MIPS)),
+    ]
+    for kw in worlds:
+        spec, state, net, bounds = _build(
+            send_interval=0.25, horizon=2.0,
+            telemetry=True, telemetry_hist=True, derive_acks=False, **kw
+        )
+        ref, _ = run(spec, state, net, bounds)
+        jit_ref = run_jit(
+            spec, jax.tree.map(jnp.copy, state), net, bounds
+        )
+        chunk_ref = run_chunked(
+            spec, jax.tree.map(jnp.copy, state), net, bounds,
+            chunk_ticks=spec.n_ticks // 2,
+        )
+        # the single-device entries agree among themselves...
+        assert _hash(ref) == _hash(jit_ref) == _hash(chunk_ref)
+        spec2, got = _tp(spec, state, net, bounds, node_mesh)
+        np.testing.assert_array_equal(
+            np.asarray(ref.telem.lat_hist),
+            np.asarray(got.telem.lat_hist), err_msg=str(kw),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.telem.phase_work)[_SHARED],
+            np.asarray(got.telem.phase_work)[_SHARED],
+            err_msg=str(kw),
+        )
+        assert _hash(ref, skip=("telem",)) == _hash(
+            got, skip=("telem",)
+        ), kw
+        # chunked TP == one-shot TP, bit-for-bit, telemetry included
+        spec3, got_c = run_tp_chunked(
+            spec, jax.tree.map(jnp.copy, state), net, bounds, node_mesh,
+            chunk_ticks=spec.n_ticks // 4,
+        )
+        assert spec3 == spec2
+        assert _hash(got_c) == _hash(got), kw
+
+
+# ----------------------------------------------------------------------
+# sharded health plane: serve --tp, defer-rate watchdog, postmortem
+# ----------------------------------------------------------------------
+
+def test_serve_tp_overflow_pages_and_postmortem_names_the_shard(
+    node_mesh, tmp_path
+):
+    """A forced sustained-overflow world (exchange_window=1 from t=0)
+    under serve_tp_run: the defer-RATE floor trips the watchdog (the
+    z-score alone cannot — the rate is CONSTANT), a post-mortem bundle
+    lands with per-shard hashes, the live endpoint serves per-shard
+    exchange families that pass the OpenMetrics lint, and
+    tools/postmortem.py --diff bisects the diverging shard."""
+    import check_openmetrics as com
+    import postmortem
+
+    from fognetsimpp_tpu.telemetry.live import serve_tp_run
+
+    # every user publishes EVERY tick (interval == dt) into a 1-slot
+    # exchange window: 2 candidates per shard per tick, 1 deferred —
+    # constant overflow from t=0, the regime whose z-score is 0 forever
+    spec, state, net, bounds = _build(
+        send_interval=0.001, start_time_max=0.0, horizon=0.15,
+        telemetry=True,
+    )
+    dump_dir = str(tmp_path / "pm")
+    spec2, final, status = serve_tp_run(
+        spec, state, net, bounds, node_mesh,
+        exchange_window=1,
+        chunk_ticks=30,
+        port=0,
+        dump_dir=dump_dir,
+    )
+    # sustained overflow really deferred...
+    assert int(np.asarray(final.metrics.n_deferred_max)) > 0
+    ex = exchange_summary(spec2, final)
+    assert ex["defer_sum"].sum() > 0
+    assert ex["age_max_ticks"].max() > 0  # someone waited
+    assert (ex["occ_hist"][:, -1] > 0).any()  # overflow bucket hit
+    # ...and the defer-rate floor paged (kind='floor', not a z spike)
+    wd = status["watchdog"]
+    fired = [a for a in wd.anomalies if a["signal"] == "defer_rate"]
+    assert fired and any(a.get("kind") == "floor" for a in fired)
+    assert status["dumps"], "anomaly must dump a post-mortem bundle"
+
+    # live endpoint: per-shard families + healthz, lint-clean
+    port = status["port"]
+    om = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics"
+    ).read().decode()
+    hz = json.load(
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+    )
+    status["server"].close()
+    assert com.check_text(om, "tp-serve") == 0
+    assert 'fns_tp_exchange_occupancy_bucket{shard="0"' in om
+    assert "defer_rate" in hz["signals"]
+
+    # flight recorder carried per-shard hashes each chunk
+    ring = status["recorder"].ring
+    assert all(len(e.get("shard_hashes") or []) == 8 for e in ring)
+
+    # postmortem --diff: two bundles built from the FULL serving ring
+    # (the defer-rate dump fires on chunk 1, so its own ring snapshot
+    # is one entry deep), the twin's shard-3 hash flipped at the second
+    # chunk; the diff must name tick AND shard.  Writing the bundles
+    # minimal also exercises load()'s optional-field defaults.
+    assert len(ring) >= 2
+    src = str(tmp_path / "run_a.json")
+    with open(src, "w") as f:
+        json.dump({"reason": "anomaly", "ring": ring}, f)
+    b = json.loads(json.dumps({"reason": "anomaly", "ring": ring}))
+    t_div = b["ring"][1]["ticks_done"]
+    b["ring"][1]["state_hash"] = "deadbeef"
+    b["ring"][1]["shard_hashes"][3] = "deadbeef"
+    twin = str(tmp_path / "twin.json")
+    with open(twin, "w") as f:
+        json.dump(b, f)
+    lines = postmortem.diff(postmortem.load(src), postmortem.load(twin))
+    text = "\n".join(lines)
+    assert f"first state-hash divergence at tick {t_div}" in text
+    assert "diverging shard(s)" in text and "3" in text
+
+
+def test_postmortem_tolerates_pre_issue6_bundles(tmp_path, capsys):
+    """A minimal old-style bundle (no compile_cache, no watchdog, ring
+    entries without hashes) summarizes without crashing."""
+    import postmortem
+
+    old = {
+        "reason": "crash",
+        "ring": [{"rows": {"t": [1.0]}}],
+        "watchdog": {"anomalies": [{"signal": "q_depth"}]},  # no z
+    }
+    p = str(tmp_path / "old.json")
+    with open(p, "w") as f:
+        json.dump(old, f)
+    rc = postmortem.main([p])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "reason:      crash" in out
+    assert "z=?" in out
+    # and --diff against itself stays calm
+    assert postmortem.main(["--diff", p, p]) == 0
+
+
+# ----------------------------------------------------------------------
+# host-side exposition units (no TP compile)
+# ----------------------------------------------------------------------
+
+def test_watchdog_defer_rate_is_per_tick_not_per_row():
+    """The defer-rate floor must mean deferred-per-TICK at any horizon:
+    the reservoir stride (row_ticks) normalizes the per-row cumulative
+    delta, so a long-horizon serve (stride >> 1) does not page on a
+    benign trickle while the same physical rate pages at stride 1."""
+    from fognetsimpp_tpu.telemetry.live import Watchdog
+
+    def rows(deferred):
+        n = len(deferred)
+        return {
+            "t": np.arange(n, dtype=float),
+            "q_len_total": np.zeros(n),
+            "n_busy": np.zeros(n),
+            "n_deferred": np.zeros(n),
+            "n_completed": np.zeros(n),
+            "n_dropped": np.zeros(n),
+            "defer_total": np.asarray(deferred, float),
+        }
+
+    # 0.05 deferrals/tick over 10 rows x 100-tick stride = delta 50
+    wd = Watchdog(4, row_ticks=100)
+    sig = wd.signals_from_rows(rows(np.arange(10) * 5.0))
+    assert sig["defer_rate"] == pytest.approx(45.0 / 1000.0)
+    assert not wd.update(sig, 1000)  # benign: well under the floor
+    # the same per-row delta at stride 1 is 4.5/tick -> floor trips
+    wd1 = Watchdog(4, row_ticks=1)
+    sig1 = wd1.signals_from_rows(rows(np.arange(10) * 5.0))
+    fired = wd1.update(sig1, 10)
+    assert fired and fired[0]["kind"] == "floor"
+
+
+def test_openmetrics_linter_shard_label_rules():
+    """The shard-label contract on fns_tp_exchange_* families: missing
+    label, non-integer value and shard gaps are findings; the generic
+    duplicate-series rule covers duplicate (family, shard) pairs."""
+    import check_openmetrics as com
+
+    head = (
+        "# HELP fns_tp_exchange_candidates c\n"
+        "# TYPE fns_tp_exchange_candidates counter\n"
+    )
+    good = (
+        head
+        + 'fns_tp_exchange_candidates{shard="0"} 5\n'
+        + 'fns_tp_exchange_candidates{shard="1"} 7\n# EOF\n'
+    )
+    assert com.check_text(good, "g") == 0
+    assert com.check_text(
+        head + "fns_tp_exchange_candidates 5\n# EOF\n", "no-label"
+    ) == 1
+    assert com.check_text(
+        head + 'fns_tp_exchange_candidates{shard="x"} 5\n# EOF\n',
+        "non-int",
+    ) == 1
+    assert com.check_text(
+        head + 'fns_tp_exchange_candidates{shard="1"} 5\n# EOF\n',
+        "gap",
+    ) == 1
+    assert com.check_text(
+        head
+        + 'fns_tp_exchange_candidates{shard="0"} 5\n'
+        + 'fns_tp_exchange_candidates{shard="0"} 6\n# EOF\n',
+        "dup",
+    ) == 1
+    # TRAILING gap: the published fns_tp_shards count is the truth —
+    # shards 0..1 of a 3-shard run is a finding even with no hole
+    shards_head = (
+        "# HELP fns_tp_shards s\n# TYPE fns_tp_shards gauge\n"
+        "fns_tp_shards 3\n"
+    )
+    assert com.check_text(shards_head + good[: -len("# EOF\n")]
+                          + "# EOF\n", "trailing-gap") == 1
+    assert com.check_text(
+        shards_head
+        + head
+        + 'fns_tp_exchange_candidates{shard="0"} 5\n'
+        + 'fns_tp_exchange_candidates{shard="1"} 7\n'
+        + 'fns_tp_exchange_candidates{shard="2"} 9\n# EOF\n',
+        "complete",
+    ) == 0
+
+
+def test_fleet_openmetrics_per_replica_phase_work():
+    """render_fleet_openmetrics publishes one sample per
+    (fleet=replica, phase) pair and stays lint-clean."""
+    import check_openmetrics as com
+
+    from fognetsimpp_tpu.telemetry.openmetrics import (
+        render_fleet_openmetrics,
+    )
+
+    pw = np.arange(2 * len(PHASES)).reshape(2, len(PHASES))
+    scalars = {
+        "n_replicas": 2,
+        "aggregate": {
+            "n_completed": {
+                "sum": 4.0, "mean": 2.0, "min": 1.0, "max": 3.0
+            }
+        },
+    }
+    text = render_fleet_openmetrics(scalars, phase_work=pw)
+    assert com.check_text(text, "fleet") == 0
+    assert 'fns_fleet_phase_work{fleet="0",phase="connect"} 0' in text
+    assert (
+        f'fns_fleet_phase_work{{fleet="1",phase="tp_defer"}} '
+        f"{2 * len(PHASES) - 1}" in text
+    )
+
+
+def test_bench_trend_overhead_gate(tmp_path):
+    """A capture recording telemetry_overhead above the bar fails
+    --check; at/below the bar passes."""
+    import bench_trend
+
+    def cap(path, overhead):
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "parsed": {
+                        "metric": "m", "value": 100.0, "backend": "cpu",
+                        "n_users": 8, "telemetry_overhead": overhead,
+                    }
+                },
+                f,
+            )
+
+    cap(tmp_path / "BENCH_r01.json", 1.04)
+    rows = bench_trend.load_rounds(str(tmp_path))
+    assert bench_trend.check(rows) == []
+    cap(tmp_path / "BENCH_r02.json", 1.31)
+    rows = bench_trend.load_rounds(str(tmp_path))
+    problems = bench_trend.check(rows)
+    assert len(problems) == 1 and "overhead" in problems[0]
+
+
+@pytest.mark.slow  # in-process CLI: its own TP serve program
+def test_cli_serve_tp_composes(tmp_path, capsys):
+    """--serve --tp N end to end: pads, serves, records — the
+    previously rejected composition (ISSUE 11)."""
+    from fognetsimpp_tpu.__main__ import main
+
+    rc = main([
+        "--scenario", "smoke", "--tp", "8", "--serve", "0",
+        "--serve-chunk", "50",
+        "--set", "scenario.n_users=16",
+        "--set", "scenario.n_fogs=3",
+        "--set", "scenario.send_interval=0.01",
+        "--set", "scenario.horizon=0.1",
+        "--out", str(tmp_path),
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    summary = json.loads(captured.out.strip().splitlines()[-1])
+    assert summary["tp_shards"] == 8 and summary["chunks"] >= 1
+    om = open(
+        os.path.join(str(tmp_path), "General-0.om.txt")
+    ).read()
+    assert "fns_tp_exchange_occupancy_bucket" in om
+    sca = json.load(
+        open(os.path.join(str(tmp_path), "General-0.sca.json"))
+    )
+    assert len(sca["modules"]["tp_shard"]) == 8

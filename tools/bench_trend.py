@@ -30,6 +30,13 @@ from typing import Dict, List, Optional, Tuple
 #: at the same shape.
 TOLERANCE = 0.10
 
+#: Telemetry-on wall overhead bar (ISSUE 11): any capture recording a
+#: ``telemetry_overhead`` ratio (telemetry-on wall / off wall,
+#: interleaved A/B — bench.py BENCH_TP_TELEMETRY) above this fails
+#: --check.  The same <= 10% bar every observability plane has shipped
+#: under since PR 4.
+OVERHEAD_BAR = 1.10
+
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 #: Fields that define a comparable measurement shape.  Missing fields
@@ -93,6 +100,7 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     "value": float(parsed["value"]),
                     "unit": parsed.get("unit", ""),
                     "compile_s": parsed.get("compile_s"),
+                    "telemetry_overhead": parsed.get("telemetry_overhead"),
                     "parsed": parsed,
                 }
             )
@@ -122,6 +130,15 @@ def trajectories(rows: List[Dict]) -> Dict[Tuple, List[Dict]]:
 def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
     """Regression findings (empty = green)."""
     problems = []
+    # telemetry-overhead bar: gate every capture that measured it
+    for r in rows:
+        oh = r.get("telemetry_overhead")
+        if oh is not None and float(oh) > OVERHEAD_BAR:
+            problems.append(
+                f"{r['file']}: telemetry-on overhead ratio {oh:.3f} "
+                f"exceeds the {OVERHEAD_BAR:.2f} bar (interleaved "
+                "off/on A/B; the observability planes ship under <=10%)"
+            )
     for shape, traj in trajectories(rows).items():
         if len(traj) < 2:
             continue
@@ -167,9 +184,14 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                     f"{r['value']:,.0f} | {ratio} | {comp} |"
                 )
             else:
+                oh = (
+                    f", telemetry x{r['telemetry_overhead']:.3f}"
+                    if r.get("telemetry_overhead") is not None
+                    else ""
+                )
                 out.append(
                     f"  r{r['round']:<2} {r['value']:>14,.1f} {r['unit']}"
-                    f"  ({ratio}, compile {comp}s)  {r['file']}"
+                    f"  ({ratio}, compile {comp}s{oh})  {r['file']}"
                 )
             prev = r["value"]
     return "\n".join(out)

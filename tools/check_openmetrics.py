@@ -16,7 +16,13 @@ health plane (r6):
   sample has an ``le`` label, each label-group's ``le`` values ascend
   strictly and terminate at ``+Inf``, bucket counts are cumulative
   (non-decreasing), the group's ``_count`` equals its ``+Inf`` bucket
-  and a ``_sum`` sample is present.
+  and a ``_sum`` sample is present;
+* the TP exchange-plane families (ISSUE 11, ``fns_tp_exchange_*``)
+  carry the ``shard`` label dimension on every sample, with
+  non-negative decimal-integer values and no gaps (shards 0..N-1 all
+  present per family) — a missing shard in the scrape is a silent
+  observability hole, and duplicate (family, shard, fog) series are
+  already rejected by the generic duplicate-series rule.
 """
 import math
 import re
@@ -97,6 +103,38 @@ def check_lines(lines, where: str) -> int:
             return 1
         if fam not in helps:
             print(f"{where}:{i}: sample {name} has no # HELP line")
+            return 1
+    # TP exchange-plane shard-label contract (ISSUE 11)
+    shard_vals = {}  # family -> set of shard ints
+    n_shards = None  # the exposition's own fns_tp_shards sample
+    for i, name, labels_text, v in samples:
+        if name == "fns_tp_shards":
+            n_shards = int(v)
+        fam = _family(name, types)
+        if not fam.startswith("fns_tp_exchange"):
+            continue
+        labels = _parse_labels(labels_text)
+        if "shard" not in labels:
+            print(f"{where}:{i}: {name} sample without a 'shard' label")
+            return 1
+        sv = labels["shard"]
+        if not sv.isdigit():
+            print(
+                f"{where}:{i}: {name} has non-integer shard={sv!r}"
+            )
+            return 1
+        shard_vals.setdefault(fam, set()).add(int(sv))
+    for fam, vals in shard_vals.items():
+        # cross-check against the published shard count when present:
+        # MISSING TRAILING shards (a truncated render loop) are the
+        # silent observability hole the gap rule exists for, and only
+        # fns_tp_shards knows the true N
+        want = set(range(n_shards if n_shards else max(vals) + 1))
+        if vals != want:
+            print(
+                f"{where}: family {fam} has shard gaps: saw "
+                f"{sorted(vals)}, expected 0..{max(want)}"
+            )
             return 1
     # histogram bucket contract
     hist_fams = {n for n, k in types.items() if k == "histogram"}

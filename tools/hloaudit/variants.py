@@ -128,15 +128,21 @@ def _compile_tp():
     return compiled.as_text(), None
 
 
-def _compile_tp_tick():
+def _compile_tp_tick(**build_overrides):
     """Compile the shard_map'd TP sharded tick (the ISSUE 9 production
     path) through taskshard's OWN program builder — the audited
-    artifact is the program ``run_tp_sharded`` executes, never a twin."""
+    artifact is the program ``run_tp_sharded`` executes, never a twin.
+
+    ``build_overrides`` select the variant: ``telemetry=True`` compiles
+    the ISSUE 11 telemetry-on tick (exchange-plane gauges + the
+    phase-work/histogram fold psums riding the shard_map body)."""
     from fognetsimpp_tpu.parallel.mesh import make_mesh
     from fognetsimpp_tpu.parallel.taskshard import NODE_AXIS, _tp_setup
     from fognetsimpp_tpu.scenarios import smoke
 
-    spec, state, net, bounds = smoke.build(**_TP_TICK)
+    spec, state, net, bounds = smoke.build(
+        **{**_TP_TICK, **build_overrides}
+    )
     mesh = make_mesh(_N_DEVICES, axis_name=NODE_AXIS)
     go, parts, net_r, cache_r, spec = _tp_setup(
         spec, state, net, mesh, _TP_TICK_TICKS, NODE_AXIS,
@@ -203,7 +209,19 @@ def variants() -> List[Variant]:
             "shard_map'd TP sharded tick on the 8-device node mesh "
             "(parallel/taskshard.run_tp_sharded: psum combines + ring "
             "arrival exchange)",
-            _compile_tp_tick,
+            lambda: _compile_tp_tick(),
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from taskshard.py
+        ),
+        Variant(
+            "tp_tick_telemetry",
+            "the same TP sharded tick with the telemetry plane on "
+            "(ISSUE 11: per-shard exchange gauges + the phase-work/"
+            "latency-hist fold psums; collective kinds must stay "
+            "within taskshard.DECLARED_COLLECTIVES)",
+            lambda: _compile_tp_tick(
+                telemetry=True, telemetry_hist=True, derive_acks=False
+            ),
             sharded=True,
             declared_collectives=None,  # resolved lazily from taskshard.py
         ),
@@ -219,7 +237,7 @@ def declared_for(v: Variant) -> Optional[Dict[str, Set[str]]]:
         return _fleet_declared()
     if v.name == "tp_dryrun":
         return _tp_declared()
-    if v.name == "tp_tick":
+    if v.name in ("tp_tick", "tp_tick_telemetry"):
         from fognetsimpp_tpu.parallel.taskshard import (
             DECLARED_COLLECTIVES as tp_tick_declared,
         )

@@ -103,7 +103,7 @@ def compile_tick_counts(fused: bool) -> dict:
     return entry_op_counts(compiled.as_text())
 
 
-def compile_tp_counts() -> dict:
+def compile_tp_counts(telemetry: bool = False) -> dict:
     """Compile the shard_map'd TP sharded tick and count its HLO ops +
     collectives (ISSUE 9).
 
@@ -113,6 +113,12 @@ def compile_tp_counts() -> dict:
     collective in the sharded tick must arrive together with its
     ``DECLARED_COLLECTIVES`` entry and a reviewed budget regeneration;
     hloaudit A3 checks the kinds, this pins the count).
+
+    ``telemetry=True`` compiles the ISSUE 11 telemetry-on variant
+    (exchange gauges + hist): its EXTRA psums — the phase-work/
+    histogram i32 fold and the exchange/latency f32 fold — get their
+    own exactly-pinned count, while the telemetry-OFF tick must keep
+    the PR 8 count unchanged.
     """
     from tools.hloaudit.hlo import (
         COLLECTIVE_OPS,
@@ -121,7 +127,12 @@ def compile_tp_counts() -> dict:
     )
     from tools.hloaudit.variants import _compile_tp_tick
 
-    text, _spec = _compile_tp_tick()
+    if telemetry:
+        text, _spec = _compile_tp_tick(
+            telemetry=True, telemetry_hist=True, derive_acks=False
+        )
+    else:
+        text, _spec = _compile_tp_tick()
     mod = parse_hlo(text)
     counts = mod.entry_op_counts()
     colls: dict = {}
@@ -149,14 +160,14 @@ def measure(tp: bool = True) -> dict:
     unfused = compile_tick_counts(fused=False)
     out_tp = {}
     if tp:
-        t = compile_tp_counts()
-        out_tp = {
-            "tp_tick": {
+        for key, telem in (("tp_tick", False),
+                           ("tp_tick_telemetry", True)):
+            t = compile_tp_counts(telemetry=telem)
+            out_tp[key] = {
                 **t,
                 "max_ops": int(t["ops"] * COUNT_SLACK),
                 "max_fusions": int(t["fusions"] * COUNT_SLACK),
             }
-        }
     return {
         "shape": {k: (list(v) if isinstance(v, tuple) else v)
                   for k, v in PINNED.items()},
@@ -196,30 +207,32 @@ def check(measured: dict, budget: dict) -> list:
             f"fused/unfused ops ratio {ratio:.3f} > {cap} — the "
             f"fused front-end lost its kernel-count reduction"
         )
-    # --- the TP sharded tick (ISSUE 9) ---------------------------------
-    tp = measured.get("tp_tick")
-    btp = budget.get("tp_tick")
-    if tp is not None:
+    # --- the TP sharded ticks (ISSUE 9; telemetry-on since ISSUE 11) ---
+    for key in ("tp_tick", "tp_tick_telemetry"):
+        tp = measured.get(key)
+        btp = budget.get(key)
+        if tp is None:
+            continue
         if btp is None:
             errs.append(
-                "budget file predates the TP sharded tick — regenerate "
+                f"budget file predates the {key} variant — regenerate "
                 "with --write"
             )
-        else:
-            for k, cap_key in (("ops", "max_ops"),
-                               ("fusions", "max_fusions")):
-                if tp[k] > btp[cap_key]:
-                    errs.append(
-                        f"TP sharded tick {k} regressed: {tp[k]} > "
-                        f"budget {btp[cap_key]}"
-                    )
-            if tp["collectives"] != btp["collectives"]:
+            continue
+        for k, cap_key in (("ops", "max_ops"),
+                           ("fusions", "max_fusions")):
+            if tp[k] > btp[cap_key]:
                 errs.append(
-                    "TP sharded tick per-tick collectives drifted: "
-                    f"{tp['collectives']} != pinned {btp['collectives']} "
-                    "— a collective change must land with its "
-                    "DECLARED_COLLECTIVES entry and a reviewed --write"
+                    f"{key} {k} regressed: {tp[k]} > "
+                    f"budget {btp[cap_key]}"
                 )
+        if tp["collectives"] != btp["collectives"]:
+            errs.append(
+                f"{key} per-tick collectives drifted: "
+                f"{tp['collectives']} != pinned {btp['collectives']} "
+                "— a collective change must land with its "
+                "DECLARED_COLLECTIVES entry and a reviewed --write"
+            )
     return errs
 
 

@@ -22,8 +22,31 @@ from typing import Dict, List
 
 
 def load(path: str) -> Dict:
+    """Load a dump with every post-PR-6 manifest field OPTIONAL.
+
+    Older bundles predate fields newer builds always write
+    (``compile_cache`` arrived with the compile-latency observability,
+    ``shard_hashes`` with the sharded health plane) — an inspector that
+    crashes on its own older output is useless exactly when a
+    post-mortem matters, so missing fields default instead of raising
+    (tests/test_tp_telemetry.py pins an old-style bundle).
+    """
     with open(path) as f:
-        return json.load(f)
+        d = json.load(f)
+    d.setdefault("reason", "unknown")
+    d.setdefault("ticks_done", 0)
+    d.setdefault("detail", {})
+    d.setdefault("ring", [])
+    d.setdefault("compile_cache", {})
+    d.setdefault("watchdog", {})
+    for entry in d["ring"]:
+        if isinstance(entry, dict):
+            entry.setdefault("ticks_done", 0)
+    return d
+
+
+def _fmt_z(z) -> str:
+    return f"{z:.2f}" if isinstance(z, (int, float)) else "?"
 
 
 def summarize(d: Dict) -> List[str]:
@@ -39,8 +62,9 @@ def summarize(d: Dict) -> List[str]:
     anomalies = wd.get("anomalies") or []
     out.append(f"anomalies:   {len(anomalies)}")
     for a in anomalies[-5:]:
+        kind = f" [{a['kind']}]" if a.get("kind") else ""
         out.append(
-            f"  - {a.get('signal')} z={a.get('z'):.2f} "
+            f"  - {a.get('signal')} z={_fmt_z(a.get('z'))}{kind} "
             f"value={a.get('value')} at tick {a.get('ticks_done')}"
         )
     if wd.get("last_signals"):
@@ -63,9 +87,12 @@ def summarize(d: Dict) -> List[str]:
     out.append(f"ring:        {len(ring)} chunk(s)")
     if ring:
         first, last = ring[0], ring[-1]
+        shards = last.get("shard_hashes") or []
         out.append(
-            f"  ticks {first['ticks_done']} .. {last['ticks_done']}, "
+            f"  ticks {first.get('ticks_done')} .. "
+            f"{last.get('ticks_done')}, "
             f"hashes {'present' if last.get('state_hash') else 'absent'}"
+            + (f", {len(shards)} shard hash(es)" if shards else "")
         )
     if d.get("trace"):
         out.append(f"trace:       {d['trace']}")
@@ -73,14 +100,20 @@ def summarize(d: Dict) -> List[str]:
 
 
 def diff(a: Dict, b: Dict) -> List[str]:
-    """Field-level diff of two dumps; pinpoints first hash divergence."""
+    """Field-level diff of two dumps; pinpoints first hash divergence.
+
+    When both dumps carry per-shard hashes (sharded health plane), the
+    first divergence is attributed to the SHARD(s) whose blocks first
+    disagree — the bisection that turns "a TP run diverged" into
+    "shard 3 diverged first at tick 4000".
+    """
     out = []
     for key in ("reason", "ticks_done"):
         if a.get(key) != b.get(key):
             out.append(f"{key}: {a.get(key)} != {b.get(key)}")
-    ra = {e["ticks_done"]: e for e in a.get("ring") or []}
-    rb = {e["ticks_done"]: e for e in b.get("ring") or []}
-    shared = sorted(set(ra) & set(rb))
+    ra = {e.get("ticks_done"): e for e in a.get("ring") or []}
+    rb = {e.get("ticks_done"): e for e in b.get("ring") or []}
+    shared = sorted(k for k in set(ra) & set(rb) if k is not None)
     if not shared:
         out.append("rings share no chunk boundaries")
         return out
@@ -96,6 +129,21 @@ def diff(a: Dict, b: Dict) -> List[str]:
         )
     else:
         out.append(f"first state-hash divergence at tick {first_div}")
+        sa = ra[first_div].get("shard_hashes") or []
+        sb = rb[first_div].get("shard_hashes") or []
+        if sa and sb and len(sa) == len(sb):
+            bad = [s for s, (x, y) in enumerate(zip(sa, sb)) if x != y]
+            if bad:
+                out.append(
+                    f"  diverging shard(s) at tick {first_div}: "
+                    + ", ".join(str(s) for s in bad)
+                )
+            else:
+                out.append(
+                    f"  all {len(sa)} shard blocks agree at tick "
+                    f"{first_div}: the divergence is in the replicated "
+                    "fog/broker state"
+                )
     for t in shared:
         for field, va in (ra[t].get("rows") or {}).items():
             vb = (rb[t].get("rows") or {}).get(field)
