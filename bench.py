@@ -585,6 +585,184 @@ def tp_measurement(n_devices=None) -> dict:
     }
 
 
+def chaos_measurement() -> dict:
+    """Hostile-world benchmark (ISSUE 12): the bench world under churn.
+
+    ``python bench.py --chaos`` runs the single-chip bench world with
+    the chaos fault-injection subsystem live — random fog crash/recover
+    (MTBF/MTTR), RE-OFFLOAD in-flight handling and bursty broker→fog
+    RTT degradation — once per policy in ``BENCH_CHAOS_POLICIES``
+    (default: two static + two learned schedulers), and reports
+    throughput plus the policy-family latency/robustness table
+    BENCHMARKS.md quotes: under churn the bandits should win on mean
+    latency by learning to avoid flaky arms, which the happy-path table
+    cannot show.
+
+    Env knobs: BENCH_CHAOS_USERS / BENCH_CHAOS_FOGS /
+    BENCH_CHAOS_HORIZON / BENCH_CHAOS_INTERVAL / BENCH_CHAOS_MTBF /
+    BENCH_CHAOS_MTTR / BENCH_CHAOS_POLICIES / BENCH_CHAOS_SEED.
+    Headline value = min_busy decisions/s (comparable across rounds at
+    the same shape); ``chaos`` rides the JSON so tools/bench_trend.py
+    forms a separate trajectory from the happy-path rows.
+    """
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu.compile_cache import (
+        compile_stats,
+        enable_compile_cache,
+        note_compile,
+    )
+    from fognetsimpp_tpu.core.engine import run_jit
+    from fognetsimpp_tpu.runtime.signals import extract_signals
+    from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.spec import ChaosMode, policy_from_name
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+
+    # Unlike the saturated happy-path bench (a pure throughput probe),
+    # the hostile world is the test_chaos.py churn shape scaled up:
+    # fog 0 is SLOW and scripted-flaky (a square-wave outage; after
+    # every reboot it advertises busy=0, so stale-view scheduling keeps
+    # feeding it), the rest are fast and stable, and the offered load
+    # stays within single-fog capacity so the window-level-argmax
+    # policies (MIN_BUSY's quirk family, UCB/DUCB) compete on
+    # ADAPTIVITY, not queueing noise.  BENCH_CHAOS_MTBF>0 adds global
+    # random churn on top of the scripted wave.
+    n_users = _env_int("BENCH_CHAOS_USERS", 12)
+    n_fogs = _env_int("BENCH_CHAOS_FOGS", 8)
+    horizon = _env_float("BENCH_CHAOS_HORIZON", 4.0)
+    interval = _env_float("BENCH_CHAOS_INTERVAL", 0.1)
+    dt = _env_float("BENCH_CHAOS_DT", 1e-3)
+    mtbf = _env_float("BENCH_CHAOS_MTBF", 0.0)
+    mttr = _env_float("BENCH_CHAOS_MTTR", 0.1)
+    seed = _env_int("BENCH_CHAOS_SEED", 0)
+    names = os.environ.get(
+        "BENCH_CHAOS_POLICIES", "min_busy,round_robin,random,ducb,exp3"
+    ).split(",")
+    policies = [policy_from_name(p) for p in names if p.strip()]
+    # fog 0's square-wave outage: down 0.15 s of every 0.3 s
+    script = tuple(
+        (0, round(0.3 * k + 0.15, 3), round(0.3 * k + 0.30, 3))
+        for k in range(int(horizon / 0.3))
+    )
+
+    def build(policy):
+        return smoke.build(
+            n_users=n_users,
+            n_fogs=n_fogs,
+            # fog 0 slow AND flaky; the rest fast and stable
+            fog_mips=(6000.0,) + tuple(
+                float(m)
+                for _, m in zip(
+                    range(n_fogs - 1), (60000, 80000, 100000) * n_fogs
+                )
+            ),
+            send_interval=interval,
+            horizon=horizon,
+            dt=dt,
+            policy=int(policy),
+            max_sends_per_user=int(horizon / interval) + 4,
+            queue_capacity=128,
+            start_time_max=min(0.05, horizon / 4),
+            seed=seed,
+            learn_explore=0.1,
+            learn_discount=0.999,
+            chaos=True,
+            chaos_mode=int(ChaosMode.REOFFLOAD),
+            chaos_seed=seed,
+            chaos_script=script,
+            chaos_mtbf_s=mtbf,
+            chaos_mttr_s=mttr,
+            chaos_max_retries=8,
+            chaos_rtt_amp=0.5,
+            chaos_rtt_period_s=0.5,
+            chaos_rtt_burst_prob=0.02,
+            chaos_rtt_burst_mult=4.0,
+        )
+
+    per_policy = {}
+    headline = None
+    headline_name = None
+    compile_s_total = 0.0
+    for pol in policies:
+        # compile pass (untimed), then one timed run on a fresh world
+        spec, state, net, bounds = build(pol)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run_jit(spec, state, net, bounds))
+        compile_s = time.perf_counter() - t0
+        note_compile(compile_s)
+        compile_s_total += compile_s
+        spec, state, net, bounds = build(pol)
+        t0 = time.perf_counter()
+        final = run_jit(spec, state, net, bounds)
+        jax.block_until_ready(final.metrics.n_scheduled)
+        wall = time.perf_counter() - t0
+        lat = extract_signals(final)["task_time"]
+        ch = final.chaos
+        decisions = int(np.asarray(final.metrics.n_scheduled))
+        row = {
+            "decisions": decisions,
+            "decisions_per_sec": round(decisions / wall, 1),
+            "wall_s": round(wall, 4),
+            "completed": int(np.asarray(final.metrics.n_completed)),
+            "mean_latency_ms": (
+                round(float(lat.mean()), 3) if lat.size else None
+            ),
+            "p95_latency_ms": (
+                round(float(np.percentile(lat, 95)), 3)
+                if lat.size else None
+            ),
+            "reoffloaded": int(np.asarray(ch.n_reoffloaded)),
+            "retry_exhausted": int(np.asarray(ch.n_retry_exhausted)),
+            "lost_crash": int(np.asarray(ch.n_lost_crash)),
+            "crashes": int(np.asarray(ch.n_crashes)),
+        }
+        per_policy[pol.name.lower()] = row
+        # the headline (trend-ratcheted) row is min_busy when present;
+        # otherwise the first policy run — the recorded "policy" field
+        # must name whichever actually produced the number, or
+        # bench_trend would compare unlike shapes (its policy SHAPE_FIELD)
+        if headline is None or pol.name.lower() == "min_busy":
+            headline = row
+            headline_name = pol.name.lower()
+
+    return {
+        "metric": "chaos_task_offload_decisions_per_sec",
+        "value": headline["decisions_per_sec"],
+        "unit": "decisions/s",
+        "backend": backend,
+        "chaos": "reoffload-churn",
+        "n_users": n_users,
+        "n_fogs": n_fogs,
+        "horizon_s": horizon,
+        "dt": dt,
+        "interval": interval,
+        "chaos_mtbf_s": mtbf,
+        "chaos_mttr_s": mttr,
+        "policy": headline_name,
+        "decisions": headline["decisions"],
+        "wall_s": headline["wall_s"],
+        "per_policy": per_policy,
+        "compile_s": round(compile_s_total, 1),
+        "compile_cache": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in compile_stats().items()
+        },
+        "conservation": "spawned = completed + dropped + lost + "
+        "in-flight; tests/test_chaos.py",
+    }
+
+
+def chaos_main() -> None:
+    """``python bench.py --chaos`` (or ``BENCH_CHAOS=1``): the
+    hostile-world headline — the bench world under fog churn + link
+    degradation, one row per scheduling policy."""
+    print(json.dumps(chaos_measurement()))
+
+
 def tp_main() -> None:
     """``python bench.py --tp`` (or ``BENCH_TP=1``): the TP capacity
     headline — one ≥1M-user world sharded over BENCH_DEVICES devices."""
@@ -611,5 +789,7 @@ if __name__ == "__main__":
         fleet_main()
     elif "--tp" in sys.argv or os.environ.get("BENCH_TP"):
         tp_main()
+    elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS"):
+        chaos_main()
     else:
         main()

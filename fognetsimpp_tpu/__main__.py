@@ -138,6 +138,27 @@ def main(argv=None) -> int:
                     help="devices in the replica mesh (default: all "
                     "visible devices); implies --replicas D when "
                     "--replicas is omitted")
+    ap.add_argument("--chaos", metavar="PROFILE", default=None,
+                    help="deterministic fault injection (chaos/): run "
+                    "the scenario under a named chaos profile — fog "
+                    "crash/recover schedules, in-flight task loss or "
+                    "re-offload, broker→fog link degradation "
+                    "(profiles: light, heavy, flaky, degraded, "
+                    "hostile, scripted); composes with --policy/"
+                    "--telemetry/--hist/--serve/--trace-out; refine "
+                    "any knob with --set spec.chaos_*=...")
+    ap.add_argument("--chaos-seed", type=int, metavar="N", default=None,
+                    help="seed of the chaos PRNG stream (fault "
+                    "schedules + RTT bursts); needs --chaos")
+    ap.add_argument("--chaos-mode", metavar="MODE", default=None,
+                    help="in-flight task handling on a crash: 'lose' "
+                    "or 'reoffload' (overrides the profile); needs "
+                    "--chaos")
+    ap.add_argument("--chaos-script", metavar="FILE", default=None,
+                    help="scripted outage schedule: JSON list of "
+                    "[fog, t_down, t_up] triples (or the compact "
+                    "'fog:td:tu;...' text form); composes with the "
+                    "profile's random schedule; needs --chaos")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (cpu/tpu)")
     ap.add_argument("--checkify", nargs="?", const="div", default=None,
@@ -225,6 +246,19 @@ def main(argv=None) -> int:
         ap.error("--tp-window sizes the TP arrival exchange; it needs "
                  "--tp N")
 
+    # ---- chaos guard rails (ISSUE 12) ---------------------------------
+    if args.chaos is None:
+        for flag, val in (("--chaos-seed", args.chaos_seed),
+                          ("--chaos-mode", args.chaos_mode),
+                          ("--chaos-script", args.chaos_script)):
+            if val is not None:
+                ap.error(f"{flag} refines a chaos profile; it needs "
+                         "--chaos <profile>")
+    elif args.sweep:
+        ap.error("--chaos perturbs one world's fault schedule; --sweep "
+                 "grids own their replica fan-out — run chaos worlds "
+                 "without --sweep")
+
     text = ""
     if args.config:
         with open(args.config) as f:
@@ -238,6 +272,26 @@ def main(argv=None) -> int:
         if "=" not in o:
             ap.error(f"--set needs KEY=VALUE, got {o!r}")
         pre.append(o.replace("=", " = ", 1))
+    if args.chaos is not None:
+        # profile lines land BELOW the --set overrides (first match
+        # wins), so --set spec.chaos_*=... refines any profile knob
+        from .chaos.profiles import chaos_config_lines, load_script_file
+
+        try:
+            script = (
+                load_script_file(args.chaos_script)
+                if args.chaos_script is not None
+                else None
+            )
+            pre += chaos_config_lines(
+                args.chaos, seed=args.chaos_seed,
+                mode=args.chaos_mode, script=script,
+            )
+        except ValueError as e:
+            # unknown profile/mode or a malformed script file: one
+            # actionable line, never a traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if args.ticks or args.trails:
         pre.append("spec.record_tick_series = true")
     if args.trails:

@@ -1,0 +1,20 @@
+"""Deterministic fault injection: fog crash/recover lifecycle, in-flight
+task loss / re-offload, and broker->fog link degradation (ISSUE 12).
+
+``faults.py`` owns the carry-resident :class:`ChaosState`, the PRNG-keyed
+outage schedule stepping, the RTT degradation factors and the host-side
+readers (schedule replay, summary roll-up); ``profiles.py`` owns the CLI
+profile catalogue and the scripted-schedule parser.  The engine phase
+that applies all of it lives in ``core/engine._phase_chaos`` — the same
+split as ``learn/`` (state + kernels here, tick wiring in the engine).
+"""
+from .faults import (  # noqa: F401
+    ChaosState,
+    chaos_counters,
+    chaos_summary,
+    init_chaos_state,
+    outage_timeline,
+    rtt_factor,
+    step_lifecycle,
+)
+from .profiles import PROFILES, chaos_config_lines, parse_script  # noqa: F401

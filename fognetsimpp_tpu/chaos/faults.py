@@ -1,0 +1,388 @@
+"""Chaos state + fault-schedule kernels (device half) and host readers.
+
+The reference gets node death and link churn for free from INET's
+lifecycle and radio models; this module is the batched engine's analog
+— a fully deterministic, jit-compatible fault source that runs *inside*
+the tick loop:
+
+* **Fog lifecycle**: per-fog crash/recover schedules.  Random outages
+  are exponential MTBF/MTTR draws keyed
+  ``fold_in(fold_in(chaos_key, fog), outage_index)`` — a pure function
+  of (chaos key, fog, epoch), so the device carry machine
+  (:func:`step_lifecycle`) and the host replay
+  (:func:`outage_timeline`) consume the identical stream and can never
+  disagree.  Scripted ``(fog, t_down, t_up)`` intervals
+  (``spec.chaos_script``) compose on top: a fog is down while ANY
+  source holds it down.
+* **Link degradation**: a periodic + PRNG-burst multiplier over the
+  broker->fog rows of the tick's delay cache (:func:`rtt_factor`),
+  keyed on the tick index — deterministic across
+  run/run_jit/run_chunked by construction.
+
+Everything rides :class:`ChaosState` in the scan carry with the
+inert-LearnState gate discipline: every array leaf is zero-row when
+``spec.chaos`` is off, and the chaos key is *folded* from the world key
+(never split), so the main PRNG stream is bit-identical with the
+subsystem on or off.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..spec import ChaosMode, WorldSpec
+
+#: Domain separator folded into the world key to derive the chaos
+#: stream (so chaos_seed=0 still decorrelates from the world draws).
+_CHAOS_FOLD = 0x0C4A05
+#: Separator for the static per-fog RTT phase draw.
+_RTT_PHASE_FOLD = 0x0B17
+#: Separator for the per-tick RTT burst draws.
+_RTT_BURST_FOLD = 0x0B57
+
+
+@struct.dataclass
+class ChaosState:
+    """Carry-resident fault-injection state (one per world).
+
+    Per-fog leaves are sized ``spec.chaos_fogs`` and the per-task retry
+    column ``spec.chaos_tasks`` — the real dimensions when
+    ``spec.chaos`` is on, zero rows otherwise.  The scalar counters are
+    always present and stay exactly zero on inert worlds.
+    """
+
+    key: jax.Array  # chaos PRNG key (constant through the run: every
+    #   draw is a fold_in of it, nothing ever consumes it)
+    next_down: jax.Array  # (Fc,) f32 next scheduled random crash time
+    #   (+inf = no random crash pending)
+    next_up: jax.Array  # (Fc,) f32 scheduled random recover time
+    #   (+inf = the fog is not in a random outage)
+    epoch: jax.Array  # (Fc,) i32 outage index — keys the per-outage
+    #   (gap, duration) draws, incremented at each random recovery
+    down_ticks: jax.Array  # (Fc,) i32 cumulative ticks spent down
+    rtt_phase: jax.Array  # (Fc,) f32 per-fog phase offset of the
+    #   periodic RTT degradation term (static draw at init)
+    retry: jax.Array  # (Tc,) i8 per-task re-offload count (REOFFLOAD)
+    n_crashes: jax.Array  # () i32 crash edges observed
+    n_recovers: jax.Array  # () i32 recover edges observed
+    n_lost_crash: jax.Array  # () i32 tasks lost to a crash (LOSE mode)
+    n_reoffloaded: jax.Array  # () i32 tasks bounced back to the broker
+    n_retry_exhausted: jax.Array  # () i32 tasks lost after the retry
+    #   budget ran out (REOFFLOAD mode)
+
+
+def _chaos_key(spec: WorldSpec, key: jax.Array) -> jax.Array:
+    """The chaos PRNG stream for ``spec`` on world key ``key``.
+
+    Folded (not split) from the world key: enabling chaos consumes
+    nothing from the main stream, which is what keeps the chaos-off
+    bit-exactness gate trivially true.
+    """
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _CHAOS_FOLD), spec.chaos_seed
+    )
+
+
+def _outage_draws(
+    spec: WorldSpec, key: jax.Array, epoch: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """(gap, duration) exponential draws for each fog's ``epoch``-th
+    outage, both clamped to >= dt so every outage spans at least one
+    tick (which statically rules out same-tick crash->recover blips —
+    see :func:`step_lifecycle`'s ordering argument)."""
+    F = epoch.shape[0]
+
+    def one(f, e):
+        k = jax.random.fold_in(jax.random.fold_in(key, f), e)
+        return jax.random.uniform(
+            k, (2,), jnp.float32, minval=1e-7, maxval=1.0
+        )
+
+    u = jax.vmap(one)(jnp.arange(F, dtype=jnp.int32), epoch)  # (F, 2)
+    dt = np.float32(spec.dt)
+    gap = jnp.maximum(
+        -np.float32(spec.chaos_mtbf_s) * jnp.log(u[:, 0]), dt
+    )
+    dur = jnp.maximum(
+        -np.float32(max(spec.chaos_mttr_s, 0.0)) * jnp.log(u[:, 1]), dt
+    )
+    return gap, dur
+
+
+def init_chaos_state(
+    spec: WorldSpec, key: Optional[jax.Array] = None
+) -> ChaosState:
+    """The t=0 chaos state for ``spec`` (inert zero-row when off)."""
+    F, Tc = spec.chaos_fogs, spec.chaos_tasks
+    f32, i32 = jnp.float32, jnp.int32
+    if spec.chaos:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        ck = _chaos_key(spec, key)
+        epoch0 = jnp.zeros((F,), i32)
+        if spec.chaos_mtbf_s > 0:
+            gap0, _ = _outage_draws(spec, ck, epoch0)
+            next_down = gap0
+        else:
+            next_down = jnp.full((F,), jnp.inf, f32)
+        rtt_phase = jax.random.uniform(
+            jax.random.fold_in(ck, _RTT_PHASE_FOLD), (F,), f32,
+            minval=0.0, maxval=2.0 * np.pi,
+        )
+    else:
+        ck = jax.random.PRNGKey(0)
+        next_down = jnp.zeros((F,), f32)
+        rtt_phase = jnp.zeros((F,), f32)
+        epoch0 = jnp.zeros((F,), i32)
+    return ChaosState(
+        key=ck,
+        next_down=next_down,
+        next_up=jnp.full((F,), jnp.inf, f32) if spec.chaos
+        else jnp.zeros((F,), f32),
+        epoch=epoch0,
+        down_ticks=jnp.zeros((F,), i32),
+        rtt_phase=rtt_phase,
+        retry=jnp.zeros((Tc,), jnp.int8),
+        n_crashes=jnp.zeros((), i32),
+        n_recovers=jnp.zeros((), i32),
+        n_lost_crash=jnp.zeros((), i32),
+        n_reoffloaded=jnp.zeros((), i32),
+        n_retry_exhausted=jnp.zeros((), i32),
+    )
+
+
+def step_lifecycle(
+    spec: WorldSpec,
+    ch: ChaosState,
+    up_prev: jax.Array,  # (F,) bool — fog liveness entering this tick
+    t0: jax.Array,
+    t1: jax.Array,
+):
+    """Advance the outage schedules one tick.
+
+    Returns ``(ch', up_new, crashed, recovered, crash_t, recover_t)``
+    where ``crashed``/``recovered`` are this tick's edges vs
+    ``up_prev`` and ``crash_t``/``recover_t`` are per-fog event times
+    clamped into ``[t0, t1]``.
+
+    Random-machine ordering per tick: recoveries fire first, then crash
+    triggers.  Because every draw is clamped >= dt, a fog that recovers
+    this tick has its next crash at ``next_up + gap >= t0 + dt = t1``
+    (not < t1), and a fog that crashes has ``next_up = next_down + dur
+    >= t1`` — so neither a crash nor a recovery can re-fire within the
+    same tick, and every outage is visible to at least one tick's
+    dispatch masking.
+    """
+    F = spec.n_fogs
+    f32, i32 = jnp.float32, jnp.int32
+    next_down, next_up, epoch = ch.next_down, ch.next_up, ch.epoch
+    inf = jnp.inf
+
+    if spec.chaos_mtbf_s > 0:
+        _, dur_e = _outage_draws(spec, ch.key, epoch)
+        gap_next, _ = _outage_draws(spec, ch.key, epoch + 1)
+        rand_down = jnp.isfinite(next_up)
+        # 1. recoveries
+        rec = rand_down & (next_up < t1)
+        rand_rec_t = jnp.where(rec, next_up, inf)
+        epoch = jnp.where(rec, epoch + 1, epoch)
+        next_down = jnp.where(rec, next_up + gap_next, next_down)
+        next_up = jnp.where(rec, inf, next_up)
+        rand_down = rand_down & ~rec
+        # 2. crash triggers
+        crash = ~rand_down & (next_down < t1)
+        rand_crash_t = jnp.where(crash, next_down, inf)
+        next_up = jnp.where(crash, next_down + dur_e, next_up)
+        next_down = jnp.where(crash, inf, next_down)
+        rand_down = rand_down | crash
+    else:
+        rand_down = jnp.zeros((F,), bool)
+        rand_crash_t = jnp.full((F,), inf, f32)
+        rand_rec_t = jnp.full((F,), inf, f32)
+
+    # scripted intervals: down for the tick ending at t1 iff
+    # t_down < t1 <= t_up (static entries, traced clock)
+    scripted_down = jnp.zeros((F,), bool)
+    s_crash_t = jnp.full((F,), inf, f32)
+    s_rec_t = jnp.full((F,), -inf, f32)
+    idx = jnp.arange(F, dtype=i32)
+    for f, td, tu in spec.chaos_script:
+        onehot = idx == int(f)
+        td = np.float32(td)
+        tu = np.float32(tu)
+        active = (td < t1) & (tu >= t1)
+        scripted_down = scripted_down | (onehot & active)
+        started = (td >= t0) & (td < t1)
+        s_crash_t = jnp.where(
+            onehot & started, jnp.minimum(s_crash_t, td), s_crash_t
+        )
+        ended = (tu >= t0) & (tu < t1)
+        s_rec_t = jnp.where(
+            onehot & ended, jnp.maximum(s_rec_t, tu), s_rec_t
+        )
+
+    up_new = ~(rand_down | scripted_down)
+    crashed = up_prev & ~up_new
+    recovered = ~up_prev & up_new
+    crash_t = jnp.clip(jnp.minimum(rand_crash_t, s_crash_t), t0, t1)
+    # a fog recovers when its LAST holding source releases it
+    recover_t = jnp.clip(
+        jnp.maximum(jnp.where(jnp.isfinite(rand_rec_t), rand_rec_t,
+                              -inf), s_rec_t),
+        t0, t1,
+    )
+    ch = ch.replace(
+        next_down=next_down,
+        next_up=next_up,
+        epoch=epoch,
+        down_ticks=ch.down_ticks + (~up_new).astype(i32),
+        n_crashes=ch.n_crashes + jnp.sum(crashed.astype(i32)),
+        n_recovers=ch.n_recovers + jnp.sum(recovered.astype(i32)),
+    )
+    return ch, up_new, crashed, recovered, crash_t, recover_t
+
+
+def rtt_factor(
+    spec: WorldSpec, ch: ChaosState, tick: jax.Array, t0: jax.Array
+) -> jax.Array:
+    """(F,) multiplier for the broker->fog rows of the delay cache.
+
+    Periodic term: ``1 + amp * (1 + sin(2*pi*t/period + phase_f)) / 2``
+    — each fog's phase offset is a static draw from the chaos stream,
+    so congestion waves do not hit every fog in lockstep.  Burst term:
+    per-fog Bernoulli(``chaos_rtt_burst_prob``) draws keyed on the TICK
+    INDEX (``fold_in(chaos_key, tick)``), multiplying by
+    ``chaos_rtt_burst_mult`` — a pure function of (key, tick), so
+    run/run_jit/run_chunked see the identical burst sequence.
+    """
+    F = spec.n_fogs
+    fac = jnp.ones((F,), jnp.float32)
+    if spec.chaos_rtt_amp > 0:
+        w = np.float32(2.0 * np.pi / spec.chaos_rtt_period_s)
+        fac = fac * (
+            1.0
+            + np.float32(spec.chaos_rtt_amp)
+            * 0.5
+            * (1.0 + jnp.sin(w * t0 + ch.rtt_phase))
+        )
+    if spec.chaos_rtt_burst_prob > 0:
+        kb = jax.random.fold_in(
+            jax.random.fold_in(ch.key, _RTT_BURST_FOLD),
+            tick.astype(jnp.int32),
+        )
+        burst = jax.random.uniform(kb, (F,)) < np.float32(
+            spec.chaos_rtt_burst_prob
+        )
+        fac = jnp.where(
+            burst, fac * np.float32(spec.chaos_rtt_burst_mult), fac
+        )
+    return fac
+
+
+# ----------------------------------------------------------------------
+# host-side readers (post-run / per chunk; one fetch each)
+# ----------------------------------------------------------------------
+
+def outage_timeline(
+    spec: WorldSpec,
+    chaos_key,
+    horizon: Optional[float] = None,
+    max_outages_per_fog: int = 10_000,
+) -> List[Tuple[int, float, float]]:
+    """Replay the full ``(fog, t_down, t_up)`` outage list on host.
+
+    Random schedules are a pure function of (chaos key, fog, epoch) —
+    the same ``fold_in`` draws the device carry machine consumes, so
+    this replay is exact, not a reconstruction.  Scripted intervals are
+    appended verbatim (clipped to the horizon).  Feeds the Perfetto
+    fog-lifecycle track (``telemetry/timeline.py``) and schedule-replay
+    tests.  ``chaos_key`` is ``final.chaos.key`` (constant through the
+    run) or anything array-like holding it.
+    """
+    hz = float(spec.horizon if horizon is None else horizon)
+    out: List[Tuple[int, float, float]] = []
+    for f, td, tu in spec.chaos_script:
+        if float(td) < hz:
+            out.append((int(f), float(td), min(float(tu), hz)))
+    if spec.chaos and spec.chaos_mtbf_s > 0:
+        key = jnp.asarray(np.asarray(chaos_key))
+        dt32 = np.float32(spec.dt)
+        mtbf32 = np.float32(spec.chaos_mtbf_s)
+        mttr32 = np.float32(spec.chaos_mttr_s)
+        # draws fetched in epoch CHUNKS (one vmapped dispatch per 64
+        # epochs per fog instead of one per outage — a churny wide
+        # world produces thousands) — same fold order as the device
+        chunk = 64
+        draw_chunk = jax.jit(
+            jax.vmap(
+                lambda k, e: jax.random.uniform(
+                    jax.random.fold_in(k, e), (2,), jnp.float32,
+                    minval=1e-7, maxval=1.0,
+                ),
+                in_axes=(None, 0),
+            )
+        )
+        for f in range(spec.n_fogs):
+            kf = jax.random.fold_in(key, f)
+            # f32 accumulation MIRRORS the device carry machine
+            # (next_down = next_up + gap etc. are f32 adds): a float64
+            # host sum could place an edge in a different tick
+            t = np.float32(0.0)
+            done = False
+            for e0 in range(0, max_outages_per_fog, chunk):
+                u = np.asarray(draw_chunk(
+                    kf, jnp.arange(e0, e0 + chunk, dtype=jnp.int32)
+                ))
+                gaps = np.maximum(-mtbf32 * np.log(u[:, 0]), dt32)
+                durs = np.maximum(-mttr32 * np.log(u[:, 1]), dt32)
+                for i in range(chunk):
+                    down = np.float32(t + gaps[i])
+                    if float(down) >= hz:
+                        done = True
+                        break
+                    up = np.float32(down + durs[i])
+                    out.append((f, float(down), min(float(up), hz)))
+                    t = up
+                if done:
+                    break
+    out.sort(key=lambda x: (x[0], x[1]))
+    return out
+
+
+def chaos_summary(spec: WorldSpec, final) -> Optional[dict]:
+    """Host roll-up of a finished chaos run (None when the subsystem is
+    off).  THE values every exposition publishes — the recorder's
+    ``.sca.json`` chaos section, the ``fns_chaos_*`` OpenMetrics
+    families and the flight-recorder manifests all read this one dict
+    (the ``busy_fractions`` single-source discipline)."""
+    if not spec.chaos:
+        return None
+    ch = final.chaos
+    return {
+        "mode": ChaosMode(spec.chaos_mode).name.lower(),
+        "crashes": int(np.asarray(ch.n_crashes)),
+        "recovers": int(np.asarray(ch.n_recovers)),
+        "lost_crash": int(np.asarray(ch.n_lost_crash)),
+        "reoffloaded": int(np.asarray(ch.n_reoffloaded)),
+        "retry_exhausted": int(np.asarray(ch.n_retry_exhausted)),
+        # plain ints: every consumer JSON-serializes this dict verbatim
+        "down_ticks": [int(x) for x in np.asarray(ch.down_ticks)],
+    }
+
+
+def chaos_counters(final) -> dict:
+    """Tiny per-chunk counter fetch for the live health plane (the
+    flight-recorder ``note_chunk`` extra): five scalars, no per-fog or
+    per-task leaves — safe at any serving cadence."""
+    ch = final.chaos
+    return {
+        "crashes": int(np.asarray(ch.n_crashes)),
+        "recovers": int(np.asarray(ch.n_recovers)),
+        "lost_crash": int(np.asarray(ch.n_lost_crash)),
+        "reoffloaded": int(np.asarray(ch.n_reoffloaded)),
+        "retry_exhausted": int(np.asarray(ch.n_retry_exhausted)),
+    }

@@ -152,6 +152,13 @@ def build_from_config(cfg: Config, seed: Optional[int] = None):
             field = pat[5:]
             if field not in spec_fields:
                 raise ValueError(f"unknown WorldSpec field {field!r}")
+            if field == "chaos_script":
+                # ini values are scalars: the scripted-outage schedule
+                # travels as one 'fog:t_down:t_up;...' string and is
+                # normalised to the spec's hashable tuple form here
+                from ..chaos.profiles import parse_script
+
+                v = parse_script(v)
             kwargs.setdefault(field, v)
 
     try:

@@ -188,6 +188,13 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             when=lambda sp: sp.n_fogs > 0,
         ),
         PhaseContract(
+            "_phase_chaos",
+            lambda sp, s, n, c, b, t0, t1: E._phase_chaos(
+                sp, s, n, c, b, t0, t1
+            ),
+            when=lambda sp: sp.chaos,
+        ),
+        PhaseContract(
             "_phase_learn_credit",
             lambda sp, s, n, c, b, t0, t1: E._phase_learn_credit(
                 sp, s, n, c, b, t1

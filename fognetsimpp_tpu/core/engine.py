@@ -43,8 +43,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos.faults import rtt_factor, step_lifecycle
 from ..learn.bandits import arms_view, exp3_probs
-from ..learn.rewards import credit_batch
+from ..learn.rewards import credit_batch, penalize_counts
 from ..net.mobility import MobilityBounds, step_mobility
 from ..net.energy import step_energy
 from ..net.topology import LinkCache, NetParams, associate
@@ -57,7 +58,14 @@ from ..ops.queues import (
     row_lexmin,
 )
 from ..ops.sched import scalar_winner, schedule_batch, task_uniform
-from ..spec import STATIC_MAC_ERR, FogModel, Policy, Stage, WorldSpec
+from ..spec import (
+    STATIC_MAC_ERR,
+    ChaosMode,
+    FogModel,
+    Policy,
+    Stage,
+    WorldSpec,
+)
 from ..state import WorldState
 from ..telemetry.health import accumulate_latency
 from ..telemetry.metrics import PHASE_INDEX, accumulate_tick, tick_activity
@@ -137,6 +145,15 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
     """
     if spec.n_fogs <= 0:
         return "TP tick needs fog nodes (n_fogs >= 1)"
+    if spec.chaos:
+        # checked FIRST among the feature gates: a chaos spec also
+        # fails the assume_static hoist below (chaos mutates liveness),
+        # and the actionable reason is the subsystem, not the symptom
+        return (
+            "TP tick does not carry the chaos fault-injection subsystem "
+            "yet (run chaos worlds on single-device run/run_jit/"
+            "run_chunked)"
+        )
     if spec.fog_model != int(FogModel.FIFO):
         return "TP tick covers FIFO fogs only (POOL pools are sequential)"
     if not _broker_dense_ok(spec):
@@ -1255,15 +1272,20 @@ def _phase_broker_dense(
 
     # key split kept for PRNG-stream alignment with the compacted path
     key, _ = jax.random.split(state.key)
-    any_fog = jnp.any(b.registered)
 
     # ---- scalar winner (shared formulas: ops/sched.py) ----------------
     fog_alive = state.nodes.alive[U : U + F]
+    # chaos worlds mask crashed fogs out of EVERY policy's candidate
+    # set (the broker observes liveness; the reference never evicts
+    # dead fogs — bug_compat — so this is gated on spec.chaos to keep
+    # chaos-off worlds bit-exact)
+    reg_eff = b.registered & fog_alive if spec.chaos else b.registered
+    any_fog = jnp.any(reg_eff)
     fog_efrac = state.nodes.energy[U : U + F] / jnp.maximum(
         state.nodes.energy_capacity[U : U + F], 1e-12
     )
     choice_s = scalar_winner(
-        spec.policy, b.view_busy, b.view_mips, b.registered, fog_alive,
+        spec.policy, b.view_busy, b.view_mips, reg_eff, fog_alive,
         fog_efrac, 2.0 * cache.d2b[U : U + F],
         spec.bug_compat.v1_max_scan,
     )
@@ -1541,11 +1563,15 @@ def _phase_broker(
             )
 
     # ---- offload scheduling ------------------------------------------
-    any_fog = jnp.any(b.registered)
     key, k_sched = jax.random.split(state.key)
     U = spec.n_users
     rtt_bf = 2.0 * cache.d2b[U : U + F]
     fog_alive = state.nodes.alive[U : U + F]
+    # chaos worlds mask crashed fogs out of every policy's candidate
+    # set (gated on spec.chaos: chaos-off worlds keep the reference's
+    # never-evicts-dead-fogs view, bit-exact)
+    reg_eff = b.registered & fog_alive if spec.chaos else b.registered
+    any_fog = jnp.any(reg_eff)
     fog_efrac = state.nodes.energy[U : U + F] / jnp.maximum(
         state.nodes.energy_capacity[U : U + F], 1e-12
     )
@@ -1564,7 +1590,7 @@ def _phase_broker(
         rand_u = None
     choice, rr_new = schedule_batch(
         spec.policy, offl, mips_g, b.view_busy, b.view_mips,
-        b.registered, fog_alive, fog_efrac, rtt_bf, b.rr_next, k_sched,
+        reg_eff, fog_alive, fog_efrac, rtt_bf, b.rr_next, k_sched,
         spec.bug_compat.mips0_divisor, spec.bug_compat.v1_max_scan,
         policy_id=b.policy_id, order_t=t_ab_g, rand_u=rand_u,
         learn=arms_view(state.learn) if spec.learn_active else None,
@@ -2758,6 +2784,180 @@ def _phase_local_completions(
     )
 
 
+def _phase_chaos(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t0: jax.Array, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Fault injection: fog crash/recover lifecycle + in-flight sweep.
+
+    Runs FIRST among the protocol phases (after the tick's
+    association/delay cache is built, before any dispatch), so an
+    outage scheduled inside ``[t0, t1)`` is already reflected in the
+    ``nodes.alive`` mask every dispatch/arrival/completion phase of
+    this tick respects.  Three jobs:
+
+    * advance the deterministic outage schedules
+      (:func:`fognetsimpp_tpu.chaos.faults.step_lifecycle`) and write
+      the per-fog up mask into ``nodes.alive``;
+    * sweep in-flight work off crashed fogs — ``spec.chaos_mode``
+      chooses LOSE (tasks drop into :data:`Stage.LOST`, counted in
+      ``ChaosState.n_lost_crash``) or RE-OFFLOAD (tasks bounce back to
+      the broker as fresh ``PUB_INFLIGHT`` arrivals at
+      ``crash_time + d(fog, broker)``, re-decided through the
+      established K-window contract, with a bounded per-task retry
+      budget; exhausted tasks are lost and counted separately).  The
+      crashed fog's server/queue/pool state is wiped (a restarted node
+      boots clean) and a fresh advertisement is put in flight at
+      recovery so the broker's view converges;
+    * resolve the learn-side credit of every swept decision
+      exactly-once as a zero-reward penalty
+      (:func:`fognetsimpp_tpu.learn.rewards.penalize_counts`) — lost
+      tasks never ack, so without this their picks would dangle as
+      unresolved optimism on a dead arm.
+
+    Only traced when ``spec.chaos`` is on; chaos-off worlds stay
+    bit-exact (tests/test_chaos.py A/Bs it).
+    """
+    U, F, T = spec.n_users, spec.n_fogs, spec.task_capacity
+    i32 = jnp.int32
+    f32 = jnp.float32
+    tasks = state.tasks
+
+    up_prev = state.nodes.alive[U : U + F]
+    ch, up_new, crashed, recovered, crash_t, recover_t = step_lifecycle(
+        spec, state.chaos, up_prev, t0, t1
+    )
+    nodes = state.nodes.replace(
+        alive=state.nodes.alive.at[U : U + F].set(up_new)
+    )
+
+    # ---- in-flight sweep over this tick's crash edges -----------------
+    # (T,)-gathers from (F,) tables: fine on the single-device paths
+    # this subsystem covers (the TP/fleet runners gate chaos off — a
+    # gather here serializes under collapsed vmap fan-out, r4)
+    has_fog = tasks.fog >= 0
+    fog_c = jnp.clip(tasks.fog, 0, F - 1)
+    st = tasks.stage
+    live = (
+        (st == _ST_TASK_INFLIGHT)
+        | (st == _ST_QUEUED)
+        | (st == _ST_RUNNING)
+    )
+    swept = has_fog & live & crashed[fog_c]
+    t_edge = crash_t[fog_c]
+
+    # learn-side exactly-once penalty on the picked (now dead) arms —
+    # booked BEFORE the fog column is cleared.  f32 scatter-add counts
+    # stay exact integers: learn-active specs bound task_capacity
+    # < 2^24 (learn/rewards._credit_counts_exact; hloaudit A4).
+    learn = state.learn
+    if spec.learn_active:
+        cnt_f = jnp.zeros((F,), f32).at[
+            jnp.where(swept, fog_c, F)
+        ].add(1.0, mode="drop")
+        learn = penalize_counts(learn, cnt_f)
+
+    reoffload = spec.chaos_mode == int(ChaosMode.REOFFLOAD)
+    if reoffload:
+        retry_new = ch.retry + swept.astype(jnp.int8)
+        exhausted = swept & (
+            retry_new.astype(i32) > spec.chaos_max_retries
+        )
+        bounce = swept & ~exhausted
+        terminal = exhausted
+        # bounce: back to the broker as a fresh publish arrival — the
+        # fog->broker hop models the orphan-detection round trip
+        d_fb_t = cache.d2b[U + fog_c]
+        tasks = tasks.replace(
+            stage=jnp.where(
+                bounce, _ST_PUB_INFLIGHT,
+                jnp.where(exhausted, _ST_LOST, st),
+            ),
+            t_at_broker=jnp.where(
+                bounce, t_edge + d_fb_t, tasks.t_at_broker
+            ),
+            fog=jnp.where(bounce, NO_TASK, tasks.fog),
+            t_at_fog=jnp.where(bounce, jnp.inf, tasks.t_at_fog),
+            t_q_enter=jnp.where(bounce, jnp.inf, tasks.t_q_enter),
+            t_service_start=jnp.where(
+                bounce, jnp.inf, tasks.t_service_start
+            ),
+            t_complete=jnp.where(swept, jnp.inf, tasks.t_complete),
+        )
+        ch = ch.replace(retry=retry_new)
+    else:
+        bounce = jnp.zeros((T,), bool)
+        exhausted = jnp.zeros((T,), bool)
+        terminal = swept
+        # LOSE: the fog column is kept as provenance (which arm the
+        # task died on — the timeline and the learn penalty both read
+        # it); stage LOST is terminal, so no phase ever revives it
+        tasks = tasks.replace(
+            stage=jnp.where(swept, _ST_LOST, st),
+            t_complete=jnp.where(swept, jnp.inf, tasks.t_complete),
+        )
+    if spec.learn_active:
+        # terminal rows resolve here, exactly once; bounced rows keep
+        # credited=0 and resolve at their eventual ack on the new arm
+        learn = learn.replace(
+            credited=jnp.maximum(
+                learn.credited, terminal.astype(jnp.int8)
+            )
+        )
+
+    # ---- crashed fogs reboot clean; recovered fogs re-advertise -------
+    fogs = state.fogs
+    fogs = fogs.replace(
+        current_task=jnp.where(crashed, NO_TASK, fogs.current_task),
+        busy_until=jnp.where(crashed, jnp.inf, fogs.busy_until),
+        busy_time=jnp.where(crashed, 0.0, fogs.busy_time),
+        free_since=jnp.where(recovered, recover_t, fogs.free_since),
+        queue=jnp.where(crashed[:, None], NO_TASK, fogs.queue),
+        q_head=jnp.where(crashed, 0, fogs.q_head),
+        q_len=jnp.where(crashed, 0, fogs.q_len),
+        pool_avail=jnp.where(crashed, fogs.mips, fogs.pool_avail),
+    )
+    b = state.broker
+    d_fb = cache.d2b[U : U + F]
+    adv_mips = (
+        fogs.pool_avail
+        if spec.fog_model == int(FogModel.POOL)
+        else fogs.mips
+    )
+    b = b.replace(
+        adv_val_mips=jnp.where(recovered, adv_mips, b.adv_val_mips),
+        adv_val_busy=jnp.where(recovered, 0.0, b.adv_val_busy),
+        adv_arrive_t=jnp.where(
+            recovered, recover_t + d_fb, b.adv_arrive_t
+        ),
+    )
+
+    # one stacked reduction for the sweep counters
+    sums = jnp.sum(
+        jnp.stack([bounce, exhausted, swept]).astype(i32), axis=1
+    )
+    if reoffload:
+        ch = ch.replace(
+            n_reoffloaded=ch.n_reoffloaded + sums[0],
+            n_retry_exhausted=ch.n_retry_exhausted + sums[1],
+        )
+    else:
+        ch = ch.replace(n_lost_crash=ch.n_lost_crash + sums[2])
+    # message accounting: each bounce is one orphan notice reaching the
+    # broker; each recovery puts one advertisement on the wire
+    buf = buf._replace(
+        rx_b=buf.rx_b + sums[0],
+        tx_f=buf.tx_f + recovered.astype(i32),
+    )
+    return (
+        state.replace(
+            nodes=nodes, tasks=tasks, fogs=fogs, broker=b,
+            learn=learn, chaos=ch,
+        ),
+        buf,
+    )
+
+
 def _phase_learn_credit(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t1: jax.Array,
@@ -2798,9 +2998,23 @@ def _phase_learn_credit(
         rot = None
     idx, idxc, valid = _compact(due, K, T, rot)
     fog_g = tasks.fog[idxc]  # picked-at-publish-time fog (provenance)
-    lat = jnp.where(
-        valid, tasks.t_ack6[idxc] - tasks.t_create[idxc], 0.0
-    )
+    # Credit-observation origin: publish time — except for a task the
+    # chaos subsystem re-offloaded (retry > 0): its t_at_broker was
+    # restamped at the bounce, and measuring from broker arrival
+    # charges each DECISION only its own leg — the rescue arm is not
+    # blamed for the crashed detour (the crashed pick already resolved
+    # as a zero-reward penalty in _phase_chaos).  Per-task, keyed on
+    # the retry column, so an inert chaos-on world (zero sweeps) stays
+    # bit-exact; the regret harness's reported task latency stays
+    # publish -> ack either way (runtime/signals.py).
+    lat0 = tasks.t_ack6[idxc] - tasks.t_create[idxc]
+    if spec.chaos:
+        lat0 = jnp.where(
+            state.chaos.retry[idxc] > 0,
+            tasks.t_ack6[idxc] - tasks.t_at_broker[idxc],
+            lat0,
+        )
+    lat = jnp.where(valid, lat0, 0.0)
     pick_p_g = learn.pick_p[idxc]
     memb = _per_fog(valid, fog_g, F)  # (F, K)
     learn = credit_batch(
@@ -2846,9 +3060,17 @@ def _phase_telemetry(
     bit-exact (tests/test_telemetry.py).  Pure carry endomorphism, so
     it rides the scan and the fleet's replica ``vmap`` unchanged.
     """
+    if spec.chaos:
+        U, F = spec.n_users, spec.n_fogs
+        chaos = state.chaos
+        fogs_down = jnp.sum(
+            (~state.nodes.alive[U : U + F]).astype(jnp.int32)
+        )
+    else:
+        chaos, fogs_down = None, None
     telem = accumulate_tick(
         spec, state.telem, state.fogs, state.learn, state.metrics,
-        state.tick, t1, phase_work,
+        state.tick, t1, phase_work, chaos=chaos, fogs_down=fogs_down,
     )
     return state.replace(telem=telem), buf
 
@@ -3042,6 +3264,27 @@ def make_step(
             cache = cache.replace(
                 d2b=cache.d2b + qdelay + qdelay[spec.broker_index]
             )
+
+        # chaos fault injection (spec.chaos, ISSUE 12): degrade the
+        # broker->fog delay rows for this tick (periodic + PRNG-burst
+        # terms keyed on the tick index — deterministic across every
+        # entry point), then run the lifecycle phase so crash/recover
+        # edges land in nodes.alive BEFORE any dispatch decision of
+        # this tick (and before the fused register views snapshot the
+        # task table below).
+        if spec.chaos:
+            if spec.chaos_rtt_amp > 0 or spec.chaos_rtt_burst_prob > 0:
+                with jax.named_scope("chaos_rtt"):
+                    fac = rtt_factor(spec, state.chaos, state.tick, t0)
+                    n_rest_c = spec.n_nodes - spec.n_users - spec.n_fogs
+                    full_fac = jnp.concatenate([
+                        jnp.ones((spec.n_users,), jnp.float32),
+                        fac,
+                        jnp.ones((n_rest_c,), jnp.float32),
+                    ])
+                    cache = cache.replace(d2b=cache.d2b * full_fac)
+            _ph("chaos", lambda: _phase_chaos(
+                spec, state, net, cache, buf, t0, t1))
 
         # fused per-user slot-window front-end (spec.fused_slots, r6):
         # spawn/broker/completions/arrivals thread the hot task-table

@@ -47,6 +47,31 @@ def _credit_counts_exact(k_rows: int) -> None:
         )
 
 
+def penalize_counts(learn: LearnState, cnt_f: jax.Array) -> LearnState:
+    """Zero-reward resolution of crashed picks (the ``chaos/`` hook).
+
+    ``cnt_f`` is the per-fog count of decisions whose task was swept by
+    a crash this tick (lost outright, bounced for re-offload, or
+    retry-exhausted).  Each such PICK resolves exactly once, here, as
+    the infimum of the bounded reward map (r = 0): the credit counters
+    grow with zero reward mass, dragging the arm's empirical mean down
+    — while ``reward_sum``/``disc_sum`` and the EXP3 log-weights are
+    untouched because a zero reward contributes zero importance-
+    weighted gain (EXP3's native treatment of a zero-reward round).
+    The observed-latency accumulators (``lat_sum``/``lat_cnt``) are
+    deliberately NOT touched: they feed the regret harness's
+    mean-credited-latency curve, which is defined over tasks that
+    actually acked.
+
+    No discount decay here — the D-UCB clock is time and lives in
+    :func:`credit_batch`, which runs once per tick regardless.
+    """
+    return learn.replace(
+        reward_cnt=learn.reward_cnt + cnt_f,
+        disc_cnt=learn.disc_cnt + cnt_f,
+    )
+
+
 def credit_batch(
     learn: LearnState,
     valid: jax.Array,  # (K,) bool — rows of this tick's credit window

@@ -72,6 +72,15 @@ def fold_replica_keys(key: jax.Array, n_replicas: int) -> jax.Array:
     )
 
 
+def _check_fleet_spec(spec: WorldSpec) -> None:
+    if spec.chaos:
+        raise ValueError(
+            "the fleet runner does not carry the chaos fault-injection "
+            "subsystem yet (replicas would share one fault schedule); "
+            "run chaos worlds on single-world run/run_jit/run_chunked"
+        )
+
+
 def _check_divisible(n_replicas: int, mesh: Mesh) -> None:
     d = int(mesh.devices.size)
     if n_replicas % d != 0:
@@ -118,6 +127,7 @@ def run_fleet(
     if mesh is None:
         mesh = make_mesh()
     R = int(jnp.shape(jax.tree.leaves(batch)[0])[0])
+    _check_fleet_spec(spec)
     _check_divisible(R, mesh)
     batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
     if not donate:
@@ -178,6 +188,7 @@ def fleet_decisions(
     if mesh is None:
         mesh = make_mesh()
     R = int(jnp.shape(jax.tree.leaves(batch)[0])[0])
+    _check_fleet_spec(spec)
     _check_divisible(R, mesh)
     batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
     return _fleet_pipeline(spec, R, batch, net, bounds, keys)
@@ -299,6 +310,7 @@ def run_fleet_series(
     if mesh is None:
         mesh = make_mesh()
     R = int(jnp.shape(jax.tree.leaves(batch)[0])[0])
+    _check_fleet_spec(spec)
     _check_divisible(R, mesh)
     batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
     total = spec.n_ticks

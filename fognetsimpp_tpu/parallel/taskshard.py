@@ -1122,7 +1122,7 @@ def _tp_program(
             t=rep["t"], tick=rep["tick"], key=rep["key"],
             nodes=nodes_l, users=users, fogs=rep["fogs"],
             broker=rep["broker"], tasks=tasks, metrics=rep["metrics"],
-            learn=rep["learn"], telem=telem_l,
+            learn=rep["learn"], chaos=rep["chaos"], telem=telem_l,
         )
 
         def tick(st, _):
@@ -1142,6 +1142,7 @@ def _tp_program(
             "t": final.t, "tick": final.tick, "key": final.key,
             "fogs": final.fogs, "broker": final.broker,
             "metrics": final.metrics, "learn": final.learn,
+            "chaos": final.chaos,
             "telem": telem_out,
             "nodes_rest": jax.tree.map(lambda x: x[U_loc:], final.nodes),
         }
@@ -1253,7 +1254,8 @@ def run_tp_sharded(
     final = WorldState(
         t=rep["t"], tick=rep["tick"], key=rep["key"], nodes=nodes,
         users=users, fogs=rep["fogs"], broker=rep["broker"], tasks=tasks,
-        metrics=rep["metrics"], learn=rep["learn"], telem=telem,
+        metrics=rep["metrics"], learn=rep["learn"], chaos=rep["chaos"],
+        telem=telem,
     )
     return spec, final
 
@@ -1386,6 +1388,9 @@ def _tp_setup(
             "t": state.t, "tick": state.tick, "key": state.key,
             "fogs": state.fogs, "broker": state.broker,
             "metrics": state.metrics, "learn": state.learn,
+            # inert by construction: tp_reject_reason gates chaos-on
+            # specs off the TP tick, so every chaos leaf is zero-row
+            "chaos": state.chaos,
             "telem": telem_rep, "nodes_rest": nodes_rest,
         }
     )

@@ -56,6 +56,13 @@ def dict_to_spec(d: Dict) -> WorldSpec:
         elif v == "-inf":
             d[k] = float("-inf")
     d["bug_compat"] = BugCompat(**d["bug_compat"])
+    if "chaos_script" in d:
+        # JSON round-trips the scripted-outage tuples as lists, which
+        # would make the reconstructed spec unhashable under jit
+        d["chaos_script"] = tuple(
+            (int(f), float(td), float(tu))
+            for f, td, tu in d["chaos_script"]
+        )
     return WorldSpec(**d).validate()
 
 
@@ -266,6 +273,12 @@ def record_run(
     from ..telemetry.health import hist_summary
 
     hist = hist_summary(spec, final)
+    if spec.chaos:
+        from ..chaos.faults import chaos_summary
+
+        chaos_sca = chaos_summary(spec, final)
+    else:
+        chaos_sca = None
     sca = {
         "run": run_id,
         "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -277,6 +290,10 @@ def record_run(
         # seconds next to the run scalars, same keys as the OpenMetrics
         # fns_compile_* families
         "compile_cache": compile_stats(),
+        # chaos fault-injection section (spec.chaos, ISSUE 12): the
+        # same chaos_summary() dict the fns_chaos_* exposition and the
+        # flight-recorder manifests read, so the outputs cannot drift
+        **({"chaos": chaos_sca} if chaos_sca is not None else {}),
         # global latency-histogram roll-up (spec.telemetry_hist): the
         # quantiles are hist_summary()'s — identical to the OpenMetrics
         # quantile gauges by construction

@@ -91,6 +91,18 @@ def summarize(final: WorldState) -> Dict[str, float]:
     # credited mean latency the regret harness compares against oracles.
     # pick_p has learn_capacity rows, so its size doubles as the
     # subsystem's is-active flag without needing the spec here.
+    # chaos fault-injection roll-up (chaos/): the per-fog schedule
+    # leaves double as the is-active flag (zero-row when chaos is off),
+    # the pick_p discipline below.  The chaos_* keys become the
+    # fns_chaos_* scalar OpenMetrics families via render_openmetrics'
+    # summarize() pass.
+    if np.asarray(final.chaos.next_down).size:
+        ch = final.chaos
+        out["chaos_crashes"] = int(ch.n_crashes)
+        out["chaos_recovers"] = int(ch.n_recovers)
+        out["chaos_lost_crash"] = int(ch.n_lost_crash)
+        out["chaos_reoffloaded"] = int(ch.n_reoffloaded)
+        out["chaos_retry_exhausted"] = int(ch.n_retry_exhausted)
     if np.asarray(final.learn.pick_p).size:
         lat_cnt = float(final.learn.lat_cnt)
         out["learn_credited"] = int(lat_cnt)

@@ -41,9 +41,14 @@ def build(
         max_sends_per_user = int(horizon / send_interval) + 4
     # all nodes are stationary on a wired star: the association/delay
     # cache is constant, so the engine may hoist it out of the scan
-    # (spec.assume_static) unless the energy lifecycle is on
+    # (spec.assume_static) unless a liveness-mutating subsystem is on
+    # (the energy lifecycle, or chaos crash/recover schedules)
     spec_overrides.setdefault(
-        "assume_static", not spec_overrides.get("energy_enabled", False)
+        "assume_static",
+        not (
+            spec_overrides.get("energy_enabled", False)
+            or spec_overrides.get("chaos", False)
+        ),
     )
     spec = WorldSpec(
         n_users=n_users,

@@ -171,6 +171,23 @@ class FogModel(enum.IntEnum):
     POOL = 1
 
 
+class ChaosMode(enum.IntEnum):
+    """In-flight task handling when a fog node crashes (``chaos/``).
+
+    LOSE: every task sitting on (or in flight to) the crashed fog is
+    dropped into :class:`Stage.LOST` and counted in
+    ``ChaosState.n_lost_crash`` — the iFogSim-style hard-failure model.
+    REOFFLOAD: those tasks bounce back to the base broker as fresh
+    ``PUB_INFLIGHT`` arrivals (through the established K-window
+    contract) with a bounded per-task retry budget
+    (``spec.chaos_max_retries``); tasks whose budget is exhausted are
+    lost and counted in ``ChaosState.n_retry_exhausted``.
+    """
+
+    LOSE = 0
+    REOFFLOAD = 1
+
+
 class Mobility(enum.IntEnum):
     """Per-node mobility model (INET equivalents cited).
 
@@ -455,6 +472,49 @@ class WorldSpec:
     # spec under-declares.
     mac_keyed: bool = False
 
+    # --- deterministic fault injection (fognetsimpp_tpu.chaos) ----------
+    # Master gate: carry a ChaosState pytree in the scan (fog-node
+    # crash/recover schedules, per-task re-offload retry counters,
+    # broker->fog RTT degradation) and trace the chaos lifecycle phase.
+    # Off (the default) keeps every chaos array leaf zero-row and the
+    # run bit-exact vs the chaos-less engine — the inert-LearnState /
+    # TelemetryState gate discipline (tests/test_chaos.py A/Bs it).
+    chaos: bool = False
+    # Seed of the chaos PRNG stream.  The stream is threefry-folded
+    # from the WORLD key at init (never split from it), so enabling
+    # chaos perturbs no draw of the main simulation stream, and two
+    # chaos seeds on one world seed give independent fault schedules.
+    chaos_seed: int = 0
+    # ChaosMode: what happens to tasks on a crashed fog (LOSE/REOFFLOAD).
+    chaos_mode: int = int(ChaosMode.LOSE)
+    # Random fog lifecycle: mean up-time between crashes and mean repair
+    # time, both in simulated seconds (exponential draws per fog per
+    # outage, keyed fold_in(fold_in(chaos_key, fog), outage_index) so
+    # host tooling can replay the exact schedule — chaos/faults.py
+    # outage_timeline).  mtbf <= 0 disables random crashes (scripted
+    # schedules and link degradation still apply).
+    chaos_mtbf_s: float = 0.0
+    chaos_mttr_s: float = 0.0
+    # REOFFLOAD retry budget: a task may bounce back to the broker at
+    # most this many times; the next crash loses it (retry-exhausted).
+    chaos_max_retries: int = 2
+    # Scripted outages: ((fog, t_down, t_up), ...) absolute-time
+    # intervals for reproducible scenarios; composes with the random
+    # schedule (a fog is down while ANY source holds it down).
+    chaos_script: Tuple[Tuple[int, float, float], ...] = ()
+    # Link degradation: time-varying broker->fog RTT perturbation over
+    # the tick's delay cache.  The periodic term multiplies each fog
+    # row of d2b by 1 + amp * (1 + sin(2*pi*t/period + phase_f)) / 2
+    # (phase_f a per-fog draw from the chaos stream, so fogs do not
+    # degrade in lockstep); the burst term multiplies by burst_mult on
+    # per-fog per-tick Bernoulli(burst_prob) draws keyed on the tick
+    # index (deterministic across run/run_jit/run_chunked).  Stale
+    # view_busy and latency estimates actually go stale under it.
+    chaos_rtt_amp: float = 0.0
+    chaos_rtt_period_s: float = 1.0
+    chaos_rtt_burst_prob: float = 0.0
+    chaos_rtt_burst_mult: float = 5.0
+
     # --- telemetry (fognetsimpp_tpu.telemetry) --------------------------
     # Plane-1 observability gate: carry a TelemetryState pytree in the
     # scan (per-fog queue-depth min/max/sum, busy fractions, pool
@@ -583,6 +643,18 @@ class WorldSpec:
         memory for it)."""
         return self.task_capacity if self.learn_active else 0
 
+    # --- chaos sizing (zero-row when the subsystem is off) -------------
+    @property
+    def chaos_fogs(self) -> int:
+        """Rows of the per-fog chaos schedule/accumulator leaves."""
+        return self.n_fogs if self.chaos else 0
+
+    @property
+    def chaos_tasks(self) -> int:
+        """Rows of the per-task re-offload retry column (0 when chaos
+        is off, so inert worlds pay no task-table-sized memory)."""
+        return self.task_capacity if self.chaos else 0
+
     # --- telemetry sizing (zero-row when the plane is off) -------------
     @property
     def telemetry_fogs(self) -> int:
@@ -688,6 +760,80 @@ class WorldSpec:
                 "inside the tick; derive_acks reconstructs the ack "
                 "columns only after the scan"
             )
+        if self.chaos:
+            # ValueError (not assert) on the user-reachable knobs: the
+            # CLI/config tier surfaces these as one actionable line
+            if self.assume_static:
+                raise ValueError(
+                    "chaos cannot run under assume_static: crash/recover "
+                    "schedules mutate fog liveness per tick (the energy-"
+                    "lifecycle restriction); build with assume_static="
+                    "False"
+                )
+            if self.energy_enabled:
+                raise ValueError(
+                    "chaos and the energy lifecycle both drive node "
+                    "liveness; enable one failure source per world"
+                )
+            if self.chaos_mode not in tuple(int(m) for m in ChaosMode):
+                raise ValueError(
+                    f"unknown chaos_mode {self.chaos_mode} (have "
+                    + ", ".join(
+                        f"{m.name.lower()}={int(m)}" for m in ChaosMode
+                    )
+                    + ")"
+                )
+            if self.chaos_mtbf_s > 0 and not (self.chaos_mttr_s > 0):
+                raise ValueError(
+                    "random crash schedules need a repair time: set "
+                    "chaos_mttr_s > 0 alongside chaos_mtbf_s"
+                )
+            if not (0 <= self.chaos_max_retries < 127):
+                raise ValueError(
+                    "chaos_max_retries must be in [0, 127) (the "
+                    "per-task retry column is int8)"
+                )
+            for ent in self.chaos_script:
+                if len(ent) != 3:
+                    raise ValueError(
+                        f"chaos_script entries are (fog, t_down, t_up), "
+                        f"got {ent!r}"
+                    )
+                f, td, tu = ent
+                if not (0 <= int(f) < self.n_fogs):
+                    raise ValueError(
+                        f"chaos_script fog index {f} out of range "
+                        f"[0, {self.n_fogs})"
+                    )
+                if not (0.0 <= float(td) < float(tu)):
+                    raise ValueError(
+                        f"chaos_script interval ({td}, {tu}) needs "
+                        "0 <= t_down < t_up"
+                    )
+                if float(tu) - float(td) < self.dt:
+                    raise ValueError(
+                        f"chaos_script interval ({td}, {tu}) is shorter "
+                        f"than one tick (dt={self.dt}): the engine "
+                        "observes liveness at tick boundaries, so a "
+                        "sub-tick outage would silently never fire — "
+                        "widen it to at least dt"
+                    )
+            if self.chaos_rtt_amp < 0 or self.chaos_rtt_period_s <= 0:
+                raise ValueError(
+                    "chaos_rtt_amp must be >= 0 with chaos_rtt_period_s "
+                    "> 0"
+                )
+            if not (0.0 <= self.chaos_rtt_burst_prob <= 1.0):
+                raise ValueError(
+                    "chaos_rtt_burst_prob is a probability, got "
+                    f"{self.chaos_rtt_burst_prob}"
+                )
+            if self.chaos_rtt_burst_prob > 0 and (
+                self.chaos_rtt_burst_mult <= 0
+            ):
+                raise ValueError(
+                    "chaos_rtt_burst_mult must be > 0 when bursts are on"
+                )
         if self.assume_static:
             assert not self.energy_enabled, (
                 "assume_static promises constant (pos, alive); the energy "

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from .chaos.faults import ChaosState, init_chaos_state
 from .learn.bandits import LearnState, init_learn_state
 from .spec import NodeKind, Policy, Stage, WorldSpec
 from .telemetry.metrics import TelemetryState, init_telemetry_state
@@ -225,6 +226,8 @@ class WorldState:
     metrics: Metrics
     learn: LearnState  # bandit-scheduler state (learn/bandits.py);
     #   inert zero-row provenance when spec.learn_active is False
+    chaos: ChaosState  # fault-injection schedules/counters
+    #   (chaos/faults.py); zero-row when spec.chaos is off
     telem: TelemetryState  # device-resident observability accumulators
     #   (telemetry/metrics.py); zero-row when spec.telemetry is off
 
@@ -377,5 +380,8 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         tasks=tasks,
         metrics=metrics,
         learn=init_learn_state(spec),
+        # the chaos stream is FOLDED from the world key (never split):
+        # enabling it perturbs no draw of the main simulation stream
+        chaos=init_chaos_state(spec, key),
         telem=init_telemetry_state(spec),
     )

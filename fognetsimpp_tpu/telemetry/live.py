@@ -45,8 +45,15 @@ from ..spec import WorldSpec
 #: (the ``defer`` signal) sits constant under sustained exchange-window
 #: overflow because the tick-keyed rotation spreads deferral evenly, so
 #: only the rate signal can page before a shard starves.
+#: ``fog_down`` / ``crash_loss_rate`` (ISSUE 12) ride the chaos
+#: reservoir columns: ``fog_down`` is the mean fraction of fogs down
+#: over the chunk (a flapping fog oscillates it — the z-score fires),
+#: and ``crash_loss_rate`` is the per-tick delta of the cumulative
+#: crash-loss column with an ABSOLUTE floor next to its z-score,
+#: exactly the ``defer_rate`` discipline: steady crash losses from
+#: tick 0 have zero variance and must still page.
 WATCH_SIGNALS = ("q_depth", "busy_frac", "drop_rate", "defer",
-                 "defer_rate")
+                 "defer_rate", "fog_down", "crash_loss_rate")
 
 
 class Ewma:
@@ -117,6 +124,7 @@ class Watchdog:
         alpha: float = 0.3,
         warmup: int = 3,
         defer_rate_floor: float = 1.0,
+        crash_loss_floor: float = 1.0,
         row_ticks: float = 1.0,
         anomaly_capacity: int = 256,
     ):
@@ -134,12 +142,18 @@ class Watchdog:
         # The EWMA floors (Ewma rel/abs) still apply to its z-score
         # like every other signal.
         self.defer_rate_floor = float(defer_rate_floor)
+        # crash-loss twin of the defer-rate floor (ISSUE 12): a fog
+        # that flaps and eats tasks at a CONSTANT per-tick rate never
+        # moves the z-score — any chunk whose mean crash-losses-per-
+        # tick exceeds this floor pages regardless of variance.
+        self.crash_loss_floor = float(crash_loss_floor)
         self.row_ticks = max(float(row_ticks), 1.0)
         self._trackers = {
             s: Ewma(alpha=alpha, warmup=warmup) for s in WATCH_SIGNALS
         }
         self._last_dropped: Optional[float] = None
         self._last_deferred: Optional[float] = None
+        self._last_crash_lost: Optional[float] = None
         # bounded ring (the FlightRecorder discipline): the defer-rate
         # FLOOR fires on EVERY chunk of a sustained-overflow run by
         # design — unbounded growth would leak host memory and bloat
@@ -184,6 +198,23 @@ class Watchdog:
                 deferred.size * self.row_ticks, 1.0
             )
             self._last_deferred = float(deferred[-1])
+        # chaos columns (ISSUE 12) — rows recorded by a pre-chaos build
+        # have neither; skip then (the postmortem .get-safety contract)
+        if "n_fogs_down" in rows:
+            sig["fog_down"] = float(
+                np.mean(rows["n_fogs_down"])
+            ) / self.n_fogs
+        if "lost_crash_total" in rows:
+            lost = np.asarray(rows["lost_crash_total"], dtype=float)
+            prev_l = (
+                self._last_crash_lost
+                if self._last_crash_lost is not None
+                else float(lost[0])
+            )
+            sig["crash_loss_rate"] = float(lost[-1] - prev_l) / max(
+                lost.size * self.row_ticks, 1.0
+            )
+            self._last_crash_lost = float(lost[-1])
         return sig
 
     def update(self, signals: Dict[str, float], ticks_done: int) -> List[Dict]:
@@ -203,6 +234,12 @@ class Watchdog:
             ):
                 # absolute floor trip: a sustained overflow is constant
                 # (z ~ 0) but still pages — see __init__
+                tripped, kind = True, "floor"
+            if (
+                name == "crash_loss_rate"
+                and value > self.crash_loss_floor
+            ):
+                # chaos twin (ISSUE 12): steady crash losses are z ~ 0
                 tripped, kind = True, "floor"
             if tripped:
                 fired.append(
@@ -306,6 +343,10 @@ class FlightRecorder:
             }
         if spec is not None:
             manifest["spec"] = spec_to_dict(spec)
+        if spec is not None and final is not None and spec.chaos:
+            from ..chaos.faults import chaos_summary
+
+            manifest["chaos"] = chaos_summary(spec, final)
         if spec is not None and final is not None:
             from .health import hist_summary
 
@@ -501,12 +542,17 @@ def serve_run(
             )
         else:
             h, bad, shard_hashes = None, {}, None
+        extra = {}
+        if shard_hashes:
+            extra["shard_hashes"] = shard_hashes
+        if spec.chaos:
+            # chaos counters ride every chunk entry (five scalars):
+            # a post-mortem of a churn run sees WHEN the losses grew
+            from ..chaos.faults import chaos_counters
+
+            extra["chaos"] = chaos_counters(s)
         recorder.note_chunk(
-            ticks_done, rows=rows, state_hash=h,
-            extra=(
-                {"shard_hashes": shard_hashes}
-                if shard_hashes else None
-            ),
+            ticks_done, rows=rows, state_hash=h, extra=extra or None,
         )
         fired = watchdog.update_from_rows(rows, ticks_done)
         if fired:

@@ -61,6 +61,11 @@ PHASES = (
     # carry the TP-only quantities a single device has no analog for.
     "tp_exchange",  # candidate slots seated in the exchange window
     "tp_defer",  # candidates deferred at the exchange window (overflow)
+    # --- chaos fault injection (ISSUE 12): appended after the TP slots
+    # so every established PHASE_INDEX stays stable; the chaos phase
+    # actually executes FIRST in the tick (display order here is not
+    # execution order for the post-TP entries).
+    "chaos",  # fog lifecycle edges + in-flight sweep + re-offloads
 )
 PHASE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(PHASES)}
 
@@ -74,9 +79,16 @@ PHASE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(PHASES)}
 #: tick-keyed rotation spreads deferral evenly), so a z-score watchdog
 #: on the gauge never fires — the defer RATE from consecutive
 #: cumulative samples is the signal that pages.
+#: ``n_fogs_down`` (gauge: fogs down at the sampled tick) and
+#: ``lost_crash_total`` (cumulative tasks lost to crashes, LOSE +
+#: retry-exhausted — monotone like ``n_dropped``) joined in ISSUE 12:
+#: the serving watchdog derives a crash-loss RATE from consecutive
+#: cumulative samples (a flapping fog must page even when each
+#: individual outage looks small), and both columns stay zero on
+#: chaos-off worlds.
 RES_FIELDS = (
     "t", "q_len_total", "n_busy", "n_deferred", "n_completed", "n_dropped",
-    "defer_total",
+    "defer_total", "n_fogs_down", "lost_crash_total",
 )
 
 #: Finite bucket upper edges of the per-shard exchange-window OCCUPANCY
@@ -221,6 +233,8 @@ def accumulate_tick(
     tick: jax.Array,
     t1: jax.Array,
     phase_work: Optional[Dict[int, jax.Array]] = None,
+    chaos=None,
+    fogs_down: Optional[jax.Array] = None,
 ) -> TelemetryState:
     """Fold one finished tick into the telemetry accumulators.
 
@@ -269,6 +283,17 @@ def accumulate_tick(
         stride = max(1, -(-spec.n_ticks // R))
         slot = (tick // stride).astype(i32)
         write = (tick % stride) == 0
+        # chaos columns (ISSUE 12): fogs down now + cumulative crash
+        # losses (LOSE + retry-exhausted) — zeros on chaos-off worlds
+        zero = jnp.zeros((), f32)
+        down_now = (
+            fogs_down.astype(f32) if fogs_down is not None else zero
+        )
+        lost_tot = (
+            (chaos.n_lost_crash + chaos.n_retry_exhausted).astype(f32)
+            if chaos is not None
+            else zero
+        )
         row = jnp.stack(
             [
                 t1.astype(f32),
@@ -282,6 +307,8 @@ def accumulate_tick(
                 # defer-rate signal needs a monotone column, like
                 # n_dropped next to it
                 telem.defer_sum.astype(f32),
+                down_now,
+                lost_tot,
             ]
         )
         telem = telem.replace(

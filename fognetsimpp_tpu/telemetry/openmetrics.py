@@ -244,6 +244,23 @@ def render_openmetrics(
         # on stamped TP runs (spec.tp_shards > 0)
         if summ.get("tp_exchange") is not None:
             _render_tp_exchange(lines, summ["tp_exchange"])
+    # chaos per-fog lifecycle family (ISSUE 12): the scalar counters
+    # already rendered as fns_chaos_* via summarize(); here the per-fog
+    # down-tick gauge — same chaos_summary() dict the recorder's
+    # .sca.json chaos section reads, so the two cannot drift
+    if spec.chaos:
+        from ..chaos.faults import chaos_summary
+
+        cs = chaos_summary(spec, final)
+        _family(
+            lines, "chaos_fog_down_ticks",
+            help_text="ticks each fog spent crashed over the run",
+        )
+        for f in range(spec.n_fogs):
+            _sample(
+                lines, "chaos_fog_down_ticks", cs["down_ticks"][f],
+                labels=f'{{fog="{f}"}}',
+            )
     # streaming latency histogram (spec.telemetry_hist, ISSUE 6)
     if hist is None:
         from .health import hist_summary

@@ -250,6 +250,37 @@ def _replica_events(
     return events
 
 
+def _chaos_lifecycle_events(
+    spec: WorldSpec, final, pid: int
+) -> List[Dict]:
+    """Per-fog lifecycle track (ISSUE 12): one ``fog_down`` span per
+    outage on the owning fog's lane, replayed on host from the
+    deterministic schedule (``chaos/faults.outage_timeline`` — the
+    chaos key rides the final state, and random schedules are a pure
+    function of it, so this is exact, not a reconstruction).  Empty on
+    chaos-off runs: every existing trace stays byte-identical.
+    """
+    if not spec.chaos:
+        return []
+    from ..chaos.faults import outage_timeline
+
+    events: List[Dict] = []
+    for f, td, tu in outage_timeline(spec, final.chaos.key):
+        events.append(
+            {
+                "name": "fog_down",
+                "ph": "X",
+                "pid": int(pid),
+                "tid": int(f),
+                "ts": float(td * 1e6),
+                "dur": float(max(tu - td, 0.0) * 1e6),
+                "cat": "chaos",
+                "args": {"fog": int(f)},
+            }
+        )
+    return events
+
+
 def _tp_exchange_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
     """Per-SHARD exchange-plane counter lanes (ISSUE 11).
 
@@ -311,6 +342,8 @@ def build_trace(
     if not batched:
         # per-shard exchange lanes on TP runs (no-op everywhere else)
         events.extend(_tp_exchange_events(spec, final, pid=n_rep))
+        # fog crash/recover lifecycle spans on chaos runs (ISSUE 12)
+        events.extend(_chaos_lifecycle_events(spec, final, pid=0))
     # metadata first, then spans by (ts, -dur): a parent span sorts
     # before its children, and Perfetto/golden checks see monotone ts
     events.sort(

@@ -82,6 +82,12 @@ _QUICK_FILES = {
     # 8-virtual-device mesh + the ring-exchange units — the same
     # tier-1 contract as the fleet runner's equivalence gate
     "test_tp.py",
+    # chaos fault injection (ISSUE 12): the inert-ChaosState
+    # bit-exactness gate, cross-entry-point schedule determinism,
+    # RE-OFFLOAD conservation, the exactly-once learn-credit property
+    # and the churn world where the bandits beat every static policy —
+    # the hostile-world capability belongs in the edit loop like learn/
+    "test_chaos.py",
     # distributed observability (ISSUE 11): per-shard phase-work /
     # exchange-gauge / hist A/Bs vs the single-device profile, the
     # serve --tp defer-rate watchdog + postmortem shard bisection, and

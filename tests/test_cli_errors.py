@@ -144,3 +144,92 @@ def test_tp_window_requires_tp(capsys):
         main(["--scenario", "smoke", "--tp-window", "4"])
     assert e.value.code == 2
     assert "--tp N" in capsys.readouterr().err
+
+
+# ---- chaos CLI surface (ISSUE 12) ------------------------------------
+
+def test_unknown_chaos_profile_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--chaos", "mayhem"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "unknown chaos profile" in captured.err
+    assert "Traceback" not in captured.err
+    # the catalogue is listed so the fix is obvious
+    assert "hostile" in captured.err and "flaky" in captured.err
+
+
+def test_chaos_seed_requires_chaos(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--chaos-seed", "3"])
+    assert e.value.code == 2
+    assert "--chaos <profile>" in capsys.readouterr().err
+
+
+def test_chaos_script_requires_chaos(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--chaos-script", "/tmp/x.json"])
+    assert e.value.code == 2
+    assert "--chaos <profile>" in capsys.readouterr().err
+
+
+def test_unknown_chaos_mode_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--chaos", "light",
+               "--chaos-mode", "explode"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "unknown chaos mode" in captured.err
+    assert "lose" in captured.err and "reoffload" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_malformed_chaos_script_file_is_clear_error(tmp_path, capsys):
+    bad = tmp_path / "script.json"
+    bad.write_text('[[0, 0.5]]')  # a pair, not a triple
+    rc = main(["--scenario", "smoke", "--chaos", "scripted",
+               "--chaos-script", str(bad)])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "t_down" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_chaos_script_fog_out_of_range_is_clear_error(tmp_path, capsys):
+    bad = tmp_path / "script.json"
+    bad.write_text('[[99, 0.1, 0.2]]')
+    rc = main(["--scenario", "smoke", "--chaos", "scripted",
+               "--chaos-script", str(bad)])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "out of range" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_chaos_conflicts_with_sweep(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--chaos", "light",
+              "--sweep", "policies=min_busy loads=0.05"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "--chaos" in err and "--sweep" in err
+
+
+def test_chaos_with_tp_is_clear_error(capsys):
+    """--tp rejects chaos worlds with the tp_reject_reason one-liner,
+    never a traceback."""
+    rc = main(["--scenario", "smoke", "--tp", "8", "--chaos", "light",
+               "--set", "scenario.horizon=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "chaos" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_chaos_with_replicas_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--chaos", "light",
+               "--set", "scenario.horizon=0.1", "--replicas", "8"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "chaos" in captured.err
+    assert "Traceback" not in captured.err

@@ -30,6 +30,12 @@ def _worlds():
         ),
         smoke.build(horizon=0.4, policy=8),  # Policy.UCB
         smoke.build(horizon=0.4, telemetry=True, telemetry_hist=True),
+        # chaos fault-injection world (ISSUE 12: the lifecycle/sweep
+        # phase + retry carry; assume_static off — liveness mutates)
+        smoke.build(
+            horizon=0.4, chaos=True, chaos_mode=1, chaos_mtbf_s=0.1,
+            chaos_mttr_s=0.05, chaos_script=((0, 0.1, 0.2),),
+        ),
     ]
 
 

@@ -464,10 +464,13 @@ def test_fleet_openmetrics_per_replica_phase_work():
     text = render_fleet_openmetrics(scalars, phase_work=pw)
     assert com.check_text(text, "fleet") == 0
     assert 'fns_fleet_phase_work{fleet="0",phase="connect"} 0' in text
+    # the LAST registered phase slot, whatever it is (phases appended
+    # since — e.g. ISSUE 12's "chaos" — must not silently fall off)
     assert (
-        f'fns_fleet_phase_work{{fleet="1",phase="tp_defer"}} '
+        f'fns_fleet_phase_work{{fleet="1",phase="{PHASES[-1]}"}} '
         f"{2 * len(PHASES) - 1}" in text
     )
+    assert 'phase="tp_defer"' in text
 
 
 def test_bench_trend_overhead_gate(tmp_path):

@@ -44,7 +44,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 #: round that did not record dt is never silently compared to a new one.
 SHAPE_FIELDS = (
     "metric", "backend", "n_users", "n_fogs", "dt", "arrival_window",
-    "policy", "n_devices", "n_replicas", "tp_shards",
+    "policy", "n_devices", "n_replicas", "tp_shards", "chaos",
 )
 
 #: Shape values a capture that predates the field is known to have run
@@ -60,6 +60,11 @@ SHAPE_DEFAULTS = {
     # None so the r6 TP captures form their own trajectory and the
     # replica-fleet/single-chip histories keep comparing like-for-like.
     "tp_shards": None,
+    # chaos fault injection arrived with ISSUE 12: every prior capture
+    # ran the happy path — backfill None so hostile-world rows
+    # (bench.py --chaos records a "chaos" string) form their own
+    # trajectory instead of regressing the happy-path ratchet.
+    "chaos": None,
 }
 
 
@@ -112,7 +117,7 @@ def _shape_str(shape: Tuple) -> str:
     d = dict(shape)
     bits = [str(d.get("metric") or "?"), str(d.get("backend") or "?")]
     for k in ("n_users", "n_fogs", "dt", "arrival_window", "n_devices",
-              "tp_shards"):
+              "tp_shards", "chaos"):
         if d.get(k) is not None:
             bits.append(f"{k}={d[k]}")
     return " ".join(bits)

@@ -190,6 +190,29 @@ def variants() -> List[Variant]:
             ),
         ),
         Variant(
+            "tick_chaos",
+            "the op-budget tick with the chaos fault-injection "
+            "subsystem live (REOFFLOAD churn: random MTBF/MTTR + a "
+            "scripted outage + periodic/burst RTT degradation) — the "
+            "fault path must stay host-transfer-free, f64-free and "
+            "collective-free like every single-device tick",
+            lambda: _compile_tick(
+                chaos=True,
+                chaos_mode=1,  # ChaosMode.REOFFLOAD
+                chaos_mtbf_s=0.05,
+                chaos_mttr_s=0.02,
+                chaos_max_retries=3,
+                chaos_script=((0, 0.005, 0.01),),
+                chaos_rtt_amp=0.5,
+                chaos_rtt_burst_prob=0.02,
+                # chaos mutates fog liveness: no static hoist, and the
+                # ack columns must stay eager (derive_acks needs
+                # assume_static)
+                assume_static=False,
+                derive_acks=False,
+            ),
+        ),
+        Variant(
             "fleet_step",
             "replica-sharded fleet scan on the 8-virtual-device mesh "
             "(declared collectives: none — the zero-steady-state claim)",

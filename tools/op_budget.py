@@ -103,6 +103,19 @@ def compile_tick_counts(fused: bool) -> dict:
     return entry_op_counts(compiled.as_text())
 
 
+def compile_chaos_counts() -> dict:
+    """Compile the chaos-on tick (the hloaudit ``tick_chaos`` shape)
+    and count its HLO ops — the fault path's own kernel-count pin
+    (ISSUE 12): chaos adds a lifecycle phase, an in-flight sweep and
+    the RTT perturbation to every tick, so a regression here is a
+    hostile-world throughput loss CI should catch like any other."""
+    from tools.hloaudit.variants import variants
+
+    v = next(x for x in variants() if x.name == "tick_chaos")
+    text, _spec = v.compile_fn()
+    return entry_op_counts(text)
+
+
 def compile_tp_counts(telemetry: bool = False) -> dict:
     """Compile the shard_map'd TP sharded tick and count its HLO ops +
     collectives (ISSUE 9).
@@ -158,6 +171,7 @@ def measure(tp: bool = True) -> dict:
     """
     fused = compile_tick_counts(fused=True)
     unfused = compile_tick_counts(fused=False)
+    chaos = compile_chaos_counts()
     out_tp = {}
     if tp:
         for key, telem in (("tp_tick", False),
@@ -180,6 +194,11 @@ def measure(tp: bool = True) -> dict:
         "max_ops": int(fused["ops"] * COUNT_SLACK),
         "max_fusions": int(fused["fusions"] * COUNT_SLACK),
         "max_fused_ratio": MAX_FUSED_RATIO,
+        "tick_chaos": {
+            **chaos,
+            "max_ops": int(chaos["ops"] * COUNT_SLACK),
+            "max_fusions": int(chaos["fusions"] * COUNT_SLACK),
+        },
         **out_tp,
     }
 
@@ -207,6 +226,22 @@ def check(measured: dict, budget: dict) -> list:
             f"fused/unfused ops ratio {ratio:.3f} > {cap} — the "
             f"fused front-end lost its kernel-count reduction"
         )
+    # --- the chaos fault-injection tick (ISSUE 12) ---------------------
+    tc, btc = measured.get("tick_chaos"), budget.get("tick_chaos")
+    if tc is not None:
+        if btc is None:
+            errs.append(
+                "budget file predates the tick_chaos variant — "
+                "regenerate with --write"
+            )
+        else:
+            for k, cap_key in (("ops", "max_ops"),
+                               ("fusions", "max_fusions")):
+                if tc[k] > btc[cap_key]:
+                    errs.append(
+                        f"tick_chaos {k} regressed: {tc[k]} > "
+                        f"budget {btc[cap_key]}"
+                    )
     # --- the TP sharded ticks (ISSUE 9; telemetry-on since ISSUE 11) ---
     for key in ("tp_tick", "tp_tick_telemetry"):
         tp = measured.get(key)

@@ -75,6 +75,17 @@ def summarize(d: Dict) -> List[str]:
             f"latency:     n={hist.get('count')} "
             f"quantiles_ms={json.dumps(hist.get('quantiles_ms'))}"
         )
+    chaos = d.get("chaos") or {}
+    if chaos:
+        # chaos manifests (ISSUE 12): .get-safe like every other
+        # optional field — pre-chaos bundles simply skip the line
+        out.append(
+            "chaos:       "
+            f"mode={chaos.get('mode')} crashes={chaos.get('crashes')} "
+            f"lost_crash={chaos.get('lost_crash')} "
+            f"reoffloaded={chaos.get('reoffloaded')} "
+            f"retry_exhausted={chaos.get('retry_exhausted')}"
+        )
     cc = d.get("compile_cache") or {}
     if cc:
         out.append(
