@@ -756,6 +756,132 @@ def chaos_measurement() -> dict:
     }
 
 
+def reconfig_measurement() -> dict:
+    """Warm re-configuration benchmark (ISSUE 13): cold compile vs warm
+    knob tweak on the promoted (shape-key + DynSpec operand) path.
+
+    ``python bench.py --reconfig`` builds a chaos-on world at a pinned
+    CPU-friendly shape, pays the cold compile ONCE via the promoted
+    ``run_jit``, then re-configures promoted knobs (RTT burst
+    amplitude, MTBF, reward scale) and re-runs.  The warm run must
+    trigger ZERO compile events (``compile_stats()`` snapshot/delta —
+    the satellite accounting this round added) and land >= 10x faster
+    than the cold compile; both numbers ride the JSON (``reconfig_s``
+    next to ``compile_s``) so ``tools/bench_trend.py --check`` gates
+    warm-reconfig regressions like any throughput loss.
+
+    Headline value = compile_s / reconfig_s (the warm-reconfig speedup,
+    higher is better — ratchet-compatible with bench_trend's
+    best-prior comparison).
+
+    Env knobs: BENCH_RECONFIG_USERS / BENCH_RECONFIG_FOGS /
+    BENCH_RECONFIG_HORIZON / BENCH_RECONFIG_INTERVAL.
+    """
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu import compile_cache
+    from fognetsimpp_tpu.compile_cache import (
+        compile_stats,
+        enable_compile_cache,
+        note_compile,
+    )
+    from fognetsimpp_tpu.core.engine import run_jit
+    from fognetsimpp_tpu.dynspec import registry_stats
+    from fognetsimpp_tpu.scenarios import smoke
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+
+    # Pinned CPU shape: the warm wall INCLUDES the re-configured run
+    # itself (the honest number an operator waits for), so the horizon
+    # is sized to the serve loop's chunk scale (150 ticks ~ one scrape
+    # interval) rather than a long batch run — compile cost is
+    # scan-length-invariant, run wall is not.
+    n_users = _env_int("BENCH_RECONFIG_USERS", 256)
+    n_fogs = _env_int("BENCH_RECONFIG_FOGS", 8)
+    horizon = _env_float("BENCH_RECONFIG_HORIZON", 0.15)
+    interval = _env_float("BENCH_RECONFIG_INTERVAL", 0.005)
+
+    def build(**overrides):
+        kw = dict(
+            n_users=n_users,
+            n_fogs=n_fogs,
+            horizon=horizon,
+            send_interval=interval,
+            max_sends_per_user=int(horizon / interval) + 4,
+            chaos=True,
+            chaos_mtbf_s=0.1,
+            chaos_mttr_s=0.05,
+            chaos_rtt_amp=0.5,
+            chaos_rtt_period_s=0.5,
+            chaos_rtt_burst_prob=0.02,
+            uplink_loss_prob=0.01,
+        )
+        kw.update(overrides)
+        return smoke.build(**kw)
+
+    # --- cold: first world in the shape bucket pays the compile -------
+    spec, state, net, bounds = build()
+    t0 = time.perf_counter()
+    jax.block_until_ready(run_jit(spec, state, net, bounds, promote=True))
+    compile_s = time.perf_counter() - t0
+    note_compile(compile_s)
+
+    # --- warm: re-configured knobs re-use the compiled program --------
+    knob_tweaks = {
+        "chaos_rtt_amp": 1.75,
+        "chaos_rtt_burst_prob": 0.08,
+        "chaos_mtbf_s": 0.05,
+        "uplink_loss_prob": 0.04,
+    }
+    walls = []
+    decisions = 0
+    compiles_delta = 0.0
+    for rep in range(3):
+        spec2, state2, net2, bounds2 = build(**knob_tweaks)
+        snap = compile_cache.snapshot()
+        t0 = time.perf_counter()
+        final = run_jit(spec2, state2, net2, bounds2, promote=True)
+        jax.block_until_ready(final.metrics.n_scheduled)
+        walls.append(time.perf_counter() - t0)
+        compiles_delta += compile_cache.delta_since(snap)["compiles"]
+        decisions = int(np.asarray(final.metrics.n_scheduled))
+    reconfig_s = sorted(walls)[len(walls) // 2]
+
+    return {
+        "metric": "warm_reconfig_speedup",
+        "value": round(compile_s / reconfig_s, 1),
+        "unit": "x (cold compile / warm reconfig)",
+        "backend": backend,
+        "n_users": n_users,
+        "n_fogs": n_fogs,
+        "horizon_s": horizon,
+        "dt": 1e-3,
+        "policy": "min_busy",
+        "compile_s": round(compile_s, 2),
+        "reconfig_s": round(reconfig_s, 4),
+        "reconfig_walls_s": [round(w, 4) for w in walls],
+        "reconfig_compile_events": compiles_delta,
+        "knob_tweaks": knob_tweaks,
+        "decisions": decisions,
+        "program_registry": registry_stats(),
+        "compile_cache": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in compile_stats().items()
+            if not isinstance(v, dict)
+        },
+        "promoted": "dynspec.split_spec: shape key static + DynSpec "
+        "operand; bit-exact vs the static path (tests/test_dynspec.py)",
+    }
+
+
+def reconfig_main() -> None:
+    """``python bench.py --reconfig`` (or ``BENCH_RECONFIG=1``): the
+    ISSUE 13 headline — cold compile vs zero-compile warm knob tweak."""
+    print(json.dumps(reconfig_measurement()))
+
+
 def chaos_main() -> None:
     """``python bench.py --chaos`` (or ``BENCH_CHAOS=1``): the
     hostile-world headline — the bench world under fog churn + link
@@ -791,5 +917,7 @@ if __name__ == "__main__":
         tp_main()
     elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS"):
         chaos_main()
+    elif "--reconfig" in sys.argv or os.environ.get("BENCH_RECONFIG"):
+        reconfig_main()
     else:
         main()

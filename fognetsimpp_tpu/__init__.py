@@ -35,5 +35,15 @@ from .core.engine import (  # noqa: F401
     run_chunked,
     run_jit,
 )
+from .dynspec import (  # noqa: F401
+    DYN_FIELDS,
+    DynSpec,
+    apply_knobs,
+    bucket_spec,
+    bucket_users,
+    dyn_of,
+    shape_key,
+    split_spec,
+)
 
 __version__ = "0.1.0"

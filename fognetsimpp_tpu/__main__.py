@@ -272,6 +272,26 @@ def main(argv=None) -> int:
         if "=" not in o:
             ap.error(f"--set needs KEY=VALUE, got {o!r}")
         pre.append(o.replace("=", " = ", 1))
+        key = o.split("=", 1)[0].strip()
+        if key.startswith("spec.") and "*" not in key:
+            # one-line recompile classification (ISSUE 13): dynamic-
+            # operand knobs re-use the compiled program, shape-defining
+            # fields pay a fresh compile — surfaced BEFORE the run so a
+            # what-if operator knows which wall they are about to hit.
+            # Unknown fields fail here with the config tier's own
+            # message (one line, before any world is built).
+            from .dynspec import classify_field
+
+            try:
+                recompiles, why = classify_field(key[5:])
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            print(
+                f"recompile: {'yes' if recompiles else 'no'} "
+                f"({key}: {why})",
+                file=sys.stderr,
+            )
     if args.chaos is not None:
         # profile lines land BELOW the --set overrides (first match
         # wins), so --set spec.chaos_*=... refines any profile knob

@@ -36,6 +36,17 @@ from flax import struct
 
 from ..spec import ChaosMode, WorldSpec
 
+
+def _dv(spec: WorldSpec, dyn):
+    """The DynSpec view of the chaos knobs (ISSUE 13): the promoted
+    operand when the engine passes one, else the host-constant fold of
+    the spec's own values (bit-identical to the pre-promotion trace)."""
+    if dyn is not None:
+        return dyn
+    from ..dynspec import dyn_of
+
+    return dyn_of(spec)
+
 #: Domain separator folded into the world key to derive the chaos
 #: stream (so chaos_seed=0 still decorrelates from the world draws).
 _CHAOS_FOLD = 0x0C4A05
@@ -88,13 +99,19 @@ def _chaos_key(spec: WorldSpec, key: jax.Array) -> jax.Array:
 
 
 def _outage_draws(
-    spec: WorldSpec, key: jax.Array, epoch: jax.Array
+    spec: WorldSpec, key: jax.Array, epoch: jax.Array, dyn=None
 ) -> Tuple[jax.Array, jax.Array]:
     """(gap, duration) exponential draws for each fog's ``epoch``-th
     outage, both clamped to >= dt so every outage spans at least one
     tick (which statically rules out same-tick crash->recover blips —
-    see :func:`step_lifecycle`'s ordering argument)."""
+    see :func:`step_lifecycle`'s ordering argument).
+
+    MTBF/MTTR come from the DynSpec operand when the caller promotes
+    them (the draws' UNIFORMS are keyed on (key, fog, epoch) only, so a
+    re-configured MTBF rescales the same stream — exactly the host
+    replay's contract)."""
     F = epoch.shape[0]
+    dv = _dv(spec, dyn)
 
     def one(f, e):
         k = jax.random.fold_in(jax.random.fold_in(key, f), e)
@@ -104,12 +121,8 @@ def _outage_draws(
 
     u = jax.vmap(one)(jnp.arange(F, dtype=jnp.int32), epoch)  # (F, 2)
     dt = np.float32(spec.dt)
-    gap = jnp.maximum(
-        -np.float32(spec.chaos_mtbf_s) * jnp.log(u[:, 0]), dt
-    )
-    dur = jnp.maximum(
-        -np.float32(max(spec.chaos_mttr_s, 0.0)) * jnp.log(u[:, 1]), dt
-    )
+    gap = jnp.maximum(-dv.chaos_mtbf_s * jnp.log(u[:, 0]), dt)
+    dur = jnp.maximum(-dv.chaos_mttr_s * jnp.log(u[:, 1]), dt)
     return gap, dur
 
 
@@ -161,6 +174,7 @@ def step_lifecycle(
     up_prev: jax.Array,  # (F,) bool — fog liveness entering this tick
     t0: jax.Array,
     t1: jax.Array,
+    dyn=None,  # Optional[DynSpec]: promoted MTBF/MTTR operands
 ):
     """Advance the outage schedules one tick.
 
@@ -183,8 +197,8 @@ def step_lifecycle(
     inf = jnp.inf
 
     if spec.chaos_mtbf_s > 0:
-        _, dur_e = _outage_draws(spec, ch.key, epoch)
-        gap_next, _ = _outage_draws(spec, ch.key, epoch + 1)
+        _, dur_e = _outage_draws(spec, ch.key, epoch, dyn)
+        gap_next, _ = _outage_draws(spec, ch.key, epoch + 1, dyn)
         rand_down = jnp.isfinite(next_up)
         # 1. recoveries
         rec = rand_down & (next_up < t1)
@@ -247,7 +261,8 @@ def step_lifecycle(
 
 
 def rtt_factor(
-    spec: WorldSpec, ch: ChaosState, tick: jax.Array, t0: jax.Array
+    spec: WorldSpec, ch: ChaosState, tick: jax.Array, t0: jax.Array,
+    dyn=None,
 ) -> jax.Array:
     """(F,) multiplier for the broker->fog rows of the delay cache.
 
@@ -260,26 +275,22 @@ def rtt_factor(
     run/run_jit/run_chunked see the identical burst sequence.
     """
     F = spec.n_fogs
+    dv = _dv(spec, dyn)
     fac = jnp.ones((F,), jnp.float32)
     if spec.chaos_rtt_amp > 0:
-        w = np.float32(2.0 * np.pi / spec.chaos_rtt_period_s)
         fac = fac * (
             1.0
-            + np.float32(spec.chaos_rtt_amp)
+            + dv.chaos_rtt_amp
             * 0.5
-            * (1.0 + jnp.sin(w * t0 + ch.rtt_phase))
+            * (1.0 + jnp.sin(dv.chaos_rtt_omega * t0 + ch.rtt_phase))
         )
     if spec.chaos_rtt_burst_prob > 0:
         kb = jax.random.fold_in(
             jax.random.fold_in(ch.key, _RTT_BURST_FOLD),
             tick.astype(jnp.int32),
         )
-        burst = jax.random.uniform(kb, (F,)) < np.float32(
-            spec.chaos_rtt_burst_prob
-        )
-        fac = jnp.where(
-            burst, fac * np.float32(spec.chaos_rtt_burst_mult), fac
-        )
+        burst = jax.random.uniform(kb, (F,)) < dv.chaos_rtt_burst_prob
+        fac = jnp.where(burst, fac * dv.chaos_rtt_burst_mult, fac)
     return fac
 
 

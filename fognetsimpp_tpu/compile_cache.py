@@ -18,7 +18,7 @@ import hashlib
 import os
 import platform
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 # ----------------------------------------------------------------------
 # compile-latency observability (ISSUE 6): the ROADMAP's streaming
@@ -37,6 +37,10 @@ _STATS: Dict[str, float] = {
 }
 _CACHE_DIR: Optional[str] = None
 _LISTENING = False
+#: Extra stat sections merged into :func:`compile_stats` output under
+#: their registered name (e.g. the dynspec program registry) — callers
+#: get ONE dict for the bench JSON / OpenMetrics / flight recorder.
+_PROVIDERS: Dict[str, Callable[[], Dict]] = {}
 
 
 def _on_event(event: str, **kw) -> None:
@@ -97,11 +101,71 @@ def compile_stats() -> Dict:
     Keys: ``cache_hits`` / ``cache_misses`` (persistent-cache events),
     ``compiles`` / ``compile_s_total`` / ``compile_s_max`` (backend
     compile durations from jax.monitoring), the ``noted_*`` manual
-    entries, plus ``cache_dir`` (None when the cache is disabled).
+    entries, plus ``cache_dir`` (None when the cache is disabled) and
+    one section per registered stats provider (ISSUE 13: the
+    ``program_registry`` shape-bucket accounting rides here).
     """
     with _LOCK:
         out: Dict = dict(_STATS)
+        providers = dict(_PROVIDERS)
     out["cache_dir"] = _CACHE_DIR
+    for name, fn in providers.items():
+        try:
+            out[name] = fn()
+        except Exception:
+            # observability must never take down the serving loop
+            out[name] = None
+    return out
+
+
+def register_stats_provider(name: str, fn: Callable[[], Dict]) -> None:
+    """Attach an extra stats section to :func:`compile_stats` output.
+
+    Idempotent per name (last registration wins); the provider must be
+    cheap and exception-safe — it runs on every stats snapshot, which
+    the ``--serve`` loop takes per chunk.
+    """
+    with _LOCK:
+        _PROVIDERS[name] = fn
+
+
+def snapshot() -> Dict[str, float]:
+    """Point-in-time copy of the NUMERIC compile counters.
+
+    ``compile_stats()`` is cumulative process-wide, so bench rounds and
+    serve chunks could never attribute compile seconds to themselves
+    (ISSUE 13 satellite); pair this with :func:`delta_since` to scope
+    an interval:
+
+        before = compile_cache.snapshot()
+        ...  # the warm re-configure / bench round / serve chunk
+        d = compile_cache.delta_since(before)
+        assert d["compiles"] == 0
+    """
+    with _LOCK:
+        return {
+            k: float(v) for k, v in _STATS.items()
+            if isinstance(v, (int, float))
+        }
+
+
+def delta_since(before: Dict[str, float]) -> Dict[str, float]:
+    """Numeric counter deltas since a :func:`snapshot`.
+
+    Counters that appeared after the snapshot (e.g. the first
+    ``noted_*`` entry) delta from zero; ``compile_s_max`` is a running
+    maximum, not a counter, so its delta is the NEW max when it grew
+    (0.0 otherwise) — a zero means no compile observed since the
+    snapshot beat the prior worst.
+    """
+    now = snapshot()
+    out: Dict[str, float] = {}
+    for k, v in now.items():
+        prev = float(before.get(k, 0.0))
+        if k == "compile_s_max":
+            out[k] = v if v > prev else 0.0
+        else:
+            out[k] = v - prev
     return out
 
 

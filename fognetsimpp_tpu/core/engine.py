@@ -44,6 +44,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..chaos.faults import rtt_factor, step_lifecycle
+from ..dynspec import (
+    DynSpec,
+    dyn_of,
+    promote_default,
+    registry_note,
+    split_spec,
+)
 from ..learn.bandits import arms_view, exp3_probs
 from ..learn.rewards import credit_batch, penalize_counts
 from ..net.mobility import MobilityBounds, step_mobility
@@ -360,12 +367,15 @@ def _rot_and_defer(
 
 
 
-def offered_rate_vector(spec: WorldSpec, alive_u, users, t0) -> jax.Array:
+def offered_rate_vector(
+    spec: WorldSpec, alive_u, users, t0, dyn: Optional[DynSpec] = None
+) -> jax.Array:
     """Per-node offered frame rate (frames/s) for the Bianchi contention
     keying: a user's publish rate while it is actively publishing, zero
     otherwise.  SHARED between the engine's tick (below) and the native
     DES's delay-table chain (native/bridge.py) — the two must stay
     bit-identical or wireless parity silently breaks."""
+    dv = dyn if dyn is not None else dyn_of(spec)
     publishing = (
         alive_u
         & users.connected
@@ -374,7 +384,7 @@ def offered_rate_vector(spec: WorldSpec, alive_u, users, t0) -> jax.Array:
         & jnp.isfinite(users.next_send)
     )
     if spec.send_stop_time != float("inf"):
-        publishing = publishing & (t0 < spec.send_stop_time)
+        publishing = publishing & (t0 < dv.send_stop_time)
     return jnp.concatenate(
         [
             jnp.where(publishing, 1.0 / users.send_interval, 0.0).astype(
@@ -514,6 +524,7 @@ def _phase_spawn(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
     views: Optional[dict] = None, tp: Optional[TpCtx] = None,
+    dyn: Optional[DynSpec] = None,
 ):
     """Users whose send timer fired publish one task (mqttApp2.cc:353-409).
 
@@ -533,6 +544,7 @@ def _phase_spawn(
     U, T, S = spec.n_users, spec.task_capacity, spec.max_sends_per_user
     users, tasks = state.users, state.tasks
     alive_u = state.nodes.alive[:U]
+    dv = dyn if dyn is not None else dyn_of(spec)
 
     due = (
         alive_u
@@ -547,7 +559,7 @@ def _phase_spawn(
         # restarted node reschedules sends only before it (mqttApp2.cc:
         # 191-210); gate the actual creation time so a node resuming
         # after stopTime cannot publish
-        due = due & (t_create < spec.send_stop_time)
+        due = due & (t_create < dv.send_stop_time)
 
     if spec.wired_queue_enabled:
         key, k_mips, k_jit, k_loss, k_dtail = jax.random.split(state.key, 5)
@@ -579,24 +591,23 @@ def _phase_spawn(
             nb = float(spec.link_burst_n - 1)
             pos = jnp.where(
                 k <= nb,
-                k * jnp.float32(spec.link_drain_s),
-                nb * jnp.float32(spec.link_drain_s)
-                + (k - nb) * jnp.float32(spec.link_drain2_s),
+                k * dv.link_drain_s,
+                dv.link_burst_base + (k - nb) * dv.link_drain2_s,
             )
         else:
-            pos = k * jnp.float32(spec.link_drain_s)
-        drained = spec.link_up_s + pos
+            pos = k * dv.link_drain_s
+        drained = dv.link_up_s + pos
         if spec.link_buffer_frames > 0:
             # mechanistic pre-link-up buffer (see spec.link_buffer_frames):
             # creations while the link is down either sit in the bounded
             # pending queue (send index < capacity -> drain schedule) or
             # overflow deterministically; post-link-up sends go direct
-            pre = t_create < spec.link_up_s
+            pre = t_create < dv.link_up_s
             buffered = pre & (users.send_count < spec.link_buffer_frames)
             t_arrive = jnp.where(buffered, drained, t_arrive)
             warm_lost = pre & ~buffered
         else:
-            t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
+            t_arrive = jnp.where(t_arrive < dv.link_up_s, drained, t_arrive)
             buffered = None
             warm_lost = None
     else:
@@ -614,7 +625,7 @@ def _phase_spawn(
     lost = jnp.zeros((U,), bool)
     has_mac = net.mac_loss_tab.shape[0] > 0
     if spec.uplink_loss_prob > 0 or has_mac:
-        p_eff = jnp.full((U,), spec.uplink_loss_prob, jnp.float32)
+        p_eff = jnp.full((U,), dv.uplink_loss_prob, jnp.float32)
         if has_mac:
             p_eff = 1.0 - (1.0 - p_eff) * (1.0 - cache.mac_loss_p[:U])
         lost = (
@@ -630,7 +641,7 @@ def _phase_spawn(
             # in the random-loss draw)
             lost = lost & ~buffered
         elif spec.link_up_s > 0:
-            lost = lost & (t_create + d_ub >= spec.link_up_s)
+            lost = lost & (t_create + d_ub >= dv.link_up_s)
     if spec.wired_queue_enabled:
         # DropTail: a publish entering a full egress queue (its own link
         # or the broker's) is tail-dropped with last tick's overflow
@@ -726,6 +737,7 @@ def _phase_spawn_multi(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
     views: Optional[dict] = None, tp: Optional[TpCtx] = None,
+    dyn: Optional[DynSpec] = None,
 ):
     """Closed-form multi-send spawn: up to ``spec.max_sends_per_tick``
     publishes per user per tick, each with its exact event time.
@@ -749,6 +761,7 @@ def _phase_spawn_multi(
     users, tasks = state.users, state.tasks
     alive_u = state.nodes.alive[:U]
     i32 = jnp.int32
+    dv = dyn if dyn is not None else dyn_of(spec)
 
     can = alive_u & users.connected & users.publisher
     base = jnp.maximum(users.next_send, t0)  # (U,) chain start this window
@@ -765,7 +778,7 @@ def _phase_spawn_multi(
         & (fire < t1)
     )
     if spec.send_stop_time != float("inf"):
-        due2 = due2 & (fire < spec.send_stop_time)
+        due2 = due2 & (fire < dv.send_stop_time)
 
     if spec.wired_queue_enabled:
         key, k_mips, k_loss, k_dtail = jax.random.split(state.key, 4)
@@ -802,21 +815,20 @@ def _phase_spawn_multi(
             nb = float(spec.link_burst_n - 1)
             pos = jnp.where(
                 kf <= nb,
-                kf * jnp.float32(spec.link_drain_s),
-                nb * jnp.float32(spec.link_drain_s)
-                + (kf - nb) * jnp.float32(spec.link_drain2_s),
+                kf * dv.link_drain_s,
+                dv.link_burst_base + (kf - nb) * dv.link_drain2_s,
             )
         else:
-            pos = kf * jnp.float32(spec.link_drain_s)
-        drained = spec.link_up_s + pos
+            pos = kf * dv.link_drain_s
+        drained = dv.link_up_s + pos
         if spec.link_buffer_frames > 0:
             # mechanistic pre-link-up buffer (see _phase_spawn)
-            pre2 = fire < spec.link_up_s
+            pre2 = fire < dv.link_up_s
             buffered2 = pre2 & (k < spec.link_buffer_frames)
             t_arrive = jnp.where(buffered2, drained, t_arrive)
             warm_lost2 = pre2 & ~buffered2
         else:
-            t_arrive = jnp.where(t_arrive < spec.link_up_s, drained, t_arrive)
+            t_arrive = jnp.where(t_arrive < dv.link_up_s, drained, t_arrive)
             buffered2 = None
             warm_lost2 = None
     else:
@@ -827,7 +839,7 @@ def _phase_spawn_multi(
     if spec.uplink_loss_prob > 0 or has_mac:
         # residual fitted loss + load-dependent Bianchi retry exhaustion
         # (see _phase_spawn); one uniform lane per window send
-        p_eff = jnp.full((U,), spec.uplink_loss_prob, jnp.float32)
+        p_eff = jnp.full((U,), dv.uplink_loss_prob, jnp.float32)
         if has_mac:
             p_eff = 1.0 - (1.0 - p_eff) * (1.0 - cache.mac_loss_p[:U])
         draws_l = _tp_user_draw(
@@ -837,7 +849,7 @@ def _phase_spawn_multi(
         if buffered2 is not None:
             lost2 = lost2 & ~buffered2  # buffered frames deliver reliably
         elif spec.link_up_s > 0:
-            lost2 = lost2 & (fire + d_ub[:, None] >= spec.link_up_s)
+            lost2 = lost2 & (fire + d_ub[:, None] >= dv.link_up_s)
     if spec.wired_queue_enabled:
         p_u = state.nodes.link_drop_p[:U]
         p_b = state.nodes.link_drop_p[spec.broker_index]
@@ -2787,6 +2799,7 @@ def _phase_local_completions(
 def _phase_chaos(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
+    dyn: Optional[DynSpec] = None,
 ) -> Tuple[WorldState, TickBuf]:
     """Fault injection: fog crash/recover lifecycle + in-flight sweep.
 
@@ -2822,10 +2835,11 @@ def _phase_chaos(
     i32 = jnp.int32
     f32 = jnp.float32
     tasks = state.tasks
+    dv = dyn if dyn is not None else dyn_of(spec)
 
     up_prev = state.nodes.alive[U : U + F]
     ch, up_new, crashed, recovered, crash_t, recover_t = step_lifecycle(
-        spec, state.chaos, up_prev, t0, t1
+        spec, state.chaos, up_prev, t0, t1, dyn=dv
     )
     nodes = state.nodes.replace(
         alive=state.nodes.alive.at[U : U + F].set(up_new)
@@ -2861,7 +2875,7 @@ def _phase_chaos(
     if reoffload:
         retry_new = ch.retry + swept.astype(jnp.int8)
         exhausted = swept & (
-            retry_new.astype(i32) > spec.chaos_max_retries
+            retry_new.astype(i32) > dv.chaos_max_retries
         )
         bounce = swept & ~exhausted
         terminal = exhausted
@@ -2960,7 +2974,7 @@ def _phase_chaos(
 
 def _phase_learn_credit(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
-    buf: TickBuf, t1: jax.Array,
+    buf: TickBuf, t1: jax.Array, dyn: Optional[DynSpec] = None,
 ) -> Tuple[WorldState, TickBuf]:
     """Delayed-reward credit assignment for the bandit schedulers.
 
@@ -2979,6 +2993,7 @@ def _phase_learn_credit(
     tasks, learn = state.tasks, state.learn
     T, F, K = spec.task_capacity, spec.n_fogs, spec.window
     i32 = jnp.int32
+    dv = dyn if dyn is not None else dyn_of(spec)
 
     due = (
         (tasks.stage == _ST_DONE)
@@ -3019,7 +3034,7 @@ def _phase_learn_credit(
     memb = _per_fog(valid, fog_g, F)  # (F, K)
     learn = credit_batch(
         learn, valid, memb, lat, pick_p_g,
-        spec.n_fogs, spec.learn_discount, spec.learn_reward_scale,
+        spec.n_fogs, dv.learn_discount, dv.learn_reward_scale,
     )
     learn = learn.replace(
         credited=learn.credited.at[idx].set(jnp.int8(1), mode="drop")
@@ -3168,16 +3183,27 @@ def make_step(
     :class:`LinkCache` here — the per-tick mobility + association kernels
     are then skipped entirely (bit-identical: the cache is a pure
     function of the constant ``(pos, alive)``).
+
+    ``dyn`` (ISSUE 13): the promoted numeric knobs as a device operand.
+    ``None`` (the static path) folds :func:`~fognetsimpp_tpu.dynspec.
+    dyn_of` at trace time, embedding the same host f32 constants the
+    pre-promotion engine used — so the two paths execute identical
+    arithmetic and the promoted entry points can be state-hash A/B'd
+    against this one.  With a :class:`DynSpec` operand, ``spec`` should
+    be the world's SHAPE KEY (``dynspec.shape_key``) so every world in
+    the bucket hits one compiled program.
     """
     spec.validate()
 
     def step(
         state: WorldState, net: NetParams, bounds: MobilityBounds,
         static_cache: Optional[LinkCache] = None,
+        dyn: Optional[DynSpec] = None,
     ):
         t0 = state.tick.astype(jnp.float32) * spec.dt
         t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
         i32 = jnp.int32
+        dv = dyn if dyn is not None else dyn_of(spec)
         buf = TickBuf(
             tx_u=jnp.zeros((spec.n_users,), i32),
             rx_u=jnp.zeros((spec.n_users,), i32),
@@ -3245,7 +3271,7 @@ def make_step(
                 if net.mac_loss_tab.shape[0] > 0:
                     offered = offered_rate_vector(
                         spec, state.nodes.alive[: spec.n_users],
-                        state.users, t0,
+                        state.users, t0, dyn=dv,
                     )
                 cache = associate(
                     net, state.nodes.pos, state.nodes.alive,
@@ -3260,7 +3286,7 @@ def make_step(
             # backlog — directionally wrong under asymmetric congestion;
             # exact in aggregate for the symmetric request/ack traffic of
             # the committed scenarios.
-            qdelay = state.nodes.link_backlog * (8.0 / spec.link_rate_bps)
+            qdelay = state.nodes.link_backlog * dv.link_inv_rate
             cache = cache.replace(
                 d2b=cache.d2b + qdelay + qdelay[spec.broker_index]
             )
@@ -3275,7 +3301,9 @@ def make_step(
         if spec.chaos:
             if spec.chaos_rtt_amp > 0 or spec.chaos_rtt_burst_prob > 0:
                 with jax.named_scope("chaos_rtt"):
-                    fac = rtt_factor(spec, state.chaos, state.tick, t0)
+                    fac = rtt_factor(
+                        spec, state.chaos, state.tick, t0, dyn=dv
+                    )
                     n_rest_c = spec.n_nodes - spec.n_users - spec.n_fogs
                     full_fac = jnp.concatenate([
                         jnp.ones((spec.n_users,), jnp.float32),
@@ -3284,7 +3312,7 @@ def make_step(
                     ])
                     cache = cache.replace(d2b=cache.d2b * full_fac)
             _ph("chaos", lambda: _phase_chaos(
-                spec, state, net, cache, buf, t0, t1))
+                spec, state, net, cache, buf, t0, t1, dyn=dv))
 
         # fused per-user slot-window front-end (spec.fused_slots, r6):
         # spawn/broker/completions/arrivals thread the hot task-table
@@ -3314,10 +3342,10 @@ def make_step(
                 spec, state, net, cache, t0, t1))
         if spec.max_sends_per_tick > 1:
             out = _ph("spawn", lambda: _phase_spawn_multi(
-                spec, state, net, cache, buf, t0, t1, views=fv))
+                spec, state, net, cache, buf, t0, t1, views=fv, dyn=dv))
         else:
             out = _ph("spawn", lambda: _phase_spawn(
-                spec, state, net, cache, buf, t0, t1, views=fv))
+                spec, state, net, cache, buf, t0, t1, views=fv, dyn=dv))
         if fused:
             fv = out
         v2_local = (
@@ -3433,7 +3461,7 @@ def make_step(
             # delayed-reward credit: after completions/arrivals so a
             # status-6 ack that lands inside this tick credits this tick
             _ph("learn_credit", lambda: _phase_learn_credit(
-                spec, state, net, cache, buf, t1))
+                spec, state, net, cache, buf, t1, dyn=dv))
         if spec.telemetry_hist:
             # streaming latency histogram: after completions/acks so a
             # status-6 ack landing inside this tick streams this tick
@@ -3467,7 +3495,7 @@ def make_step(
         # beyond frameCapacity becomes next tick's tail-drop probability
         if spec.wired_queue_enabled:
             add_bytes = tx_all.astype(jnp.float32) * float(spec.task_bytes)
-            drain = jnp.float32(spec.link_rate_bps / 8.0 * spec.dt)
+            drain = dv.link_drain_bytes
             raw = state.nodes.link_backlog + add_bytes - drain
             cap_bytes = float(spec.link_queue_frames * spec.task_bytes)
             wired = ~net.is_wireless
@@ -3509,7 +3537,7 @@ def make_step(
                 energy, alive = step_energy(
                     spec, state.nodes.energy, state.nodes.energy_capacity,
                     state.nodes.has_energy, state.nodes.alive, t1,
-                    tx_all, rx_all, computing,
+                    tx_all, rx_all, computing, dyn=dv,
                 )
             state = state.replace(
                 nodes=state.nodes.replace(energy=energy, alive=alive)
@@ -3598,6 +3626,7 @@ def run(
     net: NetParams,
     bounds: Optional[MobilityBounds] = None,
     n_ticks: Optional[int] = None,
+    dyn: Optional[DynSpec] = None,
 ) -> Tuple[WorldState, Optional[dict]]:
     """Run ``n_ticks`` (default: spec horizon) under one `lax.scan`.
 
@@ -3605,6 +3634,11 @@ def run(
     (queue lengths, busy times, alive count) when
     ``spec.record_tick_series`` — the ``.vec``-file analog (SURVEY.md §5
     tracing).
+
+    ``dyn`` (ISSUE 13): promoted numeric knobs as a device operand —
+    pass ``dynspec.split_spec(world)``'s parts as ``(spec, dyn)`` so
+    every world in the shape bucket traces to one program.  ``None``
+    keeps the spec's own values as trace constants (bit-identical).
     """
     if bounds is None:
         from ..net.mobility import default_bounds
@@ -3626,7 +3660,7 @@ def run(
 
     def body(carry, _):
         if record:
-            s, aux = step(carry, net, bounds, static_cache)
+            s, aux = step(carry, net, bounds, static_cache, dyn)
             out = {
                 "t": s.t,
                 "busy_time": s.fogs.busy_time,
@@ -3651,7 +3685,7 @@ def run(
                 # Tkenv movement-trail analog (runtime/trails.py)
                 out["pos"] = s.nodes.pos
         else:
-            s = step(carry, net, bounds, static_cache)
+            s = step(carry, net, bounds, static_cache, dyn)
             out = None
         return s, out
 
@@ -3705,6 +3739,8 @@ def run_chunked(
     chunk_ticks: int = 10_000,
     callback: Optional[Callable[[WorldState, int], None]] = None,
     telemetry_stream: Optional[Callable[[dict, int], None]] = None,
+    promote: Optional[bool] = None,
+    reconfigure: Optional[Callable[[int], Optional[dict]]] = None,
 ) -> WorldState:
     """Advance an arbitrarily long horizon in fixed-size scan chunks.
 
@@ -3740,7 +3776,25 @@ def run_chunked(
     does NOT disable donation: the rows are fetched to host before the
     next chunk consumes the state, and nothing device-resident is
     retained.
+
+    ``promote`` / ``reconfigure`` (ISSUE 13, the what-if door): with
+    promotion on (the default), the chunk program takes the promoted
+    knobs as a DynSpec operand, and ``reconfigure(ticks_done)`` — called
+    at every chunk boundary — may return a ``{field: value}`` dict of
+    promoted WorldSpec knobs to apply to the REMAINING horizon with
+    ZERO recompiles (``compile_stats()`` delta-provable).  Returning
+    ``None``/``{}`` keeps the current knobs.  A dict naming a
+    shape-defining field (or flipping a trace gate, e.g. turning chaos
+    bursts on for a world compiled without them) raises the one-line
+    ``dynspec.apply_knobs`` error instead of silently recompiling.
     """
+    if promote is None:
+        promote = promote_default()
+    if reconfigure is not None and not promote:
+        raise ValueError(
+            "reconfigure re-configures the DynSpec operand between "
+            "chunks; it needs the promoted path (promote=True)"
+        )
     if spec.record_tick_series:
         raise ValueError(
             "run_chunked does not collect per-tick series; run() per chunk "
@@ -3754,11 +3808,25 @@ def run_chunked(
     total = spec.n_ticks
     chunk = min(chunk_ticks, total)
 
+    if promote:
+        from ..dynspec import apply_knobs
+
+        live_spec = spec
+        run_spec, dyn = split_spec(spec)
+        # the callback path runs the NON-donating go_keep executable —
+        # a distinct donation layout, hence a distinct registry program
+        registry_note(
+            run_spec, jax.default_backend(), donated=callback is None
+        )
+    else:
+        run_spec, dyn = spec, None
+
     @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
     def go(
-        n: int, s: WorldState, net_: NetParams, bounds_: MobilityBounds
+        n: int, s: WorldState, net_: NetParams, bounds_: MobilityBounds,
+        dyn_: Optional[DynSpec],
     ) -> WorldState:
-        final, _ = run(spec, s, net_, bounds_, n_ticks=n)
+        final, _ = run(run_spec, s, net_, bounds_, n_ticks=n, dyn=dyn_)
         return final
 
     # simlint: disable=R6 -- the callback path must NOT donate: callbacks
@@ -3766,9 +3834,10 @@ def run_chunked(
     # next chunk would delete those buffers behind the callback's back
     @functools.partial(jax.jit, static_argnums=0)
     def go_keep(
-        n: int, s: WorldState, net_: NetParams, bounds_: MobilityBounds
+        n: int, s: WorldState, net_: NetParams, bounds_: MobilityBounds,
+        dyn_: Optional[DynSpec],
     ) -> WorldState:
-        final, _ = run(spec, s, net_, bounds_, n_ticks=n)
+        final, _ = run(run_spec, s, net_, bounds_, n_ticks=n, dyn=dyn_)
         return final
 
     if telemetry_stream is not None and not spec.telemetry:
@@ -3782,9 +3851,9 @@ def run_chunked(
     while done < total:
         n = min(chunk, total - done)
         if donating:
-            state = go(n, _dealias_for_donation(state), net, bounds)
+            state = go(n, _dealias_for_donation(state), net, bounds, dyn)
         else:
-            state = go_keep(n, state, net, bounds)
+            state = go_keep(n, state, net, bounds, dyn)
         done += n
         if telemetry_stream is not None:
             from ..telemetry.metrics import reservoir_progress
@@ -3795,20 +3864,47 @@ def run_chunked(
             telemetry_stream(rows, done)
         if callback is not None:
             callback(state, done)
+        if reconfigure is not None and done < total:
+            knobs = reconfigure(done)
+            if knobs:
+                # compile-free by construction: apply_knobs rejects any
+                # change that would alter the shape key, and the chunk
+                # program re-runs with the new operand values only
+                live_spec = apply_knobs(live_spec, knobs)
+                dyn = dyn_of(live_spec)
     return state
 
 
 def run_jit(
-    spec: WorldSpec, state: WorldState, net: NetParams, bounds: MobilityBounds
+    spec: WorldSpec, state: WorldState, net: NetParams,
+    bounds: MobilityBounds, promote: Optional[bool] = None,
 ) -> WorldState:
-    """Whole-run jit entry (spec static): scan over the full horizon.
+    """Whole-run jit entry: scan over the full horizon.
 
     ``state`` is DONATED (simlint R6): the carry dominates the bytes/tick
     footprint, and donation lets XLA alias the initial state's buffers
     into the scan carry instead of copying them.  Do not reuse ``state``
     after calling; rebuild (or ``jax.tree.map(jnp.copy, ...)``) if the
     initial world is needed again.
+
+    ``promote`` (ISSUE 13, default on; ``FNS_SPEC_PROMOTE=0`` flips the
+    default): split the spec into its shape key (static) and DynSpec
+    operand, so re-configuring any promoted numeric knob — a chaos
+    MTBF, an RTT burst amplitude, an energy power budget — re-uses the
+    compiled program instead of paying the 8-56 s compile wall.
+    ``promote=False`` is the bit-exact static reference path
+    (tests/test_dynspec.py A/Bs the two).
     """
+    if promote is None:
+        promote = promote_default()
+    if promote:
+        key_spec, dyn = split_spec(spec)
+        registry_note(key_spec, jax.default_backend(), donated=True)
+        return _run_jit_dyn(
+            key_spec, _dealias_for_donation(state), net, bounds, dyn
+        )
+    # simlint: disable=R12 -- exclusive branch: the promoted return above
+    # already left the function, so `state` cannot be a donated alias here
     return _run_jit(spec, _dealias_for_donation(state), net, bounds)
 
 
@@ -3817,6 +3913,18 @@ def _run_jit(
     spec: WorldSpec, state: WorldState, net: NetParams, bounds: MobilityBounds
 ) -> WorldState:
     final, _ = run(spec, state, net, bounds)
+    return final
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _run_jit_dyn(
+    spec: WorldSpec, state: WorldState, net: NetParams,
+    bounds: MobilityBounds, dyn: DynSpec,
+) -> WorldState:
+    """The promoted whole-run program: ``spec`` is a SHAPE KEY
+    (``dynspec.shape_key``), every numeric knob rides ``dyn`` — one
+    jit-cache entry serves the whole shape bucket."""
+    final, _ = run(spec, state, net, bounds, dyn=dyn)
     return final
 
 

@@ -1,0 +1,507 @@
+"""One program, many worlds: dynamic-operand spec promotion (ISSUE 13).
+
+Every jitted entry point takes the whole :class:`~fognetsimpp_tpu.spec.
+WorldSpec` as a static argument, so historically changing ANY numeric
+knob — a chaos MTBF, an RTT burst amplitude, an energy power budget —
+threw away an 8-56 s XLA compile to re-run a sub-second tick program.
+This module splits the spec into
+
+* a **shape key** (:func:`shape_key`) — the spec with every promoted
+  numeric knob replaced by a gate-preserving canonical value, so two
+  worlds that differ only in knob *values* hash to the SAME static
+  argument and share one compiled program; and
+* a **DynSpec operand** (:func:`dyn_of`) — a tiny pytree of f32/i32
+  scalars carrying the knob values onto the device as run-time data.
+
+The correctness rail is bit-exactness: each DynSpec leaf is derived on
+host with EXACTLY the arithmetic the static path used to fold into the
+trace (``np.float32(spec.x)``, ``np.float32(2*pi/period)``, ...), so a
+promoted run and a static run execute the same f32 ops on the same f32
+values (tests/test_dynspec.py state-hash A/Bs the three policy-family
+worlds across run/run_jit/run_chunked).  When ``dyn`` is ``None`` the
+engine calls :func:`dyn_of` at trace time and the leaves are embedded
+as the same host constants as before — the static path IS the promoted
+path with constants, which is what makes the A/B trivial to reason
+about.
+
+Gate discipline: a handful of promoted fields also steer *Python-level*
+trace structure (``if spec.uplink_loss_prob > 0:`` ...).  The canonical
+values preserve each field's gate class (zero vs positive, finite vs
+inf), so the shape key always selects the same trace as the real spec;
+the values inside that trace come from the operand.  simlint rule R13
+flags any NEW engine read of a promoted field that bypasses the operand
+(closure re-capture is how this win would silently rot).
+
+Knobs deliberately left static are listed in :data:`STATIC_REASONS`
+with one-line reasons — the CLI ``--set`` classification
+(:func:`classify_field`) and the README table both read it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import struct
+
+from .spec import WorldSpec
+
+# ----------------------------------------------------------------------
+# the promoted-field catalogue
+# ----------------------------------------------------------------------
+
+#: WorldSpec fields promoted to DynSpec operands: their VALUE (never
+#: their shape) reaches the traced tick, so changing them re-uses the
+#: compiled program.  Keep in sync with tools/simlint/rules.py R13
+#: (tests/test_dynspec.py pins the two lists equal).
+DYN_FIELDS: Tuple[str, ...] = (
+    # wireless / link scalars
+    "uplink_loss_prob",
+    "send_stop_time",
+    "link_up_s",
+    "link_drain_s",
+    "link_drain2_s",
+    "link_rate_bps",
+    # chaos fault-injection knobs (ISSUE 12)
+    "chaos_mtbf_s",
+    "chaos_mttr_s",
+    "chaos_rtt_amp",
+    "chaos_rtt_period_s",
+    "chaos_rtt_burst_prob",
+    "chaos_rtt_burst_mult",
+    "chaos_max_retries",
+    # learned-scheduler reward weights
+    "learn_discount",
+    "learn_reward_scale",
+    # energy-model scalars
+    "idle_power_w",
+    "tx_energy_j",
+    "rx_energy_j",
+    "compute_power_w",
+    "harvest_power_w",
+    "harvest_period_s",
+    "harvest_duty",
+    "shutdown_frac",
+    "start_frac",
+)
+
+#: Numeric knobs deliberately kept static, with the one-line reason the
+#: tentpole demands (any knob that cannot stay bit-exact as an operand
+#: stays static, documented).  Everything not listed here and not in
+#: DYN_FIELDS is shape/gate/policy-defining by construction.
+STATIC_REASONS: Dict[str, str] = {
+    "dt": "sets n_ticks (the scan length) — shape-defining",
+    "horizon": "sets n_ticks (the scan length) — shape-defining",
+    "send_interval": "already dynamic: rides users.send_interval in the "
+    "state (the sweep load axis)",
+    "send_interval_jitter": "resample gate is trace structure "
+    "(volatile-par draw per send)",
+    "start_time_min": "folded into users.start_t at state init",
+    "start_time_max": "folded into users.start_t at state init",
+    "mips_required_min": "jax.random.randint bound — the draw pipeline "
+    "is specialized on the static bound",
+    "mips_required_max": "jax.random.randint bound — the draw pipeline "
+    "is specialized on the static bound",
+    "fixed_mips_required": "None-vs-value selects the draw-free spawn "
+    "trace",
+    "required_time": "v2 release pre-selection compares it to dt at "
+    "trace time (validate() contract)",
+    "adv_interval": "advert-boundary sub-phasing derives per-tick fire "
+    "times whose trace the boundary count depends on",
+    "broker_mips": "folded into broker pool state at init",
+    "learn_explore": "already dynamic: rides LearnState.explore in the "
+    "carry (sweep_explore's axis)",
+    "policy_seed": "folded into the per-task threefry stream key",
+    "chaos_seed": "folded into the chaos PRNG key at state init",
+    "energy_capacity_j": "folded into nodes.energy/energy_capacity at "
+    "state init",
+    "task_bytes": "static int folded into DropTail byte constants with "
+    "link_queue_frames",
+    "link_queue_frames": "static int — frameCapacity folds into the "
+    "DropTail cap constant",
+    "link_burst_n": "static int gate selecting the one- vs two-phase "
+    "drain trace",
+    "link_buffer_frames": "static int gate selecting the mechanistic- "
+    "buffer trace",
+    "telemetry_hist_min_ms": "bucket edges are trace-time constants of "
+    "the histogram compare ladder",
+    "telemetry_hist_max_ms": "bucket edges are trace-time constants of "
+    "the histogram compare ladder",
+}
+
+#: Gate classes: promoted fields whose VALUE also steers Python-level
+#: trace structure.  The canonical value must preserve the gate bit so
+#: the shape key selects the same trace as the real spec.
+_GATED_POSITIVE = (
+    "uplink_loss_prob",
+    "link_up_s",
+    "chaos_mtbf_s",
+    "chaos_mttr_s",
+    "chaos_rtt_amp",
+    "chaos_rtt_burst_prob",
+)
+
+#: Canonical representatives (exact f32 values, deliberately DISTINCT
+#: from common defaults): if an engine phase mistakenly reads the shape
+#: key's value instead of the operand, the bit-exact A/B fails loudly
+#: instead of passing by coincidence.
+_CANONICAL: Dict[str, float] = {
+    "uplink_loss_prob": 0.4375,
+    "send_stop_time": 7.0,  # only when finite (gate: != inf)
+    "link_up_s": 0.5,
+    "link_drain_s": 0.03125,
+    "link_drain2_s": 0.0625,
+    "link_rate_bps": 64e6,
+    "chaos_mtbf_s": 3.0,
+    "chaos_mttr_s": 1.5,
+    "chaos_rtt_amp": 0.75,
+    "chaos_rtt_period_s": 2.0,
+    "chaos_rtt_burst_prob": 0.4375,
+    "chaos_rtt_burst_mult": 2.5,
+    "chaos_max_retries": 3,
+    "learn_discount": 0.875,
+    "learn_reward_scale": 0.625,
+    "idle_power_w": 0.25,
+    "tx_energy_j": 0.25,
+    "rx_energy_j": 0.25,
+    "compute_power_w": 0.25,
+    "harvest_power_w": 0.25,
+    "harvest_period_s": 1.0,
+    "harvest_duty": 0.5,
+    "shutdown_frac": 0.125,
+    "start_frac": 0.625,
+}
+
+
+@struct.dataclass
+class DynSpec:
+    """Device-operand view of the promoted numeric knobs.
+
+    Every leaf is the EXACT f32 (or i32) scalar the static path would
+    have folded into the trace as a constant — derived quantities
+    (``chaos_rtt_omega`` = 2*pi/period, the energy per-tick products)
+    are precomputed on HOST with the same f64->f32 rounding order, so
+    operand and constant execute identical arithmetic.
+    """
+
+    # wireless / link
+    uplink_loss_prob: jax.Array
+    send_stop_time: jax.Array
+    link_up_s: jax.Array
+    link_drain_s: jax.Array
+    link_drain2_s: jax.Array
+    link_burst_base: jax.Array  # (link_burst_n-1) * f32(link_drain_s)
+    link_inv_rate: jax.Array  # 8.0 / link_rate_bps  (s per byte)
+    link_drain_bytes: jax.Array  # link_rate_bps / 8.0 * dt
+    # chaos
+    chaos_mtbf_s: jax.Array
+    chaos_mttr_s: jax.Array  # host-clamped max(mttr, 0)
+    chaos_rtt_amp: jax.Array
+    chaos_rtt_omega: jax.Array  # 2*pi / chaos_rtt_period_s
+    chaos_rtt_burst_prob: jax.Array
+    chaos_rtt_burst_mult: jax.Array
+    chaos_max_retries: jax.Array  # i32
+    # learn
+    learn_discount: jax.Array
+    learn_reward_scale: jax.Array
+    # energy (per-tick products precomputed against spec.dt)
+    energy_idle_dt: jax.Array  # idle_power_w * dt
+    energy_tx_j: jax.Array
+    energy_rx_j: jax.Array
+    energy_compute_dt: jax.Array  # compute_power_w * dt
+    energy_harvest_dt: jax.Array  # harvest_power_w * dt
+    harvest_period_s: jax.Array
+    harvest_duty: jax.Array
+    shutdown_frac: jax.Array
+    start_frac: jax.Array
+
+
+def dyn_of(spec: WorldSpec) -> DynSpec:
+    """The DynSpec operand for ``spec``.
+
+    Host np scalars, NOT device arrays: passed through jit they become
+    device operands; used at trace time (the ``dyn=None`` static path)
+    they are embedded as the same constants the pre-promotion engine
+    folded in — which is the whole bit-exactness argument.
+    """
+    f32 = np.float32
+    return DynSpec(
+        uplink_loss_prob=f32(spec.uplink_loss_prob),
+        send_stop_time=f32(spec.send_stop_time),
+        link_up_s=f32(spec.link_up_s),
+        link_drain_s=f32(spec.link_drain_s),
+        link_drain2_s=f32(spec.link_drain2_s),
+        # mirrors the engine's `nb * jnp.float32(drain_s)` host fold
+        # (python-float nb times an f32, computed in f64, rounded once)
+        link_burst_base=f32(
+            float(max(spec.link_burst_n - 1, 0)) * f32(spec.link_drain_s)
+        ),
+        link_inv_rate=f32(8.0 / spec.link_rate_bps),
+        link_drain_bytes=f32(spec.link_rate_bps / 8.0 * spec.dt),
+        chaos_mtbf_s=f32(spec.chaos_mtbf_s),
+        chaos_mttr_s=f32(max(spec.chaos_mttr_s, 0.0)),
+        chaos_rtt_amp=f32(spec.chaos_rtt_amp),
+        chaos_rtt_omega=f32(2.0 * np.pi / spec.chaos_rtt_period_s),
+        chaos_rtt_burst_prob=f32(spec.chaos_rtt_burst_prob),
+        chaos_rtt_burst_mult=f32(spec.chaos_rtt_burst_mult),
+        chaos_max_retries=np.int32(spec.chaos_max_retries),
+        learn_discount=f32(spec.learn_discount),
+        learn_reward_scale=f32(spec.learn_reward_scale),
+        energy_idle_dt=f32(spec.idle_power_w * spec.dt),
+        energy_tx_j=f32(spec.tx_energy_j),
+        energy_rx_j=f32(spec.rx_energy_j),
+        energy_compute_dt=f32(spec.compute_power_w * spec.dt),
+        energy_harvest_dt=f32(spec.harvest_power_w * spec.dt),
+        harvest_period_s=f32(spec.harvest_period_s),
+        harvest_duty=f32(spec.harvest_duty),
+        shutdown_frac=f32(spec.shutdown_frac),
+        start_frac=f32(spec.start_frac),
+    )
+
+
+def _canonical_value(spec: WorldSpec, field: str):
+    v = getattr(spec, field)
+    if field == "send_stop_time":
+        # gate: finite vs inf selects the stop-gated spawn trace
+        return v if v == float("inf") else _CANONICAL[field]
+    if field in _GATED_POSITIVE and not (v > 0):
+        return 0.0
+    return _CANONICAL[field]
+
+
+def shape_key(spec: WorldSpec) -> WorldSpec:
+    """The static-argument representative of ``spec``'s shape bucket.
+
+    Promoted knobs are replaced by gate-preserving canonical values:
+    every spec in the bucket maps to the SAME key, so jit caches (and
+    the program registry) key one compiled program per bucket.  All
+    shape, capacity, policy, gate and bug-compat fields pass through
+    untouched.
+    """
+    return dataclasses.replace(
+        spec, **{f: _canonical_value(spec, f) for f in DYN_FIELDS}
+    )
+
+
+def split_spec(spec: WorldSpec) -> Tuple[WorldSpec, DynSpec]:
+    """``(shape_key(spec), dyn_of(spec))`` — the promotion primitive."""
+    return shape_key(spec), dyn_of(spec)
+
+
+def same_program(a: WorldSpec, b: WorldSpec) -> bool:
+    """True when ``a`` and ``b`` share one compiled program (equal shape
+    keys: they differ only in promoted knob values)."""
+    return shape_key(a) == shape_key(b)
+
+
+def apply_knobs(spec: WorldSpec, knobs: Mapping[str, float]) -> WorldSpec:
+    """Re-configure promoted knobs on a live spec, compile-free.
+
+    Raises ``ValueError`` (one actionable line) when a key is unknown,
+    not a promoted knob, or when the new values change the shape key
+    (i.e. flip a trace gate, like turning chaos RTT bursts on for a
+    world compiled without them) — the caller must then take the
+    recompile path explicitly instead of silently paying it here.
+    """
+    for k in knobs:
+        if k not in DYN_FIELDS:
+            why = STATIC_REASONS.get(k)
+            if why is not None:
+                raise ValueError(
+                    f"spec.{k} is shape-defining ({why}): changing it "
+                    "needs a recompile — rebuild the world instead of "
+                    "re-configuring the live one"
+                )
+            raise ValueError(
+                f"unknown dynamic knob {k!r} (promoted knobs: "
+                + ", ".join(DYN_FIELDS) + ")"
+            )
+    spec2 = dataclasses.replace(spec, **dict(knobs)).validate()
+    if shape_key(spec2) != shape_key(spec):
+        changed = [
+            k for k in knobs
+            if _canonical_value(spec2, k) != _canonical_value(spec, k)
+        ]
+        raise ValueError(
+            "knob change flips a trace gate (zero vs positive / finite "
+            f"vs inf) on {', '.join(sorted(changed)) or 'a spec field'}: "
+            "this needs a recompile — rebuild the world to cross gate "
+            "classes"
+        )
+    return spec2
+
+
+def promote_default() -> bool:
+    """Whether the run/serve entry points promote by default.
+
+    ``FNS_SPEC_PROMOTE=0`` forces the legacy static-spec path (the A/B
+    reference); anything else (including unset) promotes.
+    """
+    env = os.environ.get("FNS_SPEC_PROMOTE", "1")
+    return env.strip().lower() not in ("0", "off", "false", "no", "")
+
+
+# ----------------------------------------------------------------------
+# CLI classification (--set spec.X=V -> recompile: yes|no)
+# ----------------------------------------------------------------------
+
+def classify_field(field: str) -> Tuple[bool, str]:
+    """``(recompiles, reason)`` for a WorldSpec field name.
+
+    Raises ``ValueError`` (one line) for unknown fields — the same
+    message the config tier produces, so the CLI surfaces it before
+    building a world.
+
+    Gated promoted knobs carry a caveat: the classifier cannot see the
+    scenario's CURRENT value, so a ``--set`` that crosses the knob's
+    trace gate (0 <-> positive, inf <-> finite) still compiles a fresh
+    program despite the "no".
+    """
+    if field in _GATED_POSITIVE or field == "send_stop_time":
+        gate = (
+            "inf vs finite" if field == "send_stop_time"
+            else "zero vs positive"
+        )
+        return False, (
+            "dynamic operand — compiled programs are reused within its "
+            f"gate class; crossing {gate} still recompiles"
+        )
+    if field in DYN_FIELDS:
+        return False, "dynamic operand — compiled programs are reused"
+    names = {f.name for f in dataclasses.fields(WorldSpec)}
+    if field not in names:
+        raise ValueError(f"unknown WorldSpec field {field!r}")
+    why = STATIC_REASONS.get(field)
+    if why is not None:
+        return True, why
+    return True, "shape/gate/policy-defining — selects a different trace"
+
+
+# ----------------------------------------------------------------------
+# shape-bucketed population padding (generalizes PR 8's TP padding)
+# ----------------------------------------------------------------------
+
+#: Populations at or below this are left alone: tiny worlds are parity/
+#: test scale, where ghost rows would distort committed anchors.
+BUCKET_FLOOR = 1024
+
+#: Per-octave bucket boundaries: powers of two plus the 1.5x midpoint —
+#: the classic "power-of-two-ish" ladder (1024, 1536, 2048, 3072, ...).
+#: Worst-case ghost overhead is 33%, average ~15%.
+_BUCKET_STEPS = (1.0, 1.5)
+
+
+def bucket_users(n: int, floor: int = BUCKET_FLOOR) -> int:
+    """Smallest bucket >= ``n`` on the power-of-two-ish ladder.
+
+    ``n <= floor`` returns ``n`` unchanged (no bucketing below the
+    floor); above it, the ladder is {2^k, 1.5 * 2^k}.
+    """
+    if n <= floor:
+        return n
+    p = 1 << (int(n - 1).bit_length() - 1)  # largest power of two <= n-1
+    while True:
+        for s in _BUCKET_STEPS:
+            b = int(p * s)
+            if b >= n:
+                return b
+        p *= 2
+
+
+def bucket_spec(spec: WorldSpec, state, net, floor: int = BUCKET_FLOOR):
+    """Pad ``n_users`` (and with it ``task_capacity``) up to its bucket.
+
+    Ghost users are the inert rows of PR 8's
+    :func:`~fognetsimpp_tpu.parallel.taskshard.pad_users_to_multiple`
+    (never started, unconnected, all task rows UNUSED) — the real
+    users' dynamics are exactly those of the same spec at the padded
+    population, so two nearby population sizes share one compiled
+    program per shape bucket.  Returns ``(spec, state, net)`` unchanged
+    when the population is already on a bucket boundary (or below the
+    floor).
+
+    Note the per-user PRNG stream caveat pad_users_to_multiple
+    documents: padding changes the (n_users,)-shaped draws vs the
+    unpadded world, so bucketing trades bit-identity ACROSS population
+    sizes for program reuse — worlds pinned to committed traces should
+    run un-bucketed.
+    """
+    from .parallel.taskshard import pad_users_to_multiple
+
+    b = bucket_users(spec.n_users, floor=floor)
+    if b == spec.n_users:
+        return spec, state, net
+    return pad_users_to_multiple(spec, state, net, b)
+
+
+# ----------------------------------------------------------------------
+# bounded process-level program registry
+# ----------------------------------------------------------------------
+
+_REG_LOCK = threading.Lock()
+_REGISTRY: "OrderedDict[Tuple, Dict]" = OrderedDict()
+_REGISTRY_CAP = 128
+_REG_COUNTS = {"programs": 0, "reuses": 0, "evictions": 0}
+
+
+def registry_note(
+    key_spec: WorldSpec, backend: str, donated: bool
+) -> bool:
+    """Record one promoted-entry-point invocation.
+
+    Keyed on (shape key, backend, donation layout) — the axes on which
+    XLA would compile distinct executables.  Returns True when the key
+    is NEW to the registry (a compile is expected), False on reuse.
+    The registry is bounded (LRU beyond :data:`_REGISTRY_CAP`) so a
+    pathological spec-churn loop cannot grow host memory; eviction only
+    loses accounting, never executables (jit owns those).
+    """
+    k = (key_spec, backend, bool(donated))
+    with _REG_LOCK:
+        ent = _REGISTRY.pop(k, None)
+        if ent is None:
+            ent = {"calls": 0}
+            _REG_COUNTS["programs"] += 1
+        else:
+            _REG_COUNTS["reuses"] += 1
+        ent["calls"] += 1
+        _REGISTRY[k] = ent  # most-recently-used at the end
+        while len(_REGISTRY) > _REGISTRY_CAP:
+            _REGISTRY.popitem(last=False)
+            _REG_COUNTS["evictions"] += 1
+        return ent["calls"] == 1
+
+
+def registry_stats() -> Dict:
+    """Snapshot for the compile-latency observability plane: bucket
+    count, total reuse hits, per-axis breakdown sizes."""
+    with _REG_LOCK:
+        return {
+            "buckets": len(_REGISTRY),
+            "programs": _REG_COUNTS["programs"],
+            "reuses": _REG_COUNTS["reuses"],
+            "evictions": _REG_COUNTS["evictions"],
+        }
+
+
+def registry_reset() -> None:
+    """Test hook: forget all buckets and counters."""
+    with _REG_LOCK:
+        _REGISTRY.clear()
+        for k in _REG_COUNTS:
+            _REG_COUNTS[k] = 0
+
+
+def _register_provider() -> None:
+    from . import compile_cache
+
+    compile_cache.register_stats_provider(
+        "program_registry", registry_stats
+    )
+
+
+_register_provider()

@@ -21,9 +21,16 @@ import jax.numpy as jnp
 from .bandits import LearnState
 
 
-def reward_from_latency(lat: jax.Array, scale: float) -> jax.Array:
-    """Bounded reward in (0, 1] from an observed ack latency (seconds)."""
-    return jnp.exp(-jnp.maximum(lat, 0.0) / jnp.float32(scale))
+def reward_from_latency(lat: jax.Array, scale) -> jax.Array:
+    """Bounded reward in (0, 1] from an observed ack latency (seconds).
+
+    ``scale`` may be a host float OR a traced f32 scalar (the promoted
+    ``DynSpec.learn_reward_scale`` operand, ISSUE 13) — ``asarray``
+    handles both with the same f32 value.
+    """
+    return jnp.exp(
+        -jnp.maximum(lat, 0.0) / jnp.asarray(scale, jnp.float32)
+    )
 
 
 def _credit_counts_exact(k_rows: int) -> None:
@@ -79,8 +86,8 @@ def credit_batch(
     lat: jax.Array,  # (K,) f32 observed latency (t_ack6 - t_create)
     pick_p_g: jax.Array,  # (K,) f32 decision-time pick probability
     n_fogs: int,
-    discount: float,
-    reward_scale: float,
+    discount,  # host float or traced f32 (DynSpec.learn_discount)
+    reward_scale,  # host float or traced f32 (DynSpec.learn_reward_scale)
 ) -> LearnState:
     """Fold one tick's credit window into the arm statistics.
 
@@ -106,7 +113,7 @@ def credit_batch(
     # adversarial reward sequences cannot walk the weights to +/-inf
     logw = logw - jnp.mean(logw)
 
-    g = f32(discount)
+    g = jnp.asarray(discount, f32)
     return learn.replace(
         reward_cnt=learn.reward_cnt + cnt_f,
         reward_sum=learn.reward_sum + sum_f,

@@ -340,6 +340,10 @@ def delay_table(spec, state0, net, bounds=None, n_ticks=None) -> np.ndarray:
         if spec.send_stop_time != float("inf"):
             # fires at/past stopTime never happen (mqttApp2.cc:191-210)
             room = jnp.ceil(
+                # simlint: disable=R13 -- the native-DES delay-table
+                # chain compiles once per parity world and deliberately
+                # mirrors the spawn phase against the ORIGINAL spec; it
+                # is never a reused serving program
                 (spec.send_stop_time - base) / users.send_interval
             ).astype(jnp.int32)
             n_fire = jnp.minimum(n_fire, jnp.maximum(room, 0))

@@ -35,24 +35,32 @@ def step_energy(
     tx_count: jax.Array,  # (N,) i32 messages sent by node this tick
     rx_count: jax.Array,  # (N,) i32 messages received this tick
     computing: jax.Array,  # (N,) bool — fog node actively serving
+    dyn=None,  # Optional[DynSpec] (ISSUE 13): promoted power/threshold
+    #   operands; None folds the spec's values as the same f32 constants
 ) -> Tuple[jax.Array, jax.Array]:
     """One energy tick. Returns (energy', alive').
 
     Nodes outside the model (``has_energy`` False) are always alive-eligible;
-    the alive mask for them is left untouched.
+    the alive mask for them is left untouched.  Every power/threshold
+    scalar reads through the DynSpec view (the per-tick products
+    ``idle_power_w*dt`` etc. are host-precomputed leaves), so a what-if
+    re-configuration of the energy budget reuses the compiled program.
     """
-    dt = spec.dt
+    if dyn is None:
+        from ..dynspec import dyn_of
+
+        dyn = dyn_of(spec)
     drain = (
-        spec.idle_power_w * dt
-        + spec.tx_energy_j * tx_count.astype(jnp.float32)
-        + spec.rx_energy_j * rx_count.astype(jnp.float32)
-        + jnp.where(computing, spec.compute_power_w * dt, 0.0)
+        dyn.energy_idle_dt
+        + dyn.energy_tx_j * tx_count.astype(jnp.float32)
+        + dyn.energy_rx_j * rx_count.astype(jnp.float32)
+        + jnp.where(computing, dyn.energy_compute_dt, 0.0)
     )
     # AlternatingEpEnergyGenerator: square wave, harvest for `duty` fraction
     # of each period (wireless5.ini:163-166).
-    phase = jnp.mod(t, spec.harvest_period_s) / spec.harvest_period_s
-    harvesting = phase < spec.harvest_duty
-    gain = jnp.where(harvesting, spec.harvest_power_w * dt, 0.0)
+    phase = jnp.mod(t, dyn.harvest_period_s) / dyn.harvest_period_s
+    harvesting = phase < dyn.harvest_duty
+    gain = jnp.where(harvesting, dyn.energy_harvest_dt, 0.0)
 
     e = jnp.where(
         has_energy,
@@ -61,7 +69,7 @@ def step_energy(
     )
     frac = e / jnp.maximum(capacity, 1e-12)
     # SimpleEpEnergyManagement hysteresis (wireless5.ini:159-161)
-    shut = has_energy & alive & (frac <= spec.shutdown_frac)
-    boot = has_energy & ~alive & (frac >= spec.start_frac)
+    shut = has_energy & alive & (frac <= dyn.shutdown_frac)
+    boot = has_energy & ~alive & (frac >= dyn.start_frac)
     alive2 = jnp.where(shut, False, jnp.where(boot, True, alive))
     return e.astype(jnp.float32), alive2

@@ -44,7 +44,7 @@ from .fleet import (  # noqa: F401
     run_fleet_series,
 )
 from .multihost import global_mesh, initialize  # noqa: F401
-from .sweep import sweep_explore, sweep_policies  # noqa: F401
+from .sweep import sweep_dyn, sweep_explore, sweep_policies  # noqa: F401
 from .taskshard import (  # noqa: F401
     pad_users_to_multiple,
     ring_all_gather,

@@ -76,6 +76,7 @@ def run_replicated(
     net: NetParams,
     bounds: MobilityBounds,
     n_ticks: Optional[int] = None,
+    dyn_rows=None,
 ) -> WorldState:
     """Advance every replica over the horizon: ``jit(vmap(scan(step)))``.
 
@@ -85,8 +86,15 @@ def run_replicated(
     call; as arguments the jitted program is cached on ``(spec,
     n_ticks)`` across calls).  Returns the batched final state; pull
     per-replica scalars with :func:`replica_counters`.
+
+    ``dyn_rows`` (ISSUE 13): a :class:`~fognetsimpp_tpu.dynspec.DynSpec`
+    whose every leaf carries a leading replica axis — each replica then
+    runs its OWN promoted knob values (chaos amplitudes, reward weights,
+    loss probabilities...) under the one compiled program; ``spec``
+    should be the grid's shared shape key.  ``None`` keeps the classic
+    all-replicas-one-spec fan-out.
     """
-    return _run_replicated(spec, n_ticks, batch, net, bounds)
+    return _run_replicated(spec, n_ticks, batch, net, bounds, dyn_rows)
 
 
 # simlint: disable=R6 -- callers A/B the same batch across run_replicated
@@ -95,13 +103,16 @@ def run_replicated(
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _run_replicated(
     spec: WorldSpec, n_ticks: Optional[int], batch: WorldState,
-    net: NetParams, bounds: MobilityBounds,
+    net: NetParams, bounds: MobilityBounds, dyn_rows=None,
 ) -> WorldState:
-    def run_one(s, net_, bounds_):
-        final, _ = run(spec, s, net_, bounds_, n_ticks=n_ticks)
+    def run_one(s, net_, bounds_, dyn_):
+        final, _ = run(spec, s, net_, bounds_, n_ticks=n_ticks, dyn=dyn_)
         return final
 
-    return jax.vmap(run_one, in_axes=(0, None, None))(batch, net, bounds)
+    return jax.vmap(
+        run_one,
+        in_axes=(0, None, None, 0 if dyn_rows is not None else None),
+    )(batch, net, bounds, dyn_rows)
 
 
 def replica_counters(final_batch: WorldState) -> Dict[str, np.ndarray]:

@@ -18,7 +18,10 @@ Either way the grid shards over the mesh.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence
+import dataclasses
+import itertools
+
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -217,4 +220,106 @@ def sweep_explore(
             k: v[sl].reshape(n_loads, n_replicas_per_load)
             for k, v in counters.items()
         }
+    return out
+
+
+def sweep_dyn(
+    build: Callable[..., tuple],
+    knobs: Mapping[str, Sequence],
+    n_replicas_per_cell: int = 1,
+    seed: int = 0,
+    n_ticks: Optional[int] = None,
+    **build_kwargs,
+) -> List[Dict]:
+    """Dynamic-knob grid under ONE compile (ISSUE 13).
+
+    ``knobs`` maps promoted WorldSpec fields
+    (:data:`~fognetsimpp_tpu.dynspec.DYN_FIELDS`) to value lists; the
+    cartesian grid runs as a replica fan-out whose per-replica
+    :class:`~fognetsimpp_tpu.dynspec.DynSpec` rows carry the cell's
+    values — where ``sweep_policies`` needed Policy.DYNAMIC's traced
+    switch and ``sweep_explore`` a carry-resident rate, ANY promoted
+    numeric knob now grids for free (a chaos-amplitude × loss-prob grid
+    is one compiled program, asserted via ``_run_replicated._cache_
+    size()`` in tests).
+
+    Every cell must land in the SAME shape bucket: a grid that crosses
+    a trace gate (e.g. ``uplink_loss_prob`` values mixing 0 and 0.2)
+    raises the one-line shape-key error up front rather than silently
+    splitting into per-gate compiles.  The world is built from the
+    FIRST cell's values so state init (e.g. the chaos schedule's first
+    crash draw) matches that cell's gate class; chaos-knob cells
+    re-derive their init-time chaos schedule per row.
+
+    Returns a list of ``{knob values..., counters: {...}}`` dicts in
+    grid order (cells × replicas averaged by the caller as needed).
+    """
+    from ..dynspec import DYN_FIELDS, dyn_of, shape_key
+
+    bad = sorted(set(knobs) - set(DYN_FIELDS))
+    if bad:
+        raise ValueError(
+            f"sweep_dyn grids promoted knobs only; {', '.join(bad)} "
+            "is shape-defining (see dynspec.DYN_FIELDS / the README "
+            "'one program, many worlds' table)"
+        )
+    names = sorted(knobs)
+    grid = [
+        dict(zip(names, vals))
+        for vals in itertools.product(*(knobs[k] for k in names))
+    ]
+    if not grid:
+        return []
+    # build at the first cell's values: gate classes (zero vs positive)
+    # and init-time state derivations then match the whole grid
+    spec0, state, net, bounds = build(**{**build_kwargs, **grid[0]})
+    cells = [
+        dataclasses.replace(spec0, **cell).validate() for cell in grid
+    ]
+    key0 = shape_key(cells[0])
+    for cell, sp in zip(grid, cells):
+        if shape_key(sp) != key0:
+            raise ValueError(
+                f"grid cell {cell} leaves the shape bucket (a knob "
+                "crossed a trace gate, e.g. 0 vs positive): split the "
+                "sweep per gate class"
+            )
+    nrc = n_replicas_per_cell
+    R = len(cells) * nrc
+    base = replicate_state(spec0, state, nrc, seed=seed)
+    batch = jax.tree.map(
+        lambda x: jnp.concatenate([x] * len(cells), axis=0), base
+    )
+    if spec0.chaos:
+        # the t=0 chaos schedule (first crash gap) is an init-time
+        # derivation of the cell's MTBF: re-derive per cell so each
+        # row starts exactly where a direct run of its spec would
+        from ..chaos.faults import init_chaos_state
+
+        # keyed on the BUILDER's world key (state.key at t=0): each
+        # row's schedule is exactly what a direct run of its spec on
+        # this world would draw
+        ch_rows = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *(init_chaos_state(sp, state.key) for sp in cells),
+        )
+        ch_rows = jax.tree.map(
+            lambda x: jnp.repeat(x, nrc, axis=0), ch_rows
+        )
+        batch = batch.replace(chaos=ch_rows)
+    dyn_rows = jax.tree.map(
+        lambda *xs: jnp.repeat(jnp.stack(xs), nrc, axis=0),
+        *(dyn_of(sp) for sp in cells),
+    )
+    final = run_replicated(
+        key0, batch, net, bounds, n_ticks=n_ticks, dyn_rows=dyn_rows
+    )
+    counters = replica_counters(final)
+    out: List[Dict] = []
+    for i, cell in enumerate(grid):
+        sl = slice(i * nrc, (i + 1) * nrc)
+        out.append({
+            **cell,
+            "counters": {k: v[sl] for k, v in counters.items()},
+        })
     return out

@@ -455,6 +455,7 @@ def serve_run(
     hash_every_chunk: bool = True,
     run_fn: Optional[Callable] = None,
     shard_hash_fn: Optional[Callable] = None,
+    reconfigure: Optional[Callable[[int], Optional[Dict]]] = None,
 ):
     """The production serving loop over ``run_chunked``.
 
@@ -482,7 +483,21 @@ def serve_run(
     latency-sensitive serving; the flight recorder ring then carries
     rows only and NaN dumps are disabled (the histogram/SLO/watchdog
     triggers still fire).
+
+    ``reconfigure`` (ISSUE 13, the live what-if door): forwarded to
+    ``run_chunked`` — called at every chunk boundary with the tick
+    count, may return a dict of PROMOTED WorldSpec knobs (chaos
+    amplitudes, loss probabilities, energy budgets...) to apply to the
+    remaining horizon with zero recompiles, so an operator can steer a
+    live twin between scrapes without ever paying the compile wall.
+    Only the default ``run_chunked`` runner supports it (the TP chunk
+    runner gates promotion off).
     """
+    if reconfigure is not None and run_fn is not None:
+        raise ValueError(
+            "reconfigure rides run_chunked's DynSpec operand; custom "
+            "run_fn runners (the TP chunk loop) do not take it"
+        )
     import jax
 
     from ..core.engine import run_chunked
@@ -621,6 +636,7 @@ def serve_run(
         final = (run_fn or run_chunked)(
             spec, state, net, bounds,
             chunk_ticks=chunk_ticks, callback=_chunk_cb,
+            **({} if reconfigure is None else {"reconfigure": reconfigure}),
         )
     except Exception as e:
         # crash flight-record: the ring up to the last good chunk plus

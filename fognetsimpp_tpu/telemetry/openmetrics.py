@@ -195,6 +195,16 @@ def _render_compile_stats(lines: List[str]) -> None:
     ):
         _family(lines, family, kind)
         _sample(lines, family, cs.get(key, 0))
+    # shape-bucket program registry (ISSUE 13): how many compiled
+    # programs this process holds vs how often re-configuration re-used
+    # one — the scrape-visible proof the compile wall stays down
+    reg = cs.get("program_registry") or {}
+    for family, key, kind in (
+        ("compile_program_buckets", "buckets", "gauge"),
+        ("compile_program_reuses", "reuses", "counter"),
+    ):
+        _family(lines, family, kind)
+        _sample(lines, family, reg.get(key, 0))
 
 
 def render_openmetrics(

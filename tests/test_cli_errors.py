@@ -233,3 +233,37 @@ def test_chaos_with_replicas_is_clear_error(capsys):
     assert "error:" in captured.err
     assert "chaos" in captured.err
     assert "Traceback" not in captured.err
+
+
+def test_set_prints_recompile_classification(capsys):
+    """--set on a spec field prints a one-line recompile: yes|no
+    classification (ISSUE 13): dynamic-operand knobs re-use compiled
+    programs, shape-defining fields pay a fresh compile."""
+    rc = main([
+        "--scenario", "smoke",
+        "--set", "scenario.n_users=4",
+        "--set", "scenario.horizon=0.002",
+        "--set", "spec.chaos_rtt_amp=0.0",
+        "--set", "spec.horizon=0.002",
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    lines = [
+        ln for ln in captured.err.splitlines()
+        if ln.startswith("recompile:")
+    ]
+    assert len(lines) == 2  # spec.* keys only; scenario.* stays silent
+    assert lines[0].startswith("recompile: no (spec.chaos_rtt_amp:")
+    assert "dynamic operand" in lines[0]
+    assert lines[1].startswith("recompile: yes (spec.horizon:")
+    assert "shape-defining" in lines[1]
+
+
+def test_set_unknown_spec_field_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--set", "spec.bogus_knob=1"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error: unknown WorldSpec field 'bogus_knob'" in captured.err
+    assert "Traceback" not in captured.err
+    # classification fails BEFORE any world is built: no recompile line
+    assert "recompile:" not in captured.err

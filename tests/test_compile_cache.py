@@ -1,0 +1,102 @@
+"""compile_cache unit coverage (ISSUE 13 satellite): the snapshot/delta
+interval accounting, the stats-provider hook, and the previously
+untested ``FNS_JIT_CACHE=off`` / ``_host_tag`` paths."""
+import re
+
+import pytest
+
+from fognetsimpp_tpu import compile_cache
+
+
+def test_note_compile_and_stats_keys():
+    before = compile_cache.compile_stats()
+    compile_cache.note_compile(1.5)
+    compile_cache.note_compile(0.25, cache_hit=True)
+    compile_cache.note_compile(0.25, cache_hit=False)
+    after = compile_cache.compile_stats()
+    assert after["noted_compiles"] == before.get("noted_compiles", 0) + 3
+    assert after["cache_hits"] >= before.get("cache_hits", 0) + 1
+    assert after["cache_misses"] >= before.get("cache_misses", 0) + 1
+    assert (
+        after["noted_compile_s_total"]
+        >= before.get("noted_compile_s_total", 0.0) + 2.0 - 1e-9
+    )
+
+
+def test_snapshot_delta_scopes_an_interval():
+    """Bench rounds / serve chunks attribute compile seconds to
+    THEMSELVES via snapshot + delta — the cumulative-stats gap the
+    satellite closes."""
+    snap = compile_cache.snapshot()
+    assert all(isinstance(v, float) for v in snap.values())
+    compile_cache.note_compile(2.0)
+    d = compile_cache.delta_since(snap)
+    assert d["noted_compiles"] == 1.0
+    assert d["noted_compile_s_total"] == pytest.approx(2.0)
+    # untouched counters delta to zero
+    assert d["compiles"] == 0.0
+    # a second snapshot scopes a fresh (empty) interval
+    d2 = compile_cache.delta_since(compile_cache.snapshot())
+    assert d2["noted_compiles"] == 0.0
+
+
+def test_delta_handles_counters_born_after_snapshot():
+    """noted_* keys appear lazily on first note_compile; a snapshot
+    taken before that must still delta cleanly (from zero)."""
+    snap = dict(compile_cache.snapshot())
+    snap.pop("noted_compiles", None)
+    snap.pop("noted_compile_s_total", None)
+    compile_cache.note_compile(0.5)
+    d = compile_cache.delta_since(snap)
+    assert d["noted_compiles"] >= 1.0
+
+
+def test_compile_s_max_delta_is_new_max_or_zero():
+    snap = compile_cache.snapshot()
+    d = compile_cache.delta_since(snap)
+    assert d["compile_s_max"] == 0.0  # running max did not grow
+
+
+def test_stats_provider_sections_merge_and_never_raise():
+    compile_cache.register_stats_provider("t_ok", lambda: {"x": 1})
+    compile_cache.register_stats_provider(
+        "t_boom", lambda: 1 / 0
+    )
+    out = compile_cache.compile_stats()
+    assert out["t_ok"] == {"x": 1}
+    assert out["t_boom"] is None  # provider failure degrades, not raises
+    # last registration wins (idempotent per name)
+    compile_cache.register_stats_provider("t_ok", lambda: {"x": 2})
+    assert compile_cache.compile_stats()["t_ok"] == {"x": 2}
+    # snapshots stay numeric-only: provider dicts never leak into deltas
+    assert "t_ok" not in compile_cache.snapshot()
+
+
+def test_fns_jit_cache_off_disables(monkeypatch):
+    """FNS_JIT_CACHE=off (and friends) return None without touching
+    jax config; stats accounting still flows (note_compile works)."""
+    for off in ("off", "0", "false", ""):
+        monkeypatch.setenv("FNS_JIT_CACHE", off)
+        assert compile_cache.enable_compile_cache() is None
+    snap = compile_cache.snapshot()
+    compile_cache.note_compile(0.1)
+    assert compile_cache.delta_since(snap)["noted_compiles"] == 1.0
+
+
+def test_enable_on_cpu_backend_skips(monkeypatch, tmp_path):
+    """XLA:CPU executables are skipped by design (the r4 segfault
+    note): enable returns None and never creates the directory."""
+    monkeypatch.delenv("FNS_JIT_CACHE", raising=False)
+    target = tmp_path / "jitcache"
+    import jax
+
+    if jax.default_backend() == "cpu":
+        assert compile_cache.enable_compile_cache(str(target)) is None
+        assert not target.exists()
+
+
+def test_host_tag_is_stable_and_wellformed():
+    t1 = compile_cache._host_tag()
+    t2 = compile_cache._host_tag()
+    assert t1 == t2
+    assert re.fullmatch(r"[0-9a-f]{12}", t1)

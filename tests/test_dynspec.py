@@ -1,0 +1,446 @@
+"""ISSUE 13 — dynamic-operand spec promotion + shape-bucketed reuse.
+
+The correctness rail of "one program, many worlds": promoted-operand
+runs must be BIT-EXACT vs the static-spec path over the three
+policy-family worlds (argmin/chaos, learned bandit, POOL-v2/energy)
+across every entry point; warm re-configuration of a promoted knob must
+trigger ZERO compile events; and two same-bucket user counts must share
+one compiled program.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import compile_cache, dynspec
+from fognetsimpp_tpu.core.engine import (
+    _run_jit_dyn,
+    run,
+    run_chunked,
+    run_jit,
+)
+from fognetsimpp_tpu.scenarios import smoke
+from fognetsimpp_tpu.telemetry.health import state_hash
+
+
+def _hash(s) -> str:
+    return state_hash(jax.device_get(s))
+
+
+def _copy(s):
+    return jax.tree.map(jnp.copy, s)
+
+
+def _build(**kw):
+    kw.setdefault("n_users", 32)
+    kw.setdefault("n_fogs", 4)
+    kw.setdefault("horizon", 0.05)
+    kw.setdefault("send_interval", 5e-3)
+    return smoke.build(**kw)
+
+
+#: The three policy-family worlds of the acceptance gate, each reading
+#: a different slice of the promoted knobs inside the tick.
+FAMILIES = {
+    "argmin_chaos": dict(
+        chaos=True, chaos_mtbf_s=0.01, chaos_mttr_s=0.005,
+        chaos_mode=1, chaos_rtt_amp=0.5, chaos_rtt_period_s=0.7,
+        chaos_rtt_burst_prob=0.1, chaos_rtt_burst_mult=3.0,
+        chaos_max_retries=2, uplink_loss_prob=0.05,
+    ),
+    "learned_ducb": dict(
+        policy=9, learn_discount=0.99, learn_reward_scale=0.3,
+    ),
+    "pool_v2_energy": dict(
+        policy=5, app_gen=2, fog_model=1, broker_mips=3000.0,
+        v2_local_broker=True, required_time=0.01, energy_enabled=True,
+        idle_power_w=3e-3, harvest_duty=0.4,
+    ),
+}
+
+
+# ----------------------------------------------------------------------
+# catalogue consistency
+# ----------------------------------------------------------------------
+
+def test_dyn_fields_synced_with_simlint_r13():
+    """simlint R13's literal field copy cannot drift from the real
+    promotion catalogue."""
+    from tools.simlint.rules import DYN_PROMOTED_FIELDS
+
+    assert set(dynspec.DYN_FIELDS) == set(DYN_PROMOTED_FIELDS)
+
+
+def test_dyn_fields_are_spec_fields_and_disjoint_from_static():
+    names = {f.name for f in dataclasses.fields(dynspec.WorldSpec)}
+    assert set(dynspec.DYN_FIELDS) <= names
+    overlap = set(dynspec.DYN_FIELDS) & set(dynspec.STATIC_REASONS)
+    assert not overlap, f"fields both promoted and static: {overlap}"
+    assert set(dynspec.STATIC_REASONS) <= names
+
+
+def test_classify_field():
+    rec, why = dynspec.classify_field("chaos_rtt_amp")
+    assert rec is False and "operand" in why
+    rec, why = dynspec.classify_field("horizon")
+    assert rec is True and "scan length" in why
+    rec, _ = dynspec.classify_field("n_users")
+    assert rec is True
+    with pytest.raises(ValueError, match="unknown WorldSpec field"):
+        dynspec.classify_field("bogus_knob")
+
+
+# ----------------------------------------------------------------------
+# shape keys and buckets
+# ----------------------------------------------------------------------
+
+def test_shape_key_merges_knob_values_preserves_gates():
+    spec, *_ = _build(**FAMILIES["argmin_chaos"])
+    tweaked = dataclasses.replace(
+        spec, chaos_rtt_amp=1.75, uplink_loss_prob=0.3,
+        learn_reward_scale=0.9,
+    ).validate()
+    assert dynspec.same_program(spec, tweaked)
+    # crossing a gate (positive -> zero) leaves the bucket
+    gate_flip = dataclasses.replace(spec, chaos_rtt_amp=0.0).validate()
+    assert not dynspec.same_program(spec, gate_flip)
+    # shape fields leave the bucket
+    bigger = dataclasses.replace(spec, n_users=64).validate()
+    assert not dynspec.same_program(spec, bigger)
+
+
+def test_shape_key_passes_validate():
+    for kw in FAMILIES.values():
+        spec, *_ = _build(**kw)
+        dynspec.shape_key(spec).validate()
+
+
+def test_dyn_of_matches_static_fold():
+    """Each DynSpec leaf equals the f32 the static path folds in."""
+    spec, *_ = _build(
+        chaos=True, chaos_rtt_period_s=0.7, chaos_mttr_s=-1.0,
+        chaos_mtbf_s=0.0, link_rate_bps=10e6,
+    )
+    d = dynspec.dyn_of(spec)
+    assert d.chaos_rtt_omega == np.float32(2.0 * np.pi / 0.7)
+    assert d.chaos_mttr_s == np.float32(0.0)  # host clamp
+    assert d.link_inv_rate == np.float32(8.0 / 10e6)
+    assert d.chaos_max_retries.dtype == np.int32
+
+
+def test_bucket_users_ladder():
+    assert dynspec.bucket_users(500) == 500  # below the floor: untouched
+    assert dynspec.bucket_users(1024) == 1024
+    assert dynspec.bucket_users(1025) == 1536
+    assert dynspec.bucket_users(1537) == 2048
+    assert dynspec.bucket_users(5000) == 6144
+    # monotone and idempotent on bucket boundaries
+    for n in (1100, 2049, 7000):
+        b = dynspec.bucket_users(n)
+        assert b >= n and dynspec.bucket_users(b) == b
+
+
+def test_apply_knobs():
+    spec, *_ = _build(**FAMILIES["argmin_chaos"])
+    spec2 = dynspec.apply_knobs(spec, {"chaos_rtt_amp": 1.25})
+    assert spec2.chaos_rtt_amp == 1.25
+    assert dynspec.same_program(spec, spec2)
+    with pytest.raises(ValueError, match="shape-defining"):
+        dynspec.apply_knobs(spec, {"horizon": 1.0})
+    with pytest.raises(ValueError, match="unknown dynamic knob"):
+        dynspec.apply_knobs(spec, {"bogus": 1.0})
+    with pytest.raises(ValueError, match="trace gate"):
+        dynspec.apply_knobs(spec, {"uplink_loss_prob": 0.0})
+
+
+# ----------------------------------------------------------------------
+# the bit-exactness rail
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_promoted_bitexact_vs_static(family):
+    """State-hash A/B: the promoted (shape key + DynSpec operand) run
+    equals the static-spec run bit-for-bit — any constant-folding
+    difference is a finding."""
+    spec, state, net, bounds = _build(**FAMILIES[family])
+    f_static, _ = run(spec, state, net, bounds)
+    key_spec, dyn = dynspec.split_spec(spec)
+    f_dyn, _ = run(key_spec, state, net, bounds, dyn=dyn)
+    assert _hash(f_static) == _hash(f_dyn)
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_entry_points_bitexact(family):
+    """run_jit (promoted vs static) and run_chunked (promoted) all land
+    on the same final state."""
+    spec, state, net, bounds = _build(**FAMILIES[family])
+    ref, _ = run(spec, state, net, bounds)
+    h = _hash(ref)
+    assert _hash(
+        run_jit(spec, _copy(state), net, bounds, promote=False)
+    ) == h
+    assert _hash(
+        run_jit(spec, _copy(state), net, bounds, promote=True)
+    ) == h
+    assert _hash(run_chunked(
+        spec, _copy(state), net, bounds, chunk_ticks=13, promote=True
+    )) == h
+
+
+# ----------------------------------------------------------------------
+# the compile-reuse rail
+# ----------------------------------------------------------------------
+
+def test_warm_reconfig_zero_compile_events():
+    """Re-configuring promoted knobs re-uses the compiled program:
+    zero jit-cache growth, zero compile events (compile_stats delta),
+    and a warm wall far below the cold one."""
+    import time
+
+    # a shape no other test compiles, so the cold wall is genuinely cold
+    spec, state, net, bounds = _build(
+        n_users=40, horizon=0.06, **FAMILIES["argmin_chaos"]
+    )
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        run_jit(spec, _copy(state), net, bounds, promote=True)
+    )
+    cold = time.perf_counter() - t0
+    base = _run_jit_dyn._cache_size()
+    snap = compile_cache.snapshot()
+    spec2 = dataclasses.replace(
+        spec, chaos_rtt_amp=1.75, chaos_mtbf_s=0.02,
+        uplink_loss_prob=0.11, chaos_rtt_burst_mult=5.5,
+    ).validate()
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        run_jit(spec2, _copy(state), net, bounds, promote=True)
+    )
+    warm = time.perf_counter() - t0
+    delta = compile_cache.delta_since(snap)
+    assert _run_jit_dyn._cache_size() == base, "jit cache grew"
+    assert delta["compiles"] == 0, f"compile events on warm tweak: {delta}"
+    # generous bar (the pinned-shape >=10x gate lives in bench_trend):
+    # a recompile would cost seconds, a reuse costs milliseconds
+    assert warm < cold / 5
+
+
+def test_same_bucket_user_counts_share_one_program():
+    """Two nearby populations pad to one bucket and hit one jit entry."""
+    results = {}
+    base = None
+    for n in (20, 24):
+        spec, state, net, bounds = _build(n_users=n)
+        spec_b, state_b, net_b = dynspec.bucket_spec(
+            spec, state, net, floor=16
+        )
+        assert spec_b.n_users == 24  # both land on the 16*1.5 bucket
+        if base is None:
+            jax.block_until_ready(
+                run_jit(spec_b, state_b, net_b, bounds, promote=True)
+            )
+            base = _run_jit_dyn._cache_size()
+        else:
+            final = run_jit(spec_b, state_b, net_b, bounds, promote=True)
+            jax.block_until_ready(final)
+            assert _run_jit_dyn._cache_size() == base, (
+                "same-bucket world recompiled"
+            )
+            results["n24"] = final
+    # bucket_spec is a no-op on a boundary population
+    spec, state, net, bounds = _build(n_users=24)
+    s2, st2, n2 = dynspec.bucket_spec(spec, state, net, floor=16)
+    assert s2 is spec and st2 is state and n2 is net
+
+
+def test_bucketed_ghosts_are_inert():
+    """The padded world's real users behave exactly like the same spec
+    at the padded population built directly (the pad_users contract
+    generalized to buckets)."""
+    spec, state, net, bounds = _build(n_users=20)
+    spec_b, state_b, net_b = dynspec.bucket_spec(
+        spec, state, net, floor=16
+    )
+    final, _ = run(spec_b, state_b, net_b, bounds)
+    pub = np.asarray(final.users.send_count)
+    assert pub[: spec.n_users].sum() > 0  # real users ran
+    assert pub[spec.n_users:].sum() == 0  # ghosts never published
+    assert not np.asarray(final.users.connected)[spec.n_users:].any()
+
+
+def test_program_registry_accounting():
+    dynspec.registry_reset()
+    spec, *_ = _build()
+    key = dynspec.shape_key(spec)
+    assert dynspec.registry_note(key, "cpu", True) is True
+    assert dynspec.registry_note(key, "cpu", True) is False
+    # a different donation layout or backend is a different program
+    assert dynspec.registry_note(key, "cpu", False) is True
+    st = dynspec.registry_stats()
+    assert st["buckets"] == 2 and st["reuses"] == 1
+    assert st["programs"] == 2
+    # bounded: the LRU cap evicts accounting, never grows unbounded
+    for i in range(dynspec._REGISTRY_CAP + 8):
+        sp = dataclasses.replace(spec, n_users=8 + i).validate()
+        dynspec.registry_note(dynspec.shape_key(sp), "cpu", True)
+    assert dynspec.registry_stats()["buckets"] <= dynspec._REGISTRY_CAP
+    assert dynspec.registry_stats()["evictions"] >= 8
+    # the registry feeds compile_stats() (the satellite accounting)
+    assert "program_registry" in compile_cache.compile_stats()
+    dynspec.registry_reset()
+
+
+# ----------------------------------------------------------------------
+# the what-if door: knob changes at chunk boundaries
+# ----------------------------------------------------------------------
+
+def test_run_chunked_reconfigure_matches_manual_composition():
+    """A knob change at a chunk boundary equals running the first half
+    with the old DynSpec and the second half with the new one."""
+    spec, state, net, bounds = _build(**FAMILIES["argmin_chaos"])
+    seen = []
+
+    def reconfig(ticks_done):
+        seen.append(ticks_done)
+        if ticks_done == 5:
+            return {"chaos_rtt_amp": 1.5, "uplink_loss_prob": 0.15}
+        return None
+
+    got = run_chunked(
+        spec, _copy(state), net, bounds, chunk_ticks=5,
+        promote=True, reconfigure=reconfig,
+    )
+    assert seen and seen[0] == 5
+    key_spec, dyn1 = dynspec.split_spec(spec)
+    spec2 = dynspec.apply_knobs(
+        spec, {"chaos_rtt_amp": 1.5, "uplink_loss_prob": 0.15}
+    )
+    dyn2 = dynspec.dyn_of(spec2)
+    mid, _ = run(key_spec, state, net, bounds, n_ticks=5, dyn=dyn1)
+    want, _ = run(
+        key_spec, mid, net, bounds, n_ticks=spec.n_ticks - 5, dyn=dyn2
+    )
+    assert _hash(got) == _hash(want)
+
+
+def test_run_chunked_reconfigure_rejects_gate_flip_and_static_path():
+    spec, state, net, bounds = _build(**FAMILIES["argmin_chaos"])
+    with pytest.raises(ValueError, match="promoted path"):
+        run_chunked(
+            spec, _copy(state), net, bounds, chunk_ticks=5,
+            promote=False, reconfigure=lambda t: None,
+        )
+    with pytest.raises(ValueError, match="shape-defining"):
+        run_chunked(
+            spec, _copy(state), net, bounds, chunk_ticks=5,
+            promote=True, reconfigure=lambda t: {"horizon": 9.0},
+        )
+
+
+# ----------------------------------------------------------------------
+# one-compile dynamic-knob grids (the sweep satellite)
+# ----------------------------------------------------------------------
+
+def test_sweep_dyn_one_compile_and_cell_equivalence():
+    """A chaos-amplitude grid is ONE compile (jit-cache-size assertion,
+    not wall clock), and each cell's counters equal a direct
+    run_replicated of that cell's spec."""
+    from fognetsimpp_tpu.parallel import sweep_dyn
+    from fognetsimpp_tpu.parallel.replicas import (
+        _run_replicated,
+        replica_counters,
+        replicate_state,
+        run_replicated,
+    )
+
+    build_kw = dict(
+        n_users=24, n_fogs=3, horizon=0.04, send_interval=4e-3,
+        chaos=True, chaos_mtbf_s=0.01, chaos_mttr_s=0.005,
+    )
+    grid = {"chaos_rtt_amp": [0.25, 1.0], "chaos_rtt_burst_prob": [0.05]}
+    base = _run_replicated._cache_size()
+    cells = sweep_dyn(
+        smoke.build, grid, n_replicas_per_cell=2, **build_kw
+    )
+    assert _run_replicated._cache_size() == base + 1, (
+        "the dynamic-knob grid must be one compile"
+    )
+    assert len(cells) == 2
+    # warm: a NEW grid over the same bucket is a pure jit-cache hit
+    # AND zero backend compile events (the compile_stats delta is the
+    # accounting the bench/serve loops gate on — not wall clock)
+    snap = compile_cache.snapshot()
+    sweep_dyn(
+        smoke.build,
+        {"chaos_rtt_amp": [0.4, 0.8], "chaos_rtt_burst_prob": [0.02]},
+        n_replicas_per_cell=2, **build_kw,
+    )
+    assert _run_replicated._cache_size() == base + 1, (
+        "second dynamic-knob grid must be a jit-cache hit"
+    )
+    assert compile_cache.delta_since(snap)["compiles"] == 0
+    # cell equivalence: grid row == direct run of that spec
+    spec_a, state_a, net_a, bounds_a = smoke.build(
+        **{**build_kw, "chaos_rtt_amp": 0.25,
+           "chaos_rtt_burst_prob": 0.05}
+    )
+    key_a, dyn_a = dynspec.split_spec(spec_a)
+    batch = replicate_state(spec_a, state_a, 2, seed=0)
+    rows = jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            jnp.asarray(x), (2,) + jnp.shape(jnp.asarray(x))
+        ),
+        dyn_a,
+    )
+    direct = replica_counters(run_replicated(
+        key_a, batch, net_a, bounds_a, dyn_rows=rows
+    ))
+    got = cells[0]["counters"]
+    for k, v in direct.items():
+        np.testing.assert_array_equal(np.asarray(got[k]), v, err_msg=k)
+
+
+def test_sweep_dyn_rejects_static_fields_and_gate_crossings():
+    from fognetsimpp_tpu.parallel import sweep_dyn
+
+    with pytest.raises(ValueError, match="shape-defining"):
+        sweep_dyn(smoke.build, {"horizon": [0.1, 0.2]}, n_users=8)
+    with pytest.raises(ValueError, match="shape bucket"):
+        sweep_dyn(
+            smoke.build, {"uplink_loss_prob": [0.0, 0.2]},
+            n_users=8, n_fogs=2, horizon=0.02,
+        )
+
+
+def test_serve_run_forwards_reconfigure():
+    """The --serve loop's what-if door: knob changes land between
+    chunks with zero compile events; custom run_fn runners reject the
+    kwarg with a one-line error."""
+    from fognetsimpp_tpu.telemetry.live import serve_run
+
+    spec, state, net, bounds = _build(
+        telemetry=True, **FAMILIES["argmin_chaos"]
+    )
+    calls = []
+
+    def reconfig(ticks_done):
+        calls.append(ticks_done)
+        return {"chaos_rtt_amp": 1.25} if ticks_done == 10 else None
+
+    # warm the chunk program once so the serve loop's own compile does
+    # not pollute the interval delta below
+    final, status = serve_run(
+        spec, _copy(state), net, bounds, chunk_ticks=10, port=None,
+        hash_every_chunk=False, reconfigure=reconfig,
+    )
+    assert calls and calls[0] == 10
+    assert status["chunks"] == spec.n_ticks // 10 + (
+        1 if spec.n_ticks % 10 else 0
+    )
+    with pytest.raises(ValueError, match="run_fn"):
+        serve_run(
+            spec, _copy(state), net, bounds, port=None,
+            run_fn=lambda *a, **k: None, reconfigure=reconfig,
+        )

@@ -66,6 +66,16 @@ def test_fused_reduction_meets_the_30_percent_bar(measured):
     assert ratio <= op_budget.MAX_FUSED_RATIO, measured
 
 
+def test_dyn_promotion_costs_no_kernels(measured):
+    """ISSUE 13: the promoted tick (tick_dyn — shape key static, knobs
+    as DynSpec operands) must stay within the constant-folded twin's
+    op budget: losing a constant-fold to an operand would show up here
+    as op growth vs tick_chaos."""
+    assert "tick_dyn" in measured and "tick_chaos" in measured
+    dyn, chaos = measured["tick_dyn"], measured["tick_chaos"]
+    assert dyn["ops"] <= chaos["max_ops"], (dyn, chaos)
+
+
 def test_budget_regenerable_via_write(tmp_path, measured, capsys):
     out = tmp_path / "budget.json"
     rc = op_budget.main(["--write", "--budget", str(out)])

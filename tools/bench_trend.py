@@ -37,6 +37,16 @@ TOLERANCE = 0.10
 #: under since PR 4.
 OVERHEAD_BAR = 1.10
 
+#: Warm-reconfig bar (ISSUE 13): a capture recording both ``compile_s``
+#: and ``reconfig_s`` (bench.py --reconfig) must show the warm knob
+#: tweak >= this many times faster than the cold compile, or the
+#: dynamic-operand promotion has rotted back into a recompile.
+RECONFIG_SPEEDUP_BAR = 10.0
+
+#: And the warm reconfig itself may regress at most TOLERANCE vs the
+#: best (lowest) prior ``reconfig_s`` at the same shape — the
+#: lower-is-better twin of the throughput ratchet.
+
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 #: Fields that define a comparable measurement shape.  Missing fields
@@ -105,6 +115,10 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     "value": float(parsed["value"]),
                     "unit": parsed.get("unit", ""),
                     "compile_s": parsed.get("compile_s"),
+                    "reconfig_s": parsed.get("reconfig_s"),
+                    "reconfig_compile_events": parsed.get(
+                        "reconfig_compile_events"
+                    ),
                     "telemetry_overhead": parsed.get("telemetry_overhead"),
                     "parsed": parsed,
                 }
@@ -144,6 +158,45 @@ def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
                 f"exceeds the {OVERHEAD_BAR:.2f} bar (interleaved "
                 "off/on A/B; the observability planes ship under <=10%)"
             )
+        # warm-reconfig bars (ISSUE 13): every capture that measured a
+        # reconfig_s must (a) have compiled NOTHING during the warm
+        # runs and (b) beat the cold compile by RECONFIG_SPEEDUP_BAR
+        rc = r.get("reconfig_s")
+        if rc is not None:
+            ev = r.get("reconfig_compile_events")
+            if ev:
+                problems.append(
+                    f"{r['file']}: {ev:.0f} compile event(s) during the "
+                    "warm re-configure runs — the dynamic-operand "
+                    "promotion is recompiling (compile_stats delta "
+                    "must be 0)"
+                )
+            comp = r.get("compile_s")
+            if comp is not None and float(rc) > 0 and (
+                float(comp) / float(rc) < RECONFIG_SPEEDUP_BAR
+            ):
+                problems.append(
+                    f"{r['file']}: warm reconfig {float(rc):.3f}s is "
+                    f"only {float(comp) / float(rc):.1f}x faster than "
+                    f"the {float(comp):.1f}s cold compile (bar: "
+                    f">= {RECONFIG_SPEEDUP_BAR:.0f}x)"
+                )
+    # lower-is-better ratchet on reconfig_s per shape
+    for shape, traj in trajectories(rows).items():
+        seq = [r for r in traj if r.get("reconfig_s") is not None]
+        if len(seq) < 2:
+            continue
+        latest = seq[-1]
+        best_prior = min(seq[:-1], key=lambda r: float(r["reconfig_s"]))
+        ceil_ = float(best_prior["reconfig_s"]) * (1.0 + tolerance)
+        if float(latest["reconfig_s"]) > ceil_:
+            problems.append(
+                f"{latest['file']}: reconfig_s "
+                f"{float(latest['reconfig_s']):.3f} regressed vs best "
+                f"prior {float(best_prior['reconfig_s']):.3f} "
+                f"({best_prior['file']}) at shape [{_shape_str(shape)}] "
+                f"(tolerance {tolerance * 100:.0f}%)"
+            )
     for shape, traj in trajectories(rows).items():
         if len(traj) < 2:
             continue
@@ -166,9 +219,10 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
     out = []
     if markdown:
         out.append(
-            "| round | file | decisions/s | vs prior | compile_s |"
+            "| round | file | value | vs prior | compile_s | "
+            "reconfig_s |"
         )
-        out.append("|---|---|---|---|---|")
+        out.append("|---|---|---|---|---|---|")
     for shape, traj in sorted(
         trajectories(rows).items(), key=lambda kv: _shape_str(kv[0])
     ):
@@ -183,10 +237,15 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                 f"{r['compile_s']:.1f}" if r["compile_s"] is not None
                 else "—"
             )
+            rc = (
+                f"{r['reconfig_s']:.3f}"
+                if r.get("reconfig_s") is not None
+                else "—"
+            )
             if markdown:
                 out.append(
                     f"| r{r['round']} | {r['file']} | "
-                    f"{r['value']:,.0f} | {ratio} | {comp} |"
+                    f"{r['value']:,.0f} | {ratio} | {comp} | {rc} |"
                 )
             else:
                 oh = (
@@ -194,9 +253,14 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                     if r.get("telemetry_overhead") is not None
                     else ""
                 )
+                rcs = (
+                    f", reconfig {rc}s"
+                    if r.get("reconfig_s") is not None
+                    else ""
+                )
                 out.append(
                     f"  r{r['round']:<2} {r['value']:>14,.1f} {r['unit']}"
-                    f"  ({ratio}, compile {comp}s{oh})  {r['file']}"
+                    f"  ({ratio}, compile {comp}s{oh}{rcs})  {r['file']}"
                 )
             prev = r["value"]
     return "\n".join(out)

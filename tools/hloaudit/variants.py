@@ -64,6 +64,25 @@ class Variant:
     declared_collectives: Optional[Dict[str, Set[str]]] = None
 
 
+#: The chaos-on tick overrides (ISSUE 12), shared by ``tick_chaos`` and
+#: the promoted ``tick_dyn`` variant so the two audit the SAME world —
+#: one as trace constants, one as DynSpec operands.
+CHAOS_OVERRIDES = dict(
+    chaos=True,
+    chaos_mode=1,  # ChaosMode.REOFFLOAD
+    chaos_mtbf_s=0.05,
+    chaos_mttr_s=0.02,
+    chaos_max_retries=3,
+    chaos_script=((0, 0.005, 0.01),),
+    chaos_rtt_amp=0.5,
+    chaos_rtt_burst_prob=0.02,
+    # chaos mutates fog liveness: no static hoist, and the ack columns
+    # must stay eager (derive_acks needs assume_static)
+    assume_static=False,
+    derive_acks=False,
+)
+
+
 def _compile_tick(**build_overrides):
     """Compile ONE tick of the op-budget pinned world; returns
     (hlo_text, spec).  The same lower/compile path op_budget gates, so
@@ -84,6 +103,36 @@ def _compile_tick(**build_overrides):
         lambda s: step(s, net, bounds, cache)
     ).lower(state).compile()
     return compiled.as_text(), spec
+
+
+def _compile_tick_dyn():
+    """Compile the PROMOTED tick (ISSUE 13): shape key static, every
+    promoted knob a DynSpec operand — the program the warm-reconfig /
+    shape-bucket reuse path executes.  Audited on the chaos-on world so
+    the chaos/learn/link operand leaves are actually CONSUMED (a
+    knob-free world would audit dead operands).  Must stay
+    host-transfer-free and f64-free exactly like the constant-folded
+    twin (``tick_chaos``)."""
+    import jax
+
+    from fognetsimpp_tpu.net.topology import associate
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.dynspec import split_spec
+    from fognetsimpp_tpu.scenarios import smoke
+    from tools.op_budget import PINNED
+
+    spec, state, net, bounds = smoke.build(
+        **{**PINNED, **CHAOS_OVERRIDES}
+    )
+    key_spec, dyn = split_spec(spec)
+    step = make_step(key_spec)
+    cache = associate(
+        net, state.nodes.pos, state.nodes.alive, broker=spec.broker_index
+    )
+    compiled = jax.jit(
+        lambda s, d: step(s, net, bounds, cache, dyn=d)
+    ).lower(state, dyn).compile()
+    return compiled.as_text(), key_spec
 
 
 def _compile_fleet():
@@ -196,21 +245,15 @@ def variants() -> List[Variant]:
             "scripted outage + periodic/burst RTT degradation) — the "
             "fault path must stay host-transfer-free, f64-free and "
             "collective-free like every single-device tick",
-            lambda: _compile_tick(
-                chaos=True,
-                chaos_mode=1,  # ChaosMode.REOFFLOAD
-                chaos_mtbf_s=0.05,
-                chaos_mttr_s=0.02,
-                chaos_max_retries=3,
-                chaos_script=((0, 0.005, 0.01),),
-                chaos_rtt_amp=0.5,
-                chaos_rtt_burst_prob=0.02,
-                # chaos mutates fog liveness: no static hoist, and the
-                # ack columns must stay eager (derive_acks needs
-                # assume_static)
-                assume_static=False,
-                derive_acks=False,
-            ),
+            lambda: _compile_tick(**CHAOS_OVERRIDES),
+        ),
+        Variant(
+            "tick_dyn",
+            "the same chaos-on tick with the promoted DynSpec operand "
+            "(ISSUE 13): shape key static, every promoted knob run-time "
+            "data — the warm-reconfig/shape-bucket program; must stay "
+            "host-transfer-free with its op budget pinned",
+            _compile_tick_dyn,
         ),
         Variant(
             "fleet_step",

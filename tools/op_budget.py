@@ -116,6 +116,20 @@ def compile_chaos_counts() -> dict:
     return entry_op_counts(text)
 
 
+def compile_dyn_counts() -> dict:
+    """Compile the promoted-operand tick (the hloaudit ``tick_dyn``
+    shape: the tick_chaos world with every promoted knob a DynSpec
+    operand, ISSUE 13) and count its HLO ops.  The pin is what keeps
+    "one program, many worlds" from quietly costing kernels: an operand
+    that blocks a constant-fold XLA used to exploit shows up here as op
+    growth vs ``tick_chaos``."""
+    from tools.hloaudit.variants import variants
+
+    v = next(x for x in variants() if x.name == "tick_dyn")
+    text, _spec = v.compile_fn()
+    return entry_op_counts(text)
+
+
 def compile_tp_counts(telemetry: bool = False) -> dict:
     """Compile the shard_map'd TP sharded tick and count its HLO ops +
     collectives (ISSUE 9).
@@ -172,6 +186,7 @@ def measure(tp: bool = True) -> dict:
     fused = compile_tick_counts(fused=True)
     unfused = compile_tick_counts(fused=False)
     chaos = compile_chaos_counts()
+    dyn = compile_dyn_counts()
     out_tp = {}
     if tp:
         for key, telem in (("tp_tick", False),
@@ -198,6 +213,11 @@ def measure(tp: bool = True) -> dict:
             **chaos,
             "max_ops": int(chaos["ops"] * COUNT_SLACK),
             "max_fusions": int(chaos["fusions"] * COUNT_SLACK),
+        },
+        "tick_dyn": {
+            **dyn,
+            "max_ops": int(dyn["ops"] * COUNT_SLACK),
+            "max_fusions": int(dyn["fusions"] * COUNT_SLACK),
         },
         **out_tp,
     }
@@ -226,22 +246,24 @@ def check(measured: dict, budget: dict) -> list:
             f"fused/unfused ops ratio {ratio:.3f} > {cap} — the "
             f"fused front-end lost its kernel-count reduction"
         )
-    # --- the chaos fault-injection tick (ISSUE 12) ---------------------
-    tc, btc = measured.get("tick_chaos"), budget.get("tick_chaos")
-    if tc is not None:
+    # --- the chaos (ISSUE 12) and promoted-operand (ISSUE 13) ticks ----
+    for vname in ("tick_chaos", "tick_dyn"):
+        tc, btc = measured.get(vname), budget.get(vname)
+        if tc is None:
+            continue
         if btc is None:
             errs.append(
-                "budget file predates the tick_chaos variant — "
+                f"budget file predates the {vname} variant — "
                 "regenerate with --write"
             )
-        else:
-            for k, cap_key in (("ops", "max_ops"),
-                               ("fusions", "max_fusions")):
-                if tc[k] > btc[cap_key]:
-                    errs.append(
-                        f"tick_chaos {k} regressed: {tc[k]} > "
-                        f"budget {btc[cap_key]}"
-                    )
+            continue
+        for k, cap_key in (("ops", "max_ops"),
+                           ("fusions", "max_fusions")):
+            if tc[k] > btc[cap_key]:
+                errs.append(
+                    f"{vname} {k} regressed: {tc[k]} > "
+                    f"budget {btc[cap_key]}"
+                )
     # --- the TP sharded ticks (ISSUE 9; telemetry-on since ISSUE 11) ---
     for key in ("tp_tick", "tp_tick_telemetry"):
         tp = measured.get(key)

@@ -1,0 +1,16 @@
+"""R13 fixture: promoted spec knobs folded into the trace as constants,
+bypassing the DynSpec operand (the closure-re-capture rot ISSUE 13's
+simlint rule guards against)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def degrade_links(spec, d2b, t0):
+    # R13: value read of a promoted knob flows into the trace as a
+    # constant — re-specializes the program per amplitude
+    fac = 1.0 + np.float32(spec.chaos_rtt_amp) * jnp.sin(t0)
+    # R13: same rot through an intermediate assignment
+    scale = spec.learn_reward_scale
+    return d2b * fac * scale

@@ -1,0 +1,15 @@
+"""R13 negative fixture: gate reads in `if` tests are trace structure
+(legitimate), and value reads go through the DynSpec view `dv`."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def degrade_links(spec, dv, d2b, t0):
+    fac = jnp.ones_like(d2b)
+    if spec.chaos_rtt_amp > 0:  # gate read: selects the trace, ok
+        # value read through the operand view: compile-free reconfig
+        fac = 1.0 + dv.chaos_rtt_amp * jnp.sin(t0)
+    if spec.queue_capacity > 4:  # non-promoted field: out of scope
+        fac = fac * 2.0
+    return d2b * fac

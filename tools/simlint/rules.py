@@ -941,6 +941,79 @@ class DonatedReuseRule(Rule):
                     break  # one finding per donated name per call
 
 
+#: WorldSpec fields promoted to DynSpec operands (ISSUE 13).  A literal
+#: copy of ``fognetsimpp_tpu.dynspec.DYN_FIELDS`` — simlint stays
+#: AST-only (never imports the package it lints); tests/test_dynspec.py
+#: pins the two lists equal so they cannot drift.
+DYN_PROMOTED_FIELDS = frozenset({
+    "uplink_loss_prob", "send_stop_time", "link_up_s", "link_drain_s",
+    "link_drain2_s", "link_rate_bps", "chaos_mtbf_s", "chaos_mttr_s",
+    "chaos_rtt_amp", "chaos_rtt_period_s", "chaos_rtt_burst_prob",
+    "chaos_rtt_burst_mult", "chaos_max_retries", "learn_discount",
+    "learn_reward_scale", "idle_power_w", "tx_energy_j", "rx_energy_j",
+    "compute_power_w", "harvest_power_w", "harvest_period_s",
+    "harvest_duty", "shutdown_frac", "start_frac",
+})
+
+
+class DynOperandRule(Rule):
+    """R13: a promoted spec knob read inside device code that bypasses
+    the DynSpec operand.  ``spec.<knob>`` folded into a trace as a
+    constant silently re-specializes the program on that knob's VALUE —
+    the exact recompile wall ISSUE 13 removed, re-opened by closure
+    re-capture.  Device code must read promoted knobs through the
+    ``dv`` / ``dyn`` DynSpec view; Python-level GATE reads (``if
+    spec.uplink_loss_prob > 0:``) stay legitimate trace structure and
+    are exempt, as are asserts/raises."""
+
+    id = "R13"
+    title = "promoted spec knob bypasses the DynSpec operand"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn, node in mod.device_nodes():
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not (
+                isinstance(node.value, ast.Name)
+                and node.value.id in ("spec", "sp")
+            ):
+                continue
+            if node.attr not in DYN_PROMOTED_FIELDS:
+                continue
+            if self._is_static_gate(mod, node):
+                continue
+            yield mod.finding(
+                self.id, node,
+                f"`spec.{node.attr}` is a promoted dynamic-operand knob "
+                "(dynspec.DYN_FIELDS): folding it into the trace as a "
+                "constant re-specializes the compiled program per value "
+                "— read it through the DynSpec view (`dv."
+                f"{node.attr}`) so warm re-configuration stays "
+                "compile-free; Python gate reads belong in an `if` test",
+            )
+
+    @staticmethod
+    def _is_static_gate(mod: ModuleInfo, node: ast.AST) -> bool:
+        """True when the read is trace STRUCTURE, not trace data: the
+        test of an ``if``/``while``/ternary, or an assert/raise."""
+        cur = node
+        parent = mod.parents.get(cur)
+        while parent is not None:
+            if isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return False
+            if isinstance(parent, (ast.Assert, ast.Raise)):
+                return True
+            if (
+                isinstance(parent, (ast.If, ast.IfExp, ast.While))
+                and cur is parent.test
+            ):
+                return True
+            cur, parent = parent, mod.parents.get(parent)
+        return False
+
+
 def default_rules() -> Tuple[Rule, ...]:
     return (
         HostSyncRule(),
@@ -955,4 +1028,5 @@ def default_rules() -> Tuple[Rule, ...]:
         IntF32SumRule(),
         ScanCallbackRule(),
         DonatedReuseRule(),
+        DynOperandRule(),
     )
