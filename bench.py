@@ -756,6 +756,179 @@ def chaos_measurement() -> dict:
     }
 
 
+def hier_measurement() -> dict:
+    """Federation benchmark (ISSUE 14): the imbalanced multi-broker
+    world, one row per migration policy.
+
+    ``python bench.py --hier`` runs TWO acceptance worlds:
+
+    * **imbalance** — every user publishes to broker 0, whose small
+      slow domain saturates, while broker 1 owns the fast idle fogs one
+      federation RTT away: THRESHOLD / LEAST_LOADED migration must beat
+      NEVER on mean AND p95 task latency;
+    * **domain-down** — scripted chaos kills the whole of domain 0
+      mid-run (RE-OFFLOAD in-flight handling): migration must recover
+      tasks that NEVER terminally loses (NO_RESOURCE / LOST /
+      hop-exhausted).
+
+    Env knobs: BENCH_HIER_USERS / BENCH_HIER_FOGS / BENCH_HIER_BROKERS
+    / BENCH_HIER_HORIZON / BENCH_HIER_INTERVAL / BENCH_HIER_RTT /
+    BENCH_HIER_SEED.  Headline value = THRESHOLD decisions/s on the
+    imbalance world; ``n_brokers`` rides the JSON so
+    tools/bench_trend.py ratchets federation rows as their own
+    trajectories.
+    """
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu.compile_cache import (
+        compile_stats,
+        enable_compile_cache,
+        note_compile,
+    )
+    from fognetsimpp_tpu.core.engine import run_jit
+    from fognetsimpp_tpu.hier import stamp_ownership
+    from fognetsimpp_tpu.runtime.signals import extract_signals
+    from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.spec import ChaosMode, HierPolicy, Stage
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+
+    n_users = _env_int("BENCH_HIER_USERS", 16)
+    n_fogs = _env_int("BENCH_HIER_FOGS", 8)
+    n_brokers = _env_int("BENCH_HIER_BROKERS", 2)
+    horizon = _env_float("BENCH_HIER_HORIZON", 4.0)
+    interval = _env_float("BENCH_HIER_INTERVAL", 0.05)
+    dt = _env_float("BENCH_HIER_DT", 1e-3)
+    rtt = _env_float("BENCH_HIER_RTT", 0.005)
+    seed = _env_int("BENCH_HIER_SEED", 0)
+    # domain 0: the first n_fogs//4 fogs, slow; domain 1..B-1 split the
+    # fast remainder.  Every user publishes into domain 0 (the hot cell)
+    n_slow = max(1, n_fogs // 4)
+    fog_owner = [0] * n_slow + [
+        1 + (i * (n_brokers - 1)) // (n_fogs - n_slow)
+        for i in range(n_fogs - n_slow)
+    ]
+    user_owner = [0] * n_users
+    mips = tuple([3000.0] * n_slow + [80000.0] * (n_fogs - n_slow))
+
+    def build(hier_policy, chaos_script=None):
+        kw = dict(
+            n_users=n_users,
+            n_fogs=n_fogs,
+            fog_mips=mips,
+            send_interval=interval,
+            horizon=horizon,
+            dt=dt,
+            max_sends_per_user=int(horizon / interval) + 4,
+            queue_capacity=128,
+            start_time_max=min(0.05, horizon / 4),
+            seed=seed,
+            assume_static=chaos_script is None,
+            n_brokers=n_brokers,
+            hier_policy=int(hier_policy),
+            hier_threshold=0.5,
+            hier_max_hops=2,
+            hier_rtt_s=rtt,
+        )
+        if chaos_script is not None:
+            kw.update(
+                chaos=True,
+                chaos_mode=int(ChaosMode.REOFFLOAD),
+                chaos_seed=seed,
+                chaos_script=chaos_script,
+                chaos_max_retries=8,
+                assume_static=False,
+            )
+        spec, state, net, bounds = smoke.build(**kw)
+        state = stamp_ownership(
+            spec, state, user_broker=user_owner, fog_broker=fog_owner
+        )
+        return spec, state, net, bounds
+
+    # domain-down script: every domain-0 fog out for the middle ~80%
+    down = tuple(
+        (f, round(horizon * 0.1, 3), round(horizon * 0.9, 3))
+        for f in range(n_slow)
+    )
+
+    def measure(hier_policy, chaos_script=None):
+        spec, state, net, bounds = build(hier_policy, chaos_script)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run_jit(spec, state, net, bounds))
+        compile_s = time.perf_counter() - t0
+        note_compile(compile_s)
+        spec, state, net, bounds = build(hier_policy, chaos_script)
+        t0 = time.perf_counter()
+        final = run_jit(spec, state, net, bounds)
+        jax.block_until_ready(final.metrics.n_scheduled)
+        wall = time.perf_counter() - t0
+        lat = extract_signals(final)["task_time"]
+        stage = np.asarray(final.tasks.stage)
+        lost = int(
+            (stage == int(Stage.NO_RESOURCE)).sum()
+            + (stage == int(Stage.LOST)).sum()
+            + (stage == int(Stage.HOP_EXHAUSTED)).sum()
+        )
+        decisions = int(np.asarray(final.metrics.n_scheduled))
+        return {
+            "decisions": decisions,
+            "decisions_per_sec": round(decisions / wall, 1),
+            "wall_s": round(wall, 4),
+            "completed": int(np.asarray(final.metrics.n_completed)),
+            "mean_latency_ms": (
+                round(float(lat.mean()), 3) if lat.size else None
+            ),
+            "p95_latency_ms": (
+                round(float(np.percentile(lat, 95)), 3)
+                if lat.size else None
+            ),
+            "migrated": int(np.asarray(final.hier.n_migrated)),
+            "hop_exhausted": int(np.asarray(final.hier.n_hop_exhausted)),
+            "lost_terminal": lost,
+        }, compile_s
+
+    pols = (HierPolicy.NEVER, HierPolicy.THRESHOLD,
+            HierPolicy.LEAST_LOADED)
+    imbalance, domain_down = {}, {}
+    compile_s_total = 0.0
+    for pol in pols:
+        row, cs = measure(pol)
+        imbalance[pol.name.lower()] = row
+        compile_s_total += cs
+        row, cs = measure(pol, chaos_script=down)
+        domain_down[pol.name.lower()] = row
+        compile_s_total += cs
+
+    headline = imbalance["threshold"]
+    return {
+        "metric": "hier_task_offload_decisions_per_sec",
+        "value": headline["decisions_per_sec"],
+        "unit": "decisions/s",
+        "backend": backend,
+        "n_brokers": n_brokers,
+        "n_users": n_users,
+        "n_fogs": n_fogs,
+        "horizon_s": horizon,
+        "dt": dt,
+        "interval": interval,
+        "hier_rtt_s": rtt,
+        "policy": "min_busy",
+        "decisions": headline["decisions"],
+        "wall_s": headline["wall_s"],
+        "imbalance": imbalance,
+        "domain_down": domain_down,
+        "compile_s": round(compile_s_total, 1),
+        "compile_cache": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in compile_stats().items()
+        },
+        "conservation": "spawned = completed + dropped + lost + "
+        "in-flight + hop-exhausted; tests/test_hier.py",
+    }
+
+
 def reconfig_measurement() -> dict:
     """Warm re-configuration benchmark (ISSUE 13): cold compile vs warm
     knob tweak on the promoted (shape-key + DynSpec operand) path.
@@ -889,6 +1062,13 @@ def chaos_main() -> None:
     print(json.dumps(chaos_measurement()))
 
 
+def hier_main() -> None:
+    """``python bench.py --hier`` (or ``BENCH_HIER=1``): the federation
+    headline — the imbalanced multi-broker world plus the domain-down
+    chaos world, one row per migration policy."""
+    print(json.dumps(hier_measurement()))
+
+
 def tp_main() -> None:
     """``python bench.py --tp`` (or ``BENCH_TP=1``): the TP capacity
     headline — one ≥1M-user world sharded over BENCH_DEVICES devices."""
@@ -917,6 +1097,8 @@ if __name__ == "__main__":
         tp_main()
     elif "--chaos" in sys.argv or os.environ.get("BENCH_CHAOS"):
         chaos_main()
+    elif "--hier" in sys.argv or os.environ.get("BENCH_HIER"):
+        hier_main()
     elif "--reconfig" in sys.argv or os.environ.get("BENCH_RECONFIG"):
         reconfig_main()
     else:

@@ -20,11 +20,13 @@ from .spec import (  # noqa: F401
     LEARNED_POLICIES,
     BugCompat,
     FogModel,
+    HierPolicy,
     Mobility,
     NodeKind,
     Policy,
     Stage,
     WorldSpec,
+    hier_policy_from_name,
     policy_from_name,
 )
 from .state import WorldState, init_state  # noqa: F401

@@ -138,6 +138,19 @@ def main(argv=None) -> int:
                     help="devices in the replica mesh (default: all "
                     "visible devices); implies --replicas D when "
                     "--replicas is omitted")
+    ap.add_argument("--brokers", type=int, metavar="B", default=None,
+                    help="federated multi-broker hierarchy (hier/): "
+                    "partition users and fogs into B broker domains "
+                    "(block-contiguous ownership) with broker↔broker "
+                    "task migration; shorthand for spec.n_brokers=B — "
+                    "composes with --policy/--telemetry/--chaos/"
+                    "--trace-out; B must be in [1, n_fogs]")
+    ap.add_argument("--hier-policy", metavar="NAME", default=None,
+                    help="broker↔broker migration policy: never, "
+                    "threshold (local busy fraction > "
+                    "spec.hier_threshold), or least_loaded (aged peer "
+                    "load summaries); needs --brokers B with B > 1; "
+                    "refine knobs with --set spec.hier_*=...")
     ap.add_argument("--chaos", metavar="PROFILE", default=None,
                     help="deterministic fault injection (chaos/): run "
                     "the scenario under a named chaos profile — fog "
@@ -246,6 +259,43 @@ def main(argv=None) -> int:
         ap.error("--tp-window sizes the TP arrival exchange; it needs "
                  "--tp N")
 
+    # ---- hierarchy guard rails (hier/) --------------------------------
+    if args.brokers is not None:
+        if args.brokers < 1:
+            print(
+                f"error: --brokers must be >= 1, got {args.brokers} "
+                "(1 = the single base broker, B > 1 federates)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.tp is not None:
+            ap.error("--brokers federates ONE world's decide phase; "
+                     "the TP sharded tick does not carry the hierarchy "
+                     "yet — pick one of --brokers/--tp per run")
+        if args.replicas is not None or args.mesh is not None:
+            ap.error("--brokers federates ONE world; the fleet runner "
+                     "does not carry the hierarchy yet — run federated "
+                     "worlds without --replicas/--mesh")
+        if args.sweep:
+            ap.error("--sweep grids own their replica fan-out and do "
+                     "not carry the hierarchy; run federated worlds "
+                     "without --sweep")
+    if args.hier_policy is not None:
+        if args.brokers is None or args.brokers < 2:
+            print(
+                "error: --hier-policy selects the broker↔broker "
+                "migration policy; it needs --brokers B with B > 1",
+                file=sys.stderr,
+            )
+            return 2
+        from .spec import hier_policy_from_name
+
+        try:
+            args.hier_policy = int(hier_policy_from_name(args.hier_policy))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
     # ---- chaos guard rails (ISSUE 12) ---------------------------------
     if args.chaos is None:
         for flag, val in (("--chaos-seed", args.chaos_seed),
@@ -312,6 +362,12 @@ def main(argv=None) -> int:
             # actionable line, never a traceback
             print(f"error: {e}", file=sys.stderr)
             return 2
+    # hierarchy lines land BELOW the --set overrides (first match
+    # wins), so --set spec.n_brokers/hier_* refines the flags
+    if args.brokers is not None:
+        pre.append(f"spec.n_brokers = {args.brokers}")
+    if args.hier_policy is not None:
+        pre.append(f"spec.hier_policy = {args.hier_policy}")
     if args.ticks or args.trails:
         pre.append("spec.record_tick_series = true")
     if args.trails:

@@ -168,6 +168,41 @@ def init_chaos_state(
     )
 
 
+def refold_chaos_state(
+    spec: WorldSpec, ch: ChaosState, new_key: jax.Array
+) -> ChaosState:
+    """Re-key a t=0 chaos state onto a new chaos stream key.
+
+    Re-derives every key-dependent init draw (the first crash gaps and
+    the per-fog RTT phases) from ``new_key`` so the whole schedule —
+    including epoch 0 — is a pure function of the new stream, exactly
+    what :func:`outage_timeline` replays.  The per-replica fan-out
+    (``parallel/replicas.replicate_state``) vmaps this over
+    ``fold_in(chaos_key, replica)`` keys; a state whose counters have
+    already advanced must not be refolded (asserting that would need a
+    device fetch, so the contract is documented, not checked).
+    """
+    if not spec.chaos:
+        return ch
+    epoch0 = jnp.zeros_like(ch.epoch)
+    if spec.chaos_mtbf_s > 0:
+        gap0, _ = _outage_draws(spec, new_key, epoch0)
+        next_down = gap0
+    else:
+        next_down = jnp.full_like(ch.next_down, jnp.inf)
+    F = ch.rtt_phase.shape[0]
+    rtt_phase = jax.random.uniform(
+        jax.random.fold_in(new_key, _RTT_PHASE_FOLD), (F,), jnp.float32,
+        minval=0.0, maxval=2.0 * np.pi,
+    )
+    return ch.replace(
+        key=new_key,
+        next_down=next_down,
+        next_up=jnp.full_like(ch.next_up, jnp.inf),
+        rtt_phase=rtt_phase,
+    )
+
+
 def step_lifecycle(
     spec: WorldSpec,
     ch: ChaosState,

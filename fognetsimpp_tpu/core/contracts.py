@@ -195,6 +195,13 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             when=lambda sp: sp.chaos,
         ),
         PhaseContract(
+            "_phase_broker_migrate",
+            lambda sp, s, n, c, b, t0, t1: E._phase_broker_migrate(
+                sp, s, n, c, b, t0, t1
+            ),
+            when=lambda sp: E._hier_migrate_on(sp),
+        ),
+        PhaseContract(
             "_phase_learn_credit",
             lambda sp, s, n, c, b, t0, t1: E._phase_learn_credit(
                 sp, s, n, c, b, t1
@@ -234,6 +241,11 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             "_phase_spawn_multi",
             fused_call(E._phase_spawn_multi, with_t0=True),
             when=lambda sp: E._fused_ok(sp) and sp.max_sends_per_tick > 1,
+        ),
+        PhaseContract(
+            "_phase_broker_migrate",
+            fused_call(E._phase_broker_migrate, with_t0=True),
+            when=lambda sp: E._hier_migrate_on(sp) and E._fused_ok(sp),
         ),
         PhaseContract(
             "_phase_broker_dense",
@@ -353,6 +365,14 @@ def check_telemetry_contract(spec: WorldSpec, state) -> None:
         "exg_defer_sum": (S,), "exg_defer_max": (S,),
         "exg_util_sum": (S,), "exg_age_max": (S,),
         "exg_occ_res": (Rs, S),
+        # federated hierarchy (hier/): zero-row unless the spec is a
+        # telemetry-on multi-broker world — nested inside
+        # spec.telemetry like the hist/TP gates
+        "hier_load_sum": (spec.telemetry_hier_brokers,),
+        "hier_load_res": (
+            R if spec.telemetry_hier_brokers else 0,
+            spec.telemetry_hier_brokers,
+        ),
     }
     for name, shape in expect.items():
         got = tuple(getattr(t, name).shape)

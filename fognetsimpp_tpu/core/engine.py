@@ -65,10 +65,12 @@ from ..ops.queues import (
     row_lexmin,
 )
 from ..ops.sched import scalar_winner, schedule_batch, task_uniform
+from ..hier.federation import hier_reject_reason
 from ..spec import (
     STATIC_MAC_ERR,
     ChaosMode,
     FogModel,
+    HierPolicy,
     Policy,
     Stage,
     WorldSpec,
@@ -93,6 +95,7 @@ _ST_DROPPED = np.int8(int(Stage.DROPPED))
 _ST_LOCAL_RUN = np.int8(int(Stage.LOCAL_RUN))
 _ST_REJECTED = np.int8(int(Stage.REJECTED))
 _ST_LOST = np.int8(int(Stage.LOST))
+_ST_HOP_EXHAUSTED = np.int8(int(Stage.HOP_EXHAUSTED))
 
 
 # The assume_static x Bianchi-keyed-MAC conflict message: defined ONCE
@@ -161,6 +164,10 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
             "yet (run chaos worlds on single-device run/run_jit/"
             "run_chunked)"
         )
+    if spec.hier_active:
+        # same subsystem-first ordering as chaos: ONE message source
+        # (hier/federation.hier_reject_reason) shared with the fleet gate
+        return hier_reject_reason(spec, "TP")
     if spec.fog_model != int(FogModel.FIFO):
         return "TP tick covers FIFO fogs only (POOL pools are sequential)"
     if not _broker_dense_ok(spec):
@@ -1292,15 +1299,39 @@ def _phase_broker_dense(
     # dead fogs — bug_compat — so this is gated on spec.chaos to keep
     # chaos-off worlds bit-exact)
     reg_eff = b.registered & fog_alive if spec.chaos else b.registered
-    any_fog = jnp.any(reg_eff)
     fog_efrac = state.nodes.energy[U : U + F] / jnp.maximum(
         state.nodes.energy_capacity[U : U + F], 1e-12
     )
-    choice_s = scalar_winner(
-        spec.policy, b.view_busy, b.view_mips, reg_eff, fog_alive,
-        fog_efrac, 2.0 * cache.d2b[U : U + F],
-        spec.bug_compat.v1_max_scan,
-    )
+    if spec.hier_active:
+        # federated hierarchy: one scalar winner PER BROKER DOMAIN
+        # (vmap of the same reference-faithful scan over each domain's
+        # availability slice), selected per task by its owning broker —
+        # the decide stays elementwise over the (U, S) view, with two
+        # tiny (B,)-table gathers replacing the scalar broadcast
+        B = spec.n_brokers
+        owned_bf = (
+            state.hier.fog_broker[None, :]
+            == jnp.arange(B, dtype=i32)[:, None]
+        )  # (B, F)
+        reg_b = reg_eff[None, :] & owned_bf
+        rtt_bf = 2.0 * cache.d2b[U : U + F]
+        choice_B = jax.vmap(
+            lambda rg: scalar_winner(
+                spec.policy, b.view_busy, b.view_mips, rg, fog_alive,
+                fog_efrac, rtt_bf, spec.bug_compat.v1_max_scan,
+            )
+        )(reg_b)  # (B,)
+        any_B = jnp.any(reg_b, axis=1)
+        tb2 = jnp.clip(state.hier.task_broker, 0, B - 1).reshape(U, S)
+        choice_s = choice_B[tb2]  # (U, S) per-task domain winner
+        any_fog = any_B[tb2]
+    else:
+        any_fog = jnp.any(reg_eff)
+        choice_s = scalar_winner(
+            spec.policy, b.view_busy, b.view_mips, reg_eff, fog_alive,
+            fog_efrac, 2.0 * cache.d2b[U : U + F],
+            spec.bug_compat.v1_max_scan,
+        )
 
     choice_ok = choice_s >= 0
     if spec.policy == int(Policy.MAX_MIPS) and F > 0:
@@ -1583,10 +1614,33 @@ def _phase_broker(
     # set (gated on spec.chaos: chaos-off worlds keep the reference's
     # never-evicts-dead-fogs view, bit-exact)
     reg_eff = b.registered & fog_alive if spec.chaos else b.registered
-    any_fog = jnp.any(reg_eff)
     fog_efrac = state.nodes.energy[U : U + F] / jnp.maximum(
         state.nodes.energy_capacity[U : U + F], 1e-12
     )
+    hier_kw = {}
+    tb_g = None
+    if spec.hier_active:
+        # federated hierarchy: the window's tasks carry their owning
+        # broker; schedule_batch masks every policy's candidate set to
+        # the task's domain (per-domain brokers[0] anchors, bandit
+        # slices, RANDOM slot tables — ops/sched.py)
+        B_h = spec.n_brokers
+        tb_g = jnp.clip(state.hier.task_broker[idxc], 0, B_h - 1)
+        hier_kw = dict(
+            fog_owner=state.hier.fog_broker,
+            task_broker=tb_g,
+            n_brokers=B_h,
+        )
+        any_fog = jnp.any(
+            reg_eff[None, :]
+            & (
+                state.hier.fog_broker[None, :]
+                == jnp.arange(B_h, dtype=jnp.int32)[:, None]
+            ),
+            axis=1,
+        )[tb_g]  # (K,) per-task: does MY domain have a candidate?
+    else:
+        any_fog = jnp.any(reg_eff)
 
     offl = valid & ~local
     if spec.policy in (
@@ -1606,6 +1660,7 @@ def _phase_broker(
         spec.bug_compat.mips0_divisor, spec.bug_compat.v1_max_scan,
         policy_id=b.policy_id, order_t=t_ab_g, rand_u=rand_u,
         learn=arms_view(state.learn) if spec.learn_active else None,
+        **hier_kw,
     )
     choice_ok = choice >= 0
     guard_fail = jnp.zeros((K,), bool)
@@ -1644,12 +1699,29 @@ def _phase_broker(
             spec.policy == int(Policy.DYNAMIC) and spec.learn_in_dynamic
         )
         if exp3ish:
-            p_vec = exp3_probs(
-                learn2.logw, b.registered & fog_alive, learn2.explore
-            )
-            # p at the chosen fog per row via the membership matrix (a
-            # (K,) gather from an (F,) table serializes under vmap)
-            p_row = jnp.sum(jnp.where(picked, p_vec[:, None], 0.0), axis=0)
+            if spec.hier_active:
+                # per-domain distributions (the same rows the pick
+                # sampled from in ops/sched.py): the stored importance
+                # weight is the probability within the task's OWN
+                # broker's softmax
+                owned_bf = (
+                    state.hier.fog_broker[None, :]
+                    == jnp.arange(spec.n_brokers, dtype=jnp.int32)[:, None]
+                )
+                p_bf = jax.vmap(
+                    lambda av: exp3_probs(learn2.logw, av, learn2.explore)
+                )((b.registered & fog_alive)[None, :] & owned_bf)
+                p_row = p_bf[tb_g, jnp.clip(choice, 0, F - 1)]
+            else:
+                p_vec = exp3_probs(
+                    learn2.logw, b.registered & fog_alive, learn2.explore
+                )
+                # p at the chosen fog per row via the membership matrix
+                # (a (K,) gather from an (F,) table serializes under
+                # vmap)
+                p_row = jnp.sum(
+                    jnp.where(picked, p_vec[:, None], 0.0), axis=0
+                )
             if spec.policy == int(Policy.DYNAMIC):
                 p_row = jnp.where(
                     b.policy_id == int(Policy.EXP3), p_row, 1.0
@@ -2972,6 +3044,161 @@ def _phase_chaos(
     )
 
 
+def _hier_migrate_on(spec: WorldSpec) -> bool:
+    """Static gate for the broker↔broker migrate phase: a federated
+    world whose migration policy is not NEVER.  NEVER worlds keep the
+    domain-masked decide phases but trace no migration machinery (the
+    isolated-domains baseline the bench compares against)."""
+    return spec.hier_active and spec.hier_policy != int(HierPolicy.NEVER)
+
+
+def _phase_broker_migrate(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t0: jax.Array, t1: jax.Array,
+    views: Optional[dict] = None,
+    dyn: Optional[DynSpec] = None,
+):
+    """Federated hierarchy: broker↔broker task migration (hier/).
+
+    Runs after the spawn phase and BEFORE the decide phase of the same
+    tick (threading the fused ``(U, S)`` register views when the fused
+    front-end is live), so a publish maturing at a saturated or dead
+    domain THIS tick can leave before the local broker decides — or
+    NO_RESOURCEs — it.  Three jobs:
+
+    * refresh each broker's AGED view of peer load summaries: entry
+      ``(b, p)`` re-reads peer p's live busy fraction only when its
+      ``rtt[b, p]`` exchange period has elapsed — federation sees stale
+      data exactly like fogs do through in-flight advertisements (a
+      freshly-dead peer can still look attractive for one RTT, which is
+      the staleness FogMQ's distributed brokers actually pay);
+    * fire the migration policy per broker (THRESHOLD on the local busy
+      fraction, LEAST_LOADED against the aged peer minimum; dead
+      domains — no registered, up fog — always want out) and re-home
+      every matured ``PUB_INFLIGHT`` task of a firing broker to the
+      least-loaded peer: ``task_broker`` restamps, ``t_at_broker``
+      advances by the inter-broker hop's RTT, and the task re-offers
+      through the established K-window arrival contract when it
+      matures at the new broker;
+    * enforce the bounded hop budget: a matured task in a DEAD domain
+      that can no longer move (``hops >= hier_max_hops``, or every
+      peer domain looks dead/fogless) becomes the terminal
+      :data:`Stage.HOP_EXHAUSTED`, counted in
+      ``HierState.n_hop_exhausted`` — the conservation identity's new
+      bucket.  Saturated-but-alive domains never exhaust: their tasks
+      simply stay and decide locally.
+
+    Deterministic (no PRNG consumption: destinations are argmin picks,
+    ties to the lowest broker id) and only traced when
+    :func:`_hier_migrate_on` — NEVER/single-broker worlds are
+    bit-exact without it (tests/test_hier.py).
+    """
+    U, F, T, B = spec.n_users, spec.n_fogs, spec.task_capacity, spec.n_brokers
+    i32, f32 = jnp.int32, jnp.float32
+    dv = dyn if dyn is not None else dyn_of(spec)
+    hier, tasks, b = state.hier, state.tasks, state.broker
+
+    bid = jnp.arange(B, dtype=i32)
+    owned = hier.fog_broker[None, :] == bid[:, None]  # (B, F)
+    fog_alive = state.nodes.alive[U : U + F]
+    # "usable" mirrors the decide phases' reg_eff exactly: a domain is
+    # dead here iff its broker's decide phase would find no candidate
+    usable = b.registered & fog_alive if spec.chaos else b.registered
+    if spec.fog_model == int(FogModel.POOL):
+        busy = state.fogs.pool_avail < state.fogs.mips
+    else:
+        busy = state.fogs.current_task != NO_TASK
+    up_b = owned & usable[None, :]
+    n_up = jnp.sum(up_b, axis=1)  # (B,)
+    n_busy = jnp.sum(up_b & busy[None, :], axis=1)
+    dead = n_up == 0
+    load = jnp.where(
+        dead, jnp.inf,
+        n_busy.astype(f32) / jnp.maximum(n_up.astype(f32), 1.0),
+    )  # (B,) live local busy fraction; a dead domain repels peers
+
+    # ---- aged peer-view exchange (staleness = inter-broker RTT) -------
+    # (jnp view of the RTT leaf: on the dyn=None static path it is a
+    # host np constant, which traced indexing below cannot consume raw)
+    rtt_m = jnp.asarray(dv.hier_rtt)
+    due = t1 >= hier.peer_t  # (B, B)
+    peer_load = jnp.where(due, load[None, :], hier.peer_load)
+    peer_t = jnp.where(due, t1 + rtt_m, hier.peer_t)
+
+    # ---- destination: least-loaded peer by the aged view --------------
+    has_fog = jnp.sum(owned, axis=1) > 0  # (B,) domains with owned fogs
+    cand = (~jnp.eye(B, dtype=bool)) & has_fog[None, :]
+    score = jnp.where(cand, peer_load, jnp.inf)  # (B, B)
+    dest = jnp.argmin(score, axis=1).astype(i32)  # ties → lowest id
+    has_dest = jnp.isfinite(jnp.min(score, axis=1))
+
+    # ---- fire policy per broker ---------------------------------------
+    if spec.hier_policy == int(HierPolicy.THRESHOLD):
+        fire = dead | (load > dv.hier_threshold)
+    else:  # LEAST_LOADED
+        fire = dead | (jnp.min(score, axis=1) < load)
+
+    # ---- per-task re-homing (elementwise over the (U, S) view) --------
+    S = spec.max_sends_per_user
+    if views is not None:
+        st2, tab2 = views["stage2"], views["t_at_broker2"]
+    else:
+        st2 = tasks.stage.reshape(U, S)
+        tab2 = tasks.t_at_broker.reshape(U, S)
+    matured2 = (st2 == _ST_PUB_INFLIGHT) & (tab2 <= t1)
+    tb = jnp.clip(hier.task_broker, 0, B - 1)  # (T,)
+    tb2 = tb.reshape(U, S)
+    hops_ok2 = (hier.hops.astype(i32) < dv.hier_max_hops).reshape(U, S)
+    mig2 = matured2 & fire[tb2] & has_dest[tb2] & hops_ok2
+    # exhaustion is a DEAD-domain terminal only: the task can never be
+    # served where it sits and cannot move
+    exhaust2 = matured2 & dead[tb2] & ~(has_dest[tb2] & hops_ok2)
+
+    dst2 = dest[tb2]  # (U, S)
+    rtt_hop2 = rtt_m[tb2, dst2]  # (U, S) src→dst hop latency
+    new_st2 = jnp.where(exhaust2, _ST_HOP_EXHAUSTED, st2)
+    new_tab2 = jnp.where(mig2, tab2 + rtt_hop2, tab2)
+    if views is not None:
+        views = dict(views)
+        views["stage2"] = new_st2
+        views["t_at_broker2"] = new_tab2
+    else:
+        tasks = tasks.replace(
+            stage=new_st2.reshape(T),
+            t_at_broker=new_tab2.reshape(T),
+        )
+    mig = mig2.reshape(T)
+    dst_t = dst2.reshape(T)
+    # one (B, T) membership reduce per direction instead of scatter-adds
+    out_b = jnp.sum(
+        (tb[None, :] == bid[:, None]) & mig[None, :], axis=1, dtype=i32
+    )
+    in_b = jnp.sum(
+        (dst_t[None, :] == bid[:, None]) & mig[None, :], axis=1, dtype=i32
+    )
+    sums = jnp.sum(
+        jnp.stack([mig2, exhaust2]).astype(i32), axis=(1, 2)
+    )
+    hier = hier.replace(
+        task_broker=jnp.where(mig, dst_t, hier.task_broker),
+        hops=hier.hops + mig.astype(jnp.int8),
+        peer_load=peer_load,
+        peer_t=peer_t,
+        mig_out=hier.mig_out + out_b,
+        mig_in=hier.mig_in + in_b,
+        n_migrated=hier.n_migrated + sums[0],
+        n_hop_exhausted=hier.n_hop_exhausted + sums[1],
+    )
+    # message accounting: each migration is one broker→broker task
+    # forward over the federation link (the one physical broker node
+    # carries both ends)
+    buf = buf._replace(tx_b=buf.tx_b + sums[0], rx_b=buf.rx_b + sums[0])
+    state = state.replace(tasks=tasks, hier=hier)
+    if views is not None:
+        return state, buf, views
+    return state, buf
+
+
 def _phase_learn_credit(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t1: jax.Array, dyn: Optional[DynSpec] = None,
@@ -3029,6 +3256,17 @@ def _phase_learn_credit(
             tasks.t_ack6[idxc] - tasks.t_at_broker[idxc],
             lat0,
         )
+    if spec.hier_active:
+        # a MIGRATED task's t_at_broker was restamped at each hop: the
+        # rescuing broker's pick is credited with its own leg only, not
+        # the federation detour — the chaos-retry restamp discipline,
+        # keyed per task on the hop column so hop-free worlds stay
+        # bit-exact
+        lat0 = jnp.where(
+            state.hier.hops[idxc] > 0,
+            tasks.t_ack6[idxc] - tasks.t_at_broker[idxc],
+            lat0,
+        )
     lat = jnp.where(valid, lat0, 0.0)
     pick_p_g = learn.pick_p[idxc]
     memb = _per_fog(valid, fog_g, F)  # (F, K)
@@ -3083,9 +3321,29 @@ def _phase_telemetry(
         )
     else:
         chaos, fogs_down = None, None
+    hier_load = None
+    if spec.telemetry_hier_brokers > 0:
+        # per-broker domain load gauge (busy owned fogs / owned fogs):
+        # the fns_hier_load family and the Perfetto broker lanes
+        B_h, F_h = spec.n_brokers, spec.n_fogs
+        owned_bf = (
+            state.hier.fog_broker[None, :]
+            == jnp.arange(B_h, dtype=jnp.int32)[:, None]
+        )
+        if spec.fog_model == int(FogModel.POOL):
+            busy_f = state.fogs.pool_avail < state.fogs.mips
+        else:
+            busy_f = state.fogs.current_task != NO_TASK
+        n_owned = jnp.sum(owned_bf, axis=1)
+        hier_load = jnp.sum(
+            owned_bf & busy_f[None, :], axis=1
+        ).astype(jnp.float32) / jnp.maximum(
+            n_owned.astype(jnp.float32), 1.0
+        )
     telem = accumulate_tick(
         spec, state.telem, state.fogs, state.learn, state.metrics,
         state.tick, t1, phase_work, chaos=chaos, fogs_down=fogs_down,
+        hier_load=hier_load,
     )
     return state.replace(telem=telem), buf
 
@@ -3348,6 +3606,15 @@ def make_step(
                 spec, state, net, cache, buf, t0, t1, views=fv, dyn=dv))
         if fused:
             fv = out
+        # federated hierarchy (spec.n_brokers > 1, hier/): migrate the
+        # publishes maturing at saturated/dead broker domains THIS tick
+        # out before the decide phase sees them — a chaos-killed
+        # domain's re-offloaded tasks leave the same tick they bounce
+        if _hier_migrate_on(spec):
+            out = _ph("broker_migrate", lambda: _phase_broker_migrate(
+                spec, state, net, cache, buf, t0, t1, views=fv, dyn=dv))
+            if fused:
+                fv = out
         v2_local = (
             spec.policy == int(Policy.LOCAL_FIRST) and spec.v2_local_broker
         )
@@ -3597,6 +3864,11 @@ def _finalize_derived_acks(
         & (st2 != _ST_PUB_INFLIGHT)
         & (st2 != _ST_LOST)
     )
+    if spec.n_brokers > 1:
+        # hop-exhausted tasks never reached a decide phase: no ack was
+        # ever sent (gated so single-broker worlds keep the exact
+        # pre-hier reconstruction trace)
+        decided = decided & (st2 != _ST_HOP_EXHAUSTED)
     queued = jnp.isfinite(qe2)
     assigned = jnp.isfinite(ss2) & ~queued
     done = st2 == _ST_DONE

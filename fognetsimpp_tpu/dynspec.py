@@ -77,6 +77,11 @@ DYN_FIELDS: Tuple[str, ...] = (
     # learned-scheduler reward weights
     "learn_discount",
     "learn_reward_scale",
+    # federated-hierarchy migration knobs (hier/)
+    "hier_threshold",
+    "hier_max_hops",
+    "hier_rtt_s",
+    "hier_rtt_matrix",
     # energy-model scalars
     "idle_power_w",
     "tx_energy_j",
@@ -165,6 +170,11 @@ _CANONICAL: Dict[str, float] = {
     "chaos_max_retries": 3,
     "learn_discount": 0.875,
     "learn_reward_scale": 0.625,
+    "hier_threshold": 0.8125,
+    "hier_max_hops": 3,
+    "hier_rtt_s": 0.015625,
+    # hier_rtt_matrix is shape-dependent: handled in _canonical_value
+    "hier_rtt_matrix": None,
     "idle_power_w": 0.25,
     "tx_energy_j": 0.25,
     "rx_energy_j": 0.25,
@@ -208,6 +218,13 @@ class DynSpec:
     # learn
     learn_discount: jax.Array
     learn_reward_scale: jax.Array
+    # federated hierarchy (hier/) — hier_rtt is the derived (B, B)
+    # inter-broker RTT matrix (explicit hier_rtt_matrix, else uniform
+    # hier_rtt_s off-diagonal with a zero diagonal); B is static so the
+    # leaf's shape never depends on knob values
+    hier_threshold: jax.Array
+    hier_max_hops: jax.Array  # i32
+    hier_rtt: jax.Array  # (B, B) f32; (1, 1) zero on single-broker worlds
     # energy (per-tick products precomputed against spec.dt)
     energy_idle_dt: jax.Array  # idle_power_w * dt
     energy_tx_j: jax.Array
@@ -251,6 +268,9 @@ def dyn_of(spec: WorldSpec) -> DynSpec:
         chaos_max_retries=np.int32(spec.chaos_max_retries),
         learn_discount=f32(spec.learn_discount),
         learn_reward_scale=f32(spec.learn_reward_scale),
+        hier_threshold=f32(spec.hier_threshold),
+        hier_max_hops=np.int32(spec.hier_max_hops),
+        hier_rtt=_hier_rtt_of(spec),
         energy_idle_dt=f32(spec.idle_power_w * spec.dt),
         energy_tx_j=f32(spec.tx_energy_j),
         energy_rx_j=f32(spec.rx_energy_j),
@@ -263,11 +283,36 @@ def dyn_of(spec: WorldSpec) -> DynSpec:
     )
 
 
+def _hier_rtt_of(spec: WorldSpec) -> np.ndarray:
+    """The derived (B, B) f32 inter-broker RTT matrix leaf.
+
+    B is static (``spec.n_brokers``), so two worlds in one shape bucket
+    always build same-shaped leaves; single-broker worlds carry an
+    inert (1, 1) zero.
+    """
+    B = max(spec.n_brokers, 1)
+    if spec.hier_rtt_matrix is not None:
+        return np.asarray(spec.hier_rtt_matrix, np.float32)
+    rtt = np.full((B, B), np.float32(spec.hier_rtt_s), np.float32)
+    np.fill_diagonal(rtt, np.float32(0.0))
+    return rtt
+
+
 def _canonical_value(spec: WorldSpec, field: str):
     v = getattr(spec, field)
     if field == "send_stop_time":
         # gate: finite vs inf selects the stop-gated spawn trace
         return v if v == float("inf") else _CANONICAL[field]
+    if field == "hier_rtt_matrix":
+        # shape-dependent canonical: None (the uniform derivation) and
+        # explicit matrices keep separate representatives, both
+        # canonicalised within their class so knob VALUES never split
+        # the bucket; n_brokers itself is static, so the leaf shape is
+        # fixed either way
+        if v is None:
+            return None
+        B = spec.n_brokers
+        return ((0.0234375,) * B,) * B
     if field in _GATED_POSITIVE and not (v > 0):
         return 0.0
     return _CANONICAL[field]

@@ -1,0 +1,10 @@
+"""Federated multi-broker hierarchy with broker↔broker task migration."""
+from .federation import (  # noqa: F401
+    HierState,
+    default_ownership,
+    hier_counters,
+    hier_reject_reason,
+    hier_summary,
+    init_hier_state,
+    stamp_ownership,
+)

@@ -1,0 +1,238 @@
+"""Federated multi-broker hierarchy: state, ownership stamping, readers.
+
+The reference models ONE central base broker every end device publishes
+to (SURVEY.md §5 "no broker failover logic exists"); internet-scale
+deployments federate brokers instead — FogMQ (arXiv:1610.00620) argues
+brokers must be distributed and migrate subscriber state, and iFogSim
+(arXiv:1606.02007) structures placement across tiers with inter-tier
+forwarding cost.  This module is the batched engine's rendition:
+
+* **Domains**: ``spec.n_brokers = B`` partitions users and fogs into B
+  broker domains via assembler-stamped ownership vectors
+  (:class:`HierState.user_broker` / ``fog_broker``, default
+  block-contiguous — :func:`default_ownership`; scenario builders and
+  tests restamp with :func:`stamp_ownership`).  Each logical broker
+  runs the established decide phase over its LOCAL fog set with its
+  own stale view slice (the (F,)-wide BrokerView columns partition
+  naturally, since domains partition fogs).
+* **Migration**: the contract-registered engine phase
+  ``core/engine._phase_broker_migrate`` moves matured publishes between
+  brokers when the owning domain is saturated or dead
+  (:class:`~fognetsimpp_tpu.spec.HierPolicy`), re-offering them through
+  the established K-window arrival contract with the inter-broker hop's
+  RTT added to ``t_at_broker`` and a bounded per-task hop budget
+  (``spec.hier_max_hops``; exhausted tasks become
+  ``Stage.HOP_EXHAUSTED`` and join the conservation identity).
+* **Staleness**: broker b's view of peer p's load refreshes only every
+  ``rtt[b, p]`` seconds (:class:`HierState.peer_load` / ``peer_t``) —
+  federation sees stale data exactly like fogs do through in-flight
+  advertisements.
+
+Everything rides :class:`HierState` in the scan carry with the
+inert-LearnState gate discipline: every array leaf is zero-row when
+``n_brokers == 1``, and no hierarchy code is traced at all, so the
+single-broker world is bit-exact vs the pre-hier engine
+(tests/test_hier.py A/Bs it across run/run_jit/run_chunked).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..spec import HierPolicy, WorldSpec
+
+
+@struct.dataclass
+class HierState:
+    """Carry-resident federation state (one per world / replica).
+
+    Ownership / per-task leaves are sized ``spec.hier_users`` /
+    ``spec.hier_fogs`` / ``spec.hier_tasks`` and the per-broker leaves
+    ``spec.hier_brokers`` — the real dimensions when ``n_brokers > 1``,
+    zero rows otherwise.  The scalar counters are always present and
+    stay exactly zero on single-broker worlds.
+    """
+
+    user_broker: jax.Array  # (Uh,) i32 broker owning each user's uplink
+    fog_broker: jax.Array  # (Fh,) i32 broker owning each fog node
+    task_broker: jax.Array  # (Th,) i32 broker currently holding each
+    #   task: stamped user_broker[user] at init, restamped by the
+    #   migrate phase on every broker→broker hop
+    hops: jax.Array  # (Th,) i8 migration hop count per task
+    peer_load: jax.Array  # (Bh, Bh) f32 — entry (b, p): broker b's AGED
+    #   view of peer p's busy fraction (+inf = dead domain); refreshed
+    #   only when the rtt[b, p] exchange period elapses
+    peer_t: jax.Array  # (Bh, Bh) f32 next view-refresh time per pair
+    mig_out: jax.Array  # (Bh,) i32 tasks migrated AWAY from each broker
+    mig_in: jax.Array  # (Bh,) i32 tasks migrated INTO each broker
+    n_migrated: jax.Array  # () i32 total broker→broker migrations
+    n_hop_exhausted: jax.Array  # () i32 tasks terminal after the hop
+    #   budget ran out in a dead domain (conservation bucket)
+
+
+def default_ownership(spec: WorldSpec):
+    """Block-contiguous default domains: user u → broker ``u*B // U``,
+    fog f → broker ``f*B // F``.  Host numpy (stamped at init, before
+    any tracing); every broker owns at least one fog because
+    ``validate()`` requires ``n_brokers <= n_fogs``."""
+    B = spec.n_brokers
+    ub = (np.arange(spec.n_users, dtype=np.int64) * B) // max(spec.n_users, 1)
+    fb = (np.arange(spec.n_fogs, dtype=np.int64) * B) // max(spec.n_fogs, 1)
+    return ub.astype(np.int32), fb.astype(np.int32)
+
+
+def _task_broker_of(spec: WorldSpec, user_broker) -> jnp.ndarray:
+    """Per-task owning broker from the static slot layout u*S + k."""
+    return jnp.repeat(
+        jnp.asarray(user_broker, jnp.int32), spec.max_sends_per_user
+    )
+
+
+def init_hier_state(spec: WorldSpec) -> HierState:
+    """The t=0 federation state for ``spec`` (inert zero-row when
+    ``n_brokers == 1``)."""
+    B = spec.hier_brokers
+    f32, i32 = jnp.float32, jnp.int32
+    if spec.hier_active:
+        ub, fb = default_ownership(spec)
+        user_broker = jnp.asarray(ub)
+        fog_broker = jnp.asarray(fb)
+        task_broker = _task_broker_of(spec, ub)
+    else:
+        user_broker = jnp.zeros((0,), i32)
+        fog_broker = jnp.zeros((0,), i32)
+        task_broker = jnp.zeros((0,), i32)
+    return HierState(
+        user_broker=user_broker,
+        fog_broker=fog_broker,
+        task_broker=task_broker,
+        hops=jnp.zeros((spec.hier_tasks,), jnp.int8),
+        # peer_t starts at 0: the first tick refreshes every pair from
+        # the live loads, after which each entry ages by its RTT
+        peer_load=jnp.zeros((B, B), f32),
+        peer_t=jnp.zeros((B, B), f32),
+        mig_out=jnp.zeros((B,), i32),
+        mig_in=jnp.zeros((B,), i32),
+        n_migrated=jnp.zeros((), i32),
+        n_hop_exhausted=jnp.zeros((), i32),
+    )
+
+
+def stamp_ownership(
+    spec: WorldSpec,
+    state,
+    user_broker: Optional[Sequence[int]] = None,
+    fog_broker: Optional[Sequence[int]] = None,
+):
+    """Assembler hook: restamp the domain ownership vectors of a built
+    world (and rebuild the per-task broker column from the new user
+    ownership).  ``None`` keeps the current stamping for that axis.
+    Must run BEFORE the first tick — the engine never re-derives
+    ``task_broker`` from ``user_broker``."""
+    if not spec.hier_active:
+        raise ValueError(
+            "stamp_ownership needs a federated world (n_brokers > 1)"
+        )
+    h = state.hier
+    B = spec.n_brokers
+    if user_broker is not None:
+        ub = np.asarray(user_broker, np.int32)
+        if ub.shape != (spec.n_users,) or ub.min(initial=0) < 0 or (
+            ub.max(initial=0) >= B
+        ):
+            raise ValueError(
+                f"user_broker must be ({spec.n_users},) ints in [0, {B})"
+            )
+        h = h.replace(
+            user_broker=jnp.asarray(ub),
+            task_broker=_task_broker_of(spec, ub),
+        )
+    if fog_broker is not None:
+        fb = np.asarray(fog_broker, np.int32)
+        if fb.shape != (spec.n_fogs,) or fb.min(initial=0) < 0 or (
+            fb.max(initial=0) >= B
+        ):
+            raise ValueError(
+                f"fog_broker must be ({spec.n_fogs},) ints in [0, {B})"
+            )
+        h = h.replace(fog_broker=jnp.asarray(fb))
+    return state.replace(hier=h)
+
+
+def hier_reject_reason(spec: WorldSpec, runner: str) -> Optional[str]:
+    """Why a federated spec cannot run on a sharded runner (None = it
+    can — i.e. the hierarchy is off).  ONE message source for the
+    TP-tick gate (``core/engine.tp_reject_reason``) and the fleet
+    runner (``parallel/fleet._check_fleet_spec``), so the entries can
+    never drift apart."""
+    if not spec.hier_active:
+        return None
+    return (
+        f"the {runner} runner does not carry the multi-broker hierarchy "
+        "yet (per-domain decide masks and the migrate phase need "
+        f"cross-shard load summaries); run n_brokers={spec.n_brokers} "
+        "worlds on single-device run/run_jit/run_chunked"
+    )
+
+
+# ----------------------------------------------------------------------
+# host-side readers (post-run / per chunk; one fetch each)
+# ----------------------------------------------------------------------
+
+def hier_summary(spec: WorldSpec, final) -> Optional[dict]:
+    """Host roll-up of a finished federated run (None when the
+    hierarchy is off).  THE values every exposition publishes — the
+    recorder's ``.sca.json`` hier section, the ``fns_hier_*``
+    OpenMetrics families and the Perfetto broker lanes all read this
+    one dict (the ``busy_fractions`` single-source discipline)."""
+    if not spec.hier_active:
+        return None
+    h = final.hier
+    B = spec.n_brokers
+    fb = np.asarray(h.fog_broker, np.int64)
+    ub = np.asarray(h.user_broker, np.int64)
+    out = {
+        "n_brokers": B,
+        "policy": HierPolicy(spec.hier_policy).name.lower(),
+        "max_hops": int(spec.hier_max_hops),
+        "migrated": int(np.asarray(h.n_migrated)),
+        "hop_exhausted": int(np.asarray(h.n_hop_exhausted)),
+        # plain ints: every consumer JSON-serializes this dict verbatim
+        "mig_out": [int(x) for x in np.asarray(h.mig_out)],
+        "mig_in": [int(x) for x in np.asarray(h.mig_in)],
+        "fogs_per_broker": [int((fb == b).sum()) for b in range(B)],
+        "users_per_broker": [int((ub == b).sum()) for b in range(B)],
+    }
+    # per-broker mean load + strided per-tick lanes when the telemetry
+    # plane carried the hier accumulators (telemetry_hier_brokers > 0)
+    t = getattr(final, "telem", None)
+    if t is not None and t.hier_load_sum.shape[0] == B:
+        ticks = max(int(np.asarray(t.ticks)), 1)
+        res = np.asarray(t.res, np.float64)
+        rows = np.asarray(t.hier_load_res, np.float64)
+        Rm = rows.shape[0]
+        stride = max(1, -(-spec.n_ticks // Rm)) if Rm else 1
+        n_rows = min(Rm, -(-ticks // stride)) if Rm else 0
+        out["load_mean"] = [
+            float(x) / ticks for x in np.asarray(t.hier_load_sum)
+        ]
+        out["load_rows"] = rows[:n_rows]
+        out["load_rows_t"] = (
+            res[:n_rows, 0] if n_rows else np.zeros((0,))
+        )
+    return out
+
+
+def hier_counters(final) -> dict:
+    """Tiny per-chunk counter fetch for the live health plane: two
+    scalars, no per-broker or per-task leaves — safe at any serving
+    cadence."""
+    h = final.hier
+    return {
+        "migrated": int(np.asarray(h.n_migrated)),
+        "hop_exhausted": int(np.asarray(h.n_hop_exhausted)),
+    }

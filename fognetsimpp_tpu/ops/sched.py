@@ -148,6 +148,16 @@ def schedule_batch(
     #   (learn/bandits.py), required for the learned policies UCB/DUCB/
     #   EXP3; when supplied under DYNAMIC the traced switch also covers
     #   the bandit ids 8-10
+    fog_owner: Optional[jax.Array] = None,  # (F,) i32 broker owning each
+    #   fog (hier/): when given (with task_broker + n_brokers), every
+    #   policy's candidate set is masked to the task's OWN broker domain
+    #   — each logical broker decides over its local fog slice, with
+    #   per-domain brokers[0] anchors and per-domain bandit-score
+    #   totals.  None (the default) is the single-broker fast path,
+    #   byte-identical to the pre-hier kernels.
+    task_broker: Optional[jax.Array] = None,  # (T,) i32 owning broker
+    #   per decided task (HierState.task_broker gathered at the window)
+    n_brokers: int = 1,  # static broker count B
 ) -> Tuple[jax.Array, jax.Array]:
     """Pick a fog node for every masked task. Returns ((T,) i32 fog, rr').
 
@@ -168,10 +178,66 @@ def schedule_batch(
     # order while this anchored slot 0)
     first_reg = jnp.argmax(avail).astype(jnp.int32)  # 0 if none
 
-    divisor = view_mips[first_reg] if mips0_divisor else view_mips
-    est = _safe_div(mips_req[:, None], jnp.broadcast_to(divisor, (F,))[None, :])
+    # ---- federated hierarchy (hier/): per-domain candidate masking ----
+    # Each logical broker owns a disjoint fog slice; its brokers[0]
+    # anchor is the first registered fog OF ITS DOMAIN, and a task may
+    # only score fogs its owning broker sees.  Static gate: fog_owner
+    # is None on every single-broker world, so the pre-hier trace is
+    # untouched.
+    hier = fog_owner is not None
+    if hier:
+        B = n_brokers
+        owned = (
+            fog_owner[None, :]
+            == jnp.arange(B, dtype=jnp.int32)[:, None]
+        )  # (B, F)
+        tb = jnp.clip(task_broker, 0, B - 1)  # (T,)
+        avail_b = avail[None, :] & owned  # (B, F)
+        first_reg_b = jnp.argmax(avail_b, axis=1).astype(jnp.int32)
+        first_reg_t = first_reg_b[tb]  # (T,) per-domain brokers[0]
+        allowed = owned[tb]  # (T, F) domain membership per task row
+
+    if hier and mips0_divisor:
+        # per-domain brokers[0] divisor (the mips0 quirk, tiled per
+        # broker): every candidate of task i divides by the anchor of
+        # i's own domain
+        est = _safe_div(mips_req[:, None], view_mips[first_reg_t][:, None])
+    else:
+        divisor = view_mips[first_reg] if mips0_divisor else view_mips
+        est = _safe_div(
+            mips_req[:, None], jnp.broadcast_to(divisor, (F,))[None, :]
+        )
 
     if policy in (int(Policy.MAX_MIPS), int(Policy.LOCAL_FIRST)):
+        if hier:
+            # per-domain batch-global winner (the v1/v2 scan, tiled):
+            # winner_b over each domain's available slice, selected per
+            # task by its owning broker
+            idx = jnp.arange(F, dtype=jnp.int32)
+            if v1_max_scan:
+                anchor_mips = view_mips[first_reg_b]  # (B,)
+                cand_b = (
+                    avail_b
+                    & (idx[None, :] > first_reg_b[:, None])
+                    & (view_mips[None, :] > anchor_mips[:, None])
+                )
+                last_b = jnp.max(
+                    jnp.where(cand_b, idx[None, :], -1), axis=1
+                )
+                winner_b = jnp.where(
+                    last_b >= 0, last_b, first_reg_b
+                ).astype(jnp.int32)
+            else:
+                winner_b = jnp.argmax(
+                    jnp.where(avail_b, view_mips[None, :], -jnp.inf),
+                    axis=1,
+                ).astype(jnp.int32)
+            any_b = jnp.any(avail_b, axis=1)
+            winner_t = jnp.where(any_b[tb], winner_b[tb], -1)
+            return (
+                jnp.where(mask, winner_t, -1).astype(jnp.int32),
+                rr_cursor,
+            )
         # v1/v2 offload pick (BrokerBaseApp.cc:228-240): one winner for the
         # whole batch — the scan does not depend on the task.  With the
         # faithful bug (v1_max_scan) ``temp`` stays brokers[0]'s MIPS, so the
@@ -193,6 +259,20 @@ def schedule_batch(
         return jnp.where(mask, winner, -1).astype(jnp.int32), rr_cursor
 
     def from_scores(scores, avail_):
+        if hier:
+            # domain-masked rows: fogs outside the task's domain score
+            # _BIG, the all-big fallback anchors on the task's OWN
+            # domain's brokers[0], and "no available fog" is judged per
+            # domain
+            ok = avail_[None, :] & allowed
+            scores = jnp.where(ok, scores, _BIG)
+            scores = jnp.nan_to_num(scores, posinf=_BIG)
+            choice = jnp.argmin(scores, axis=1).astype(jnp.int32)
+            all_big = jnp.all(scores >= _BIG, axis=1)
+            choice = jnp.where(all_big, first_reg_t, choice)
+            any_t = jnp.any(avail_[None, :] & owned, axis=1)[tb]
+            choice = jnp.where(any_t, choice, -1)
+            return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
         scores = jnp.where(avail_[None, :], scores, _BIG)
         # all-inf rows (early publishes before any advertisement, with the
         # MIPS=0 registration): the C++ strict-< scan never updates, so the
@@ -211,6 +291,14 @@ def schedule_batch(
         return from_scores(view_busy[None, :] + est, avail)
 
     def b_round_robin():
+        if hier:
+            # validate() gates this combination; the kernel refuses too
+            # so a hand-built spec cannot silently share one cursor
+            # across domains
+            raise ValueError(
+                "ROUND_ROBIN does not federate (single shared cursor); "
+                "WorldSpec.validate() should have rejected this spec"
+            )
         # k-th masked task of this tick gets fog (rr + k) % F among avail;
         # k follows the event order a sequential broker would see (arrival
         # time, ties by task index) when order_t is supplied
@@ -250,11 +338,35 @@ def schedule_batch(
 
     def b_random():
         ok = avail & fog_alive
-        n_ok = jnp.sum(ok.astype(jnp.int32))
         if rand_u is None:
             u = task_uniform(key, jnp.arange(T, dtype=jnp.int32))
         else:
             u = rand_u
+        if hier:
+            # per-domain uniform pick: the task-id-keyed draw indexes
+            # into its OWN domain's available slice (same stream, per-
+            # domain slot tables)
+            ok_b = ok[None, :] & owned  # (B, F)
+
+            def per_domain(okb):
+                n = jnp.sum(okb.astype(jnp.int32))
+                rank = jnp.cumsum(okb.astype(jnp.int32)) - 1
+                fos = jnp.zeros((F,), jnp.int32).at[
+                    jnp.where(okb, rank, F)
+                ].set(jnp.arange(F, dtype=jnp.int32), mode="drop")
+                return n, fos
+
+            n_ok_b, fos_b = jax.vmap(per_domain)(ok_b)
+            n_ok_t = n_ok_b[tb]  # (T,)
+            slot = jnp.clip(
+                (u * n_ok_t.astype(jnp.float32)).astype(jnp.int32),
+                0,
+                jnp.maximum(n_ok_t - 1, 0),
+            )
+            choice = fos_b[tb, slot]
+            choice = jnp.where(n_ok_t > 0, choice, -1)
+            return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
+        n_ok = jnp.sum(ok.astype(jnp.int32))
         # slot = floor(u * n_ok) in f32 — the DES computes the identical
         # float expression so boundary rounding agrees bit-for-bit
         slot = jnp.clip(
@@ -281,23 +393,59 @@ def schedule_batch(
         win = jnp.where(jnp.any(avail_), win, -1)
         return jnp.where(mask, win, -1).astype(jnp.int32), rr_cursor
 
+    def _winner_per_domain(score_fn):
+        # per-broker bandit slice: each domain's index argmax runs over
+        # its OWN available arms with its OWN exploration total (the
+        # score_fn sees only the domain's availability mask), so B
+        # brokers learn B independent schedulers over one shared (F,)
+        # statistics table — the slices are disjoint because domains
+        # partition fogs
+        ok_b = (avail & fog_alive)[None, :] & owned  # (B, F)
+        scores_b = jax.vmap(lambda av: score_fn(learn, av))(ok_b)
+        win_b = jnp.argmax(
+            jnp.where(ok_b, scores_b, -_BIG), axis=1
+        ).astype(jnp.int32)
+        any_b = jnp.any(ok_b, axis=1)
+        win_t = jnp.where(any_b[tb], win_b[tb], -1)
+        return jnp.where(mask, win_t, -1).astype(jnp.int32), rr_cursor
+
     def b_ucb():
+        if hier:
+            return _winner_per_domain(ucb_scores)
         return _winner_from_index(
             ucb_scores(learn, avail & fog_alive), avail & fog_alive
         )
 
     def b_ducb():
+        if hier:
+            return _winner_per_domain(ducb_scores)
         return _winner_from_index(
             ducb_scores(learn, avail & fog_alive), avail & fog_alive
         )
 
     def b_exp3():
         ok = avail & fog_alive
-        p = exp3_probs(learn.logw, ok, learn.explore)
         if rand_u is None:
             u = task_uniform(key, jnp.arange(T, dtype=jnp.int32))
         else:
             u = rand_u
+        if hier:
+            # per-domain softmax: broker b's distribution lives on its
+            # own arms only; each task inverse-CDF samples from its
+            # domain's row with the shared task-id-keyed stream
+            ok_b = ok[None, :] & owned  # (B, F)
+            p_b = jax.vmap(
+                lambda av: exp3_probs(learn.logw, av, learn.explore)
+            )(ok_b)  # (B, F)
+            cdf_b = jnp.cumsum(p_b, axis=1)
+            total_t = cdf_b[tb, F - 1]  # (T,)
+            target = jnp.clip(u, 1e-7, 1.0) * total_t
+            arm = jnp.argmax(
+                cdf_b[tb] >= target[:, None], axis=1
+            ).astype(jnp.int32)
+            choice = jnp.where(total_t > 0, arm, -1)
+            return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
+        p = exp3_probs(learn.logw, ok, learn.explore)
         choice = exp3_sample(p, u)
         return jnp.where(mask, choice, -1).astype(jnp.int32), rr_cursor
 
@@ -312,6 +460,11 @@ def schedule_batch(
         int(Policy.EXP3): b_exp3,
     }
     if policy == int(Policy.DYNAMIC):
+        if hier:
+            raise ValueError(
+                "Policy.DYNAMIC does not federate (n_brokers > 1); "
+                "WorldSpec.validate() should have rejected this spec"
+            )
         if policy_id is None:
             raise ValueError("Policy.DYNAMIC needs a traced policy_id")
 

@@ -73,12 +73,15 @@ def fold_replica_keys(key: jax.Array, n_replicas: int) -> jax.Array:
 
 
 def _check_fleet_spec(spec: WorldSpec) -> None:
-    if spec.chaos:
-        raise ValueError(
-            "the fleet runner does not carry the chaos fault-injection "
-            "subsystem yet (replicas would share one fault schedule); "
-            "run chaos worlds on single-world run/run_jit/run_chunked"
-        )
+    # chaos worlds run here since the per-replica chaos re-key landed in
+    # replicas.replicate_state (fold_in(chaos_key, replica): every
+    # replica draws its own fault schedule, so the old share-one-
+    # schedule rejection is gone); the federated hierarchy still gates
+    from ..hier.federation import hier_reject_reason
+
+    reason = hier_reject_reason(spec, "fleet")
+    if reason is not None:
+        raise ValueError(reason)
 
 
 def _check_divisible(n_replicas: int, mesh: Mesh) -> None:

@@ -30,6 +30,21 @@ from ..spec import WorldSpec
 from ..state import WorldState
 
 
+def fold_replica_chaos_keys(chaos_key: jax.Array, n_replicas: int) -> jax.Array:
+    """(R, 2) per-replica chaos keys: ``fold_in(chaos_key, r)``.
+
+    The chaos analog of :func:`fleet.fold_replica_keys` — and literally
+    that function applied to the chaos key, so the one replica-fold
+    discipline can never drift between world keys and chaos keys: each
+    replica's fault schedule is keyed on its own stable id,
+    decorrelated from the template's single schedule, and reproducible
+    on host via ``chaos/faults.outage_timeline`` with the folded key.
+    """
+    from .fleet import fold_replica_keys
+
+    return fold_replica_keys(chaos_key, n_replicas)
+
+
 def replicate_state(
     spec: WorldSpec,
     state: WorldState,
@@ -50,6 +65,27 @@ def replicate_state(
         lambda x: jnp.broadcast_to(x, (R,) + jnp.shape(x)), state
     )
     batch = batch.replace(key=keys)
+    if spec.chaos:
+        # per-replica fault schedules (the ROADMAP fleet-chaos
+        # follow-up): replica r's chaos stream is fold_in(chaos_key, r)
+        # — folded from the TEMPLATE's chaos key on the replica's own
+        # stable id, the fold_replica_keys discipline, so replica r
+        # draws the same outage trajectory whether the fleet runs 8 or
+        # 800 replicas around it.  refold_chaos_state re-derives the
+        # key-dependent init draws (first crash gaps, RTT phases) so
+        # the whole schedule is a pure function of the folded key —
+        # host replay via outage_timeline(spec, fold_in(ck, r)) stays
+        # exact.  Both the vmap (run_replicated) and the sharded fleet
+        # path read these rows, which is what makes the fleet-vs-vmap
+        # state-hash A/B hold under chaos (tests/test_fleet.py).
+        from ..chaos.faults import refold_chaos_state
+
+        ck_r = fold_replica_chaos_keys(state.chaos.key, R)
+        batch = batch.replace(
+            chaos=jax.vmap(
+                lambda k: refold_chaos_state(spec, state.chaos, k)
+            )(ck_r)
+        )
     if resample_starts and spec.start_time_max > spec.start_time_min:
         sub = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
         starts = jax.vmap(

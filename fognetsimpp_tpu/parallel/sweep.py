@@ -293,18 +293,24 @@ def sweep_dyn(
     if spec0.chaos:
         # the t=0 chaos schedule (first crash gap) is an init-time
         # derivation of the cell's MTBF: re-derive per cell so each
-        # row starts exactly where a direct run of its spec would
-        from ..chaos.faults import init_chaos_state
+        # row starts exactly where a direct run of its spec would —
+        # including the per-REPLICA fold_in(chaos_key, r) re-key
+        # replicate_state applies, so each (cell, replica) row equals
+        # the direct replicate_state(spec_cell, ...) fan-out
+        from ..chaos.faults import init_chaos_state, refold_chaos_state
+        from .replicas import fold_replica_chaos_keys
 
-        # keyed on the BUILDER's world key (state.key at t=0): each
-        # row's schedule is exactly what a direct run of its spec on
-        # this world would draw
+        ch_cells = []
+        for sp in cells:
+            # keyed on the BUILDER's world key (state.key at t=0):
+            # exactly what a direct build of this cell's spec draws
+            ch0 = init_chaos_state(sp, state.key)
+            ck_r = fold_replica_chaos_keys(ch0.key, nrc)
+            ch_cells.append(jax.vmap(
+                lambda k, _sp=sp, _c=ch0: refold_chaos_state(_sp, _c, k)
+            )(ck_r))
         ch_rows = jax.tree.map(
-            lambda *xs: jnp.stack(xs),
-            *(init_chaos_state(sp, state.key) for sp in cells),
-        )
-        ch_rows = jax.tree.map(
-            lambda x: jnp.repeat(x, nrc, axis=0), ch_rows
+            lambda *xs: jnp.concatenate(xs, axis=0), *ch_cells
         )
         batch = batch.replace(chaos=ch_rows)
     dyn_rows = jax.tree.map(

@@ -1122,7 +1122,8 @@ def _tp_program(
             t=rep["t"], tick=rep["tick"], key=rep["key"],
             nodes=nodes_l, users=users, fogs=rep["fogs"],
             broker=rep["broker"], tasks=tasks, metrics=rep["metrics"],
-            learn=rep["learn"], chaos=rep["chaos"], telem=telem_l,
+            learn=rep["learn"], chaos=rep["chaos"], hier=rep["hier"],
+            telem=telem_l,
         )
 
         def tick(st, _):
@@ -1142,7 +1143,7 @@ def _tp_program(
             "t": final.t, "tick": final.tick, "key": final.key,
             "fogs": final.fogs, "broker": final.broker,
             "metrics": final.metrics, "learn": final.learn,
-            "chaos": final.chaos,
+            "chaos": final.chaos, "hier": final.hier,
             "telem": telem_out,
             "nodes_rest": jax.tree.map(lambda x: x[U_loc:], final.nodes),
         }
@@ -1255,7 +1256,7 @@ def run_tp_sharded(
         t=rep["t"], tick=rep["tick"], key=rep["key"], nodes=nodes,
         users=users, fogs=rep["fogs"], broker=rep["broker"], tasks=tasks,
         metrics=rep["metrics"], learn=rep["learn"], chaos=rep["chaos"],
-        telem=telem,
+        hier=rep["hier"], telem=telem,
     )
     return spec, final
 
@@ -1389,8 +1390,9 @@ def _tp_setup(
             "fogs": state.fogs, "broker": state.broker,
             "metrics": state.metrics, "learn": state.learn,
             # inert by construction: tp_reject_reason gates chaos-on
-            # specs off the TP tick, so every chaos leaf is zero-row
-            "chaos": state.chaos,
+            # and multi-broker specs off the TP tick, so every chaos
+            # and hier leaf is zero-row
+            "chaos": state.chaos, "hier": state.hier,
             "telem": telem_rep, "nodes_rest": nodes_rest,
         }
     )

@@ -63,6 +63,11 @@ def dict_to_spec(d: Dict) -> WorldSpec:
             (int(f), float(td), float(tu))
             for f, td, tu in d["chaos_script"]
         )
+    if d.get("hier_rtt_matrix") is not None:
+        # same listification hazard for the inter-broker RTT matrix
+        d["hier_rtt_matrix"] = tuple(
+            tuple(float(x) for x in row) for row in d["hier_rtt_matrix"]
+        )
     return WorldSpec(**d).validate()
 
 
@@ -279,6 +284,18 @@ def record_run(
         chaos_sca = chaos_summary(spec, final)
     else:
         chaos_sca = None
+    if spec.hier_active:
+        from ..hier.federation import hier_summary
+
+        hier_sca = hier_summary(spec, final)
+        # the strided load lanes are Perfetto/live material, not .sca
+        # scalars — drop the arrays, keep the per-broker means
+        hier_sca = {
+            k: v for k, v in hier_sca.items()
+            if k not in ("load_rows", "load_rows_t")
+        }
+    else:
+        hier_sca = None
     sca = {
         "run": run_id,
         "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -294,6 +311,10 @@ def record_run(
         # same chaos_summary() dict the fns_chaos_* exposition and the
         # flight-recorder manifests read, so the outputs cannot drift
         **({"chaos": chaos_sca} if chaos_sca is not None else {}),
+        # federated-hierarchy section (spec.n_brokers > 1, hier/): the
+        # same hier_summary() dict the fns_hier_* exposition and the
+        # Perfetto broker lanes read, so the outputs cannot drift
+        **({"hier": hier_sca} if hier_sca is not None else {}),
         # global latency-histogram roll-up (spec.telemetry_hist): the
         # quantiles are hist_summary()'s — identical to the OpenMetrics
         # quantile gauges by construction

@@ -103,6 +103,14 @@ def summarize(final: WorldState) -> Dict[str, float]:
         out["chaos_lost_crash"] = int(ch.n_lost_crash)
         out["chaos_reoffloaded"] = int(ch.n_reoffloaded)
         out["chaos_retry_exhausted"] = int(ch.n_retry_exhausted)
+    # federated-hierarchy roll-up (hier/): the ownership leaves double
+    # as the is-active flag (zero-row when n_brokers == 1); the hier_*
+    # keys become fns_hier_* scalar OpenMetrics families via
+    # render_openmetrics' summarize() pass
+    if np.asarray(final.hier.fog_broker).size:
+        h = final.hier
+        out["hier_migrated"] = int(h.n_migrated)
+        out["hier_hop_exhausted"] = int(h.n_hop_exhausted)
     if np.asarray(final.learn.pick_p).size:
         lat_cnt = float(final.learn.lat_cnt)
         out["learn_credited"] = int(lat_cnt)

@@ -84,6 +84,11 @@ class Stage(enum.IntEnum):
     LOST = 10  # publish lost on the wireless uplink (MAC retry exhaustion:
     #            the reference's demo run records only 52 of 67 sent —
     #            simulations/example/results/General-0.sca sentPk vs n)
+    HOP_EXHAUSTED = 11  # federated hierarchy (hier/): the task's broker
+    #            domain is dead and its broker→broker migration hop
+    #            budget (spec.hier_max_hops) ran out — terminal, counted
+    #            in HierState.n_hop_exhausted (no reference analog: the
+    #            reference has exactly one broker and no failover)
 
 
 class Policy(enum.IntEnum):
@@ -186,6 +191,52 @@ class ChaosMode(enum.IntEnum):
 
     LOSE = 0
     REOFFLOAD = 1
+
+
+class HierPolicy(enum.IntEnum):
+    """Broker↔broker task-migration policy of the federated hierarchy
+    (``fognetsimpp_tpu.hier``).
+
+    NEVER: domains are isolated — a saturated or dead domain keeps (or
+    loses) its own tasks, the FogNetSim++ single-broker behaviour tiled
+    B times.  THRESHOLD: a broker whose local busy fraction exceeds
+    ``spec.hier_threshold`` (or whose domain has no usable fog at all)
+    forwards its matured publishes to the least-loaded peer by its AGED
+    view of peer load summaries.  LEAST_LOADED: a broker forwards
+    whenever any peer looks strictly less loaded than itself (dead
+    domains always forward).  Peer views age by the inter-broker RTT —
+    federation sees stale data exactly like the broker→fog view does
+    (FogMQ arXiv:1610.00620 brokers-at-internet-scale).
+    """
+
+    NEVER = 0
+    THRESHOLD = 1
+    LEAST_LOADED = 2
+
+
+def hier_policy_from_name(name) -> HierPolicy:
+    """Resolve a hierarchy migration policy from its id or name.
+
+    The ``--hier-policy`` CLI flag goes through here so an unknown name
+    becomes one actionable ``ValueError`` listing the valid names.
+    """
+    if isinstance(name, (int, HierPolicy)):
+        try:
+            return HierPolicy(int(name))
+        except ValueError:
+            pass
+    else:
+        s = str(name).strip()
+        try:
+            return HierPolicy(int(s))
+        except ValueError:
+            pass
+        try:
+            return HierPolicy[s.upper()]
+        except KeyError:
+            pass
+    known = ", ".join(f"{p.name.lower()}={int(p)}" for p in HierPolicy)
+    raise ValueError(f"unknown hier policy {name!r} (have {known})")
 
 
 class Mobility(enum.IntEnum):
@@ -515,6 +566,46 @@ class WorldSpec:
     chaos_rtt_burst_prob: float = 0.0
     chaos_rtt_burst_mult: float = 5.0
 
+    # --- federated multi-broker hierarchy (fognetsimpp_tpu.hier) --------
+    # Broker count B: 1 (the default) is the reference's single base
+    # broker and traces NONE of the hierarchy machinery (bit-exact vs
+    # the pre-hier engine — tests/test_hier.py A/Bs it).  B > 1
+    # partitions users and fogs into B broker domains via the
+    # assembler-stamped ownership vectors (HierState.user_broker /
+    # fog_broker, default block-contiguous): each logical broker runs
+    # the established decide phase over its LOCAL fog set with its own
+    # stale view slice, and the contract-registered
+    # ``_phase_broker_migrate`` moves matured publishes between brokers
+    # when a domain is saturated or dead.  All B logical brokers share
+    # the one physical broker node's link delays; the inter-broker hop
+    # cost is the ``hier_rtt_*`` matrix below.
+    n_brokers: int = 1
+    # HierPolicy: NEVER / THRESHOLD (on local busy fraction) /
+    # LEAST_LOADED (over aged peer load summaries).  Static: selects
+    # whether the migrate phase is traced at all.
+    hier_policy: int = 0  # int(HierPolicy.NEVER)
+    # THRESHOLD trigger: migrate when the local busy fraction (busy
+    # usable fogs / usable fogs of the domain) exceeds this.  inf = the
+    # phase traces but can only fire on dead domains.  Rides the
+    # DynSpec operand: retunable with zero recompiles.
+    hier_threshold: float = 0.75
+    # Migration hop budget per task: a task that still cannot be served
+    # after this many broker→broker hops (its domain dead, or nowhere
+    # left to go) becomes Stage.HOP_EXHAUSTED and is counted in
+    # HierState.n_hop_exhausted — the conservation invariant's new
+    # terminal bucket.  Rides the DynSpec operand (int, like
+    # chaos_max_retries).
+    hier_max_hops: int = 2
+    # Uniform inter-broker RTT (seconds) used when no explicit matrix
+    # is given: a migrated task's t_at_broker advances by the src→dst
+    # entry, re-offering it through the established K-window arrival
+    # contract at the new broker.  Rides the DynSpec operand.
+    hier_rtt_s: float = 0.005
+    # Explicit B×B inter-broker RTT matrix (tuple-of-tuples, hashable);
+    # None derives the uniform matrix (hier_rtt_s off-diagonal, zero
+    # diagonal).  Rides the DynSpec operand as a (B, B) f32 leaf.
+    hier_rtt_matrix: Optional[Tuple[Tuple[float, ...], ...]] = None
+
     # --- telemetry (fognetsimpp_tpu.telemetry) --------------------------
     # Plane-1 observability gate: carry a TelemetryState pytree in the
     # scan (per-fog queue-depth min/max/sum, busy fractions, pool
@@ -654,6 +745,48 @@ class WorldSpec:
         """Rows of the per-task re-offload retry column (0 when chaos
         is off, so inert worlds pay no task-table-sized memory)."""
         return self.task_capacity if self.chaos else 0
+
+    # --- hierarchy sizing (zero-row when the subsystem is off) ---------
+    @property
+    def hier_active(self) -> bool:
+        """Whether the federated multi-broker hierarchy is live.
+
+        Static under jit: ``n_brokers == 1`` traces none of the
+        hierarchy machinery (domain masks, migrate phase, HierState
+        updates), which is the bit-exactness argument of the single-
+        broker gate (tests/test_hier.py)."""
+        return self.n_brokers > 1
+
+    @property
+    def hier_brokers(self) -> int:
+        """Rows of the per-broker hierarchy leaves (peer views,
+        migration counters)."""
+        return self.n_brokers if self.hier_active else 0
+
+    @property
+    def hier_users(self) -> int:
+        """Rows of the user-ownership vector."""
+        return self.n_users if self.hier_active else 0
+
+    @property
+    def hier_fogs(self) -> int:
+        """Rows of the fog-ownership vector."""
+        return self.n_fogs if self.hier_active else 0
+
+    @property
+    def hier_tasks(self) -> int:
+        """Rows of the per-task broker/hop columns (0 when the
+        hierarchy is off, so single-broker worlds pay no
+        task-table-sized memory)."""
+        return self.task_capacity if self.hier_active else 0
+
+    @property
+    def telemetry_hier_brokers(self) -> int:
+        """Rows of the per-broker telemetry load accumulators: the
+        broker count when BOTH the telemetry plane and the hierarchy
+        are on, zero otherwise — the zero-row inert discipline of every
+        other telemetry dimension."""
+        return self.n_brokers if (self.telemetry and self.hier_active) else 0
 
     # --- telemetry sizing (zero-row when the plane is off) -------------
     @property
@@ -834,6 +967,77 @@ class WorldSpec:
                 raise ValueError(
                     "chaos_rtt_burst_mult must be > 0 when bursts are on"
                 )
+        # --- federated hierarchy (ValueError: user-reachable knobs) ----
+        if self.n_brokers < 1:
+            raise ValueError(
+                f"n_brokers must be >= 1 (got {self.n_brokers}); 1 is "
+                "the single base broker, B > 1 federates"
+            )
+        if self.n_brokers == 1 and self.hier_rtt_matrix is not None:
+            # the DynSpec hier_rtt leaf is (1, 1) on single-broker
+            # worlds by contract (dynspec._hier_rtt_of); an orphan
+            # matrix would silently change the operand's shape inside
+            # one shape bucket
+            raise ValueError(
+                "hier_rtt_matrix needs a federated world: set "
+                "n_brokers > 1 (or drop the matrix)"
+            )
+        if self.n_brokers > 1:
+            if self.n_brokers > self.n_fogs:
+                raise ValueError(
+                    f"n_brokers={self.n_brokers} exceeds n_fogs="
+                    f"{self.n_fogs}: every broker domain needs at least "
+                    "one fog node — reduce the broker count or add fogs"
+                )
+            if self.hier_policy not in tuple(int(p) for p in HierPolicy):
+                raise ValueError(
+                    f"unknown hier_policy {self.hier_policy} (have "
+                    + ", ".join(
+                        f"{p.name.lower()}={int(p)}" for p in HierPolicy
+                    )
+                    + ")"
+                )
+            if self.policy in (
+                int(Policy.ROUND_ROBIN),
+                int(Policy.LOCAL_FIRST),
+                int(Policy.DYNAMIC),
+            ):
+                raise ValueError(
+                    f"policy {Policy(self.policy).name.lower()} does not "
+                    "federate (n_brokers > 1): round_robin needs a "
+                    "per-domain cursor, local_first/dynamic are single-"
+                    "broker constructs — use the argmin family "
+                    "(min_busy/min_latency/energy_aware/random/max_mips) "
+                    "or a learned policy (ucb/ducb/exp3)"
+                )
+            if not (0 <= self.hier_max_hops < 127):
+                raise ValueError(
+                    "hier_max_hops must be in [0, 127) (the per-task "
+                    "hop column is int8)"
+                )
+            if not (self.hier_threshold >= 0.0):
+                raise ValueError(
+                    "hier_threshold is a busy fraction (>= 0; inf "
+                    "disables the saturation trigger)"
+                )
+            if self.hier_rtt_s < 0:
+                raise ValueError("hier_rtt_s must be >= 0 seconds")
+            if self.hier_rtt_matrix is not None:
+                B = self.n_brokers
+                if len(self.hier_rtt_matrix) != B or any(
+                    len(row) != B for row in self.hier_rtt_matrix
+                ):
+                    raise ValueError(
+                        f"hier_rtt_matrix must be {B}x{B} for "
+                        f"n_brokers={B}"
+                    )
+                if any(
+                    float(x) < 0 for row in self.hier_rtt_matrix
+                    for x in row
+                ):
+                    raise ValueError(
+                        "hier_rtt_matrix entries are RTTs (>= 0 s)"
+                    )
         if self.assume_static:
             assert not self.energy_enabled, (
                 "assume_static promises constant (pos, alive); the energy "

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from .chaos.faults import ChaosState, init_chaos_state
+from .hier.federation import HierState, init_hier_state
 from .learn.bandits import LearnState, init_learn_state
 from .spec import NodeKind, Policy, Stage, WorldSpec
 from .telemetry.metrics import TelemetryState, init_telemetry_state
@@ -228,6 +229,8 @@ class WorldState:
     #   inert zero-row provenance when spec.learn_active is False
     chaos: ChaosState  # fault-injection schedules/counters
     #   (chaos/faults.py); zero-row when spec.chaos is off
+    hier: HierState  # federated multi-broker ownership/migration state
+    #   (hier/federation.py); zero-row when spec.n_brokers == 1
     telem: TelemetryState  # device-resident observability accumulators
     #   (telemetry/metrics.py); zero-row when spec.telemetry is off
 
@@ -383,5 +386,6 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         # the chaos stream is FOLDED from the world key (never split):
         # enabling it perturbs no draw of the main simulation stream
         chaos=init_chaos_state(spec, key),
+        hier=init_hier_state(spec),
         telem=init_telemetry_state(spec),
     )

@@ -566,6 +566,12 @@ def serve_run(
             from ..chaos.faults import chaos_counters
 
             extra["chaos"] = chaos_counters(s)
+        if spec.hier_active:
+            # federation counters ride every chunk entry (two scalars):
+            # a post-mortem sees WHEN migration spiked or hops exhausted
+            from ..hier.federation import hier_counters
+
+            extra["hier"] = hier_counters(s)
         recorder.note_chunk(
             ticks_done, rows=rows, state_hash=h, extra=extra or None,
         )

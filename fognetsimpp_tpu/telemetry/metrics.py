@@ -66,6 +66,10 @@ PHASES = (
     # actually executes FIRST in the tick (display order here is not
     # execution order for the post-TP entries).
     "chaos",  # fog lifecycle edges + in-flight sweep + re-offloads
+    # --- federated hierarchy (hier/): appended after the chaos slot so
+    # every established PHASE_INDEX stays stable; executes right after
+    # chaos, before any decide phase.
+    "broker_migrate",  # broker↔broker task migration + peer-view aging
 )
 PHASE_INDEX: Dict[str, int] = {name: i for i, name in enumerate(PHASES)}
 
@@ -150,6 +154,14 @@ class TelemetryState:
     exg_occ_res: jax.Array  # (Rm, Sm) f32 strided per-tick per-shard
     #   occupancy rows (same stride as `res`): the Perfetto per-shard
     #   counter lanes and live dashboards read these
+    # --- federated hierarchy (spec.n_brokers > 1, hier/) --------------
+    # Per-broker domain load, accumulated by the end-of-tick telemetry
+    # fold.  Zero-row unless BOTH the telemetry plane and the hierarchy
+    # are on (spec.telemetry_hier_brokers > 0).
+    hier_load_sum: jax.Array  # (Bm,) f32 per-broker busy-fraction sum
+    #   over ticks (mean = / ticks; the fns_hier_load gauge)
+    hier_load_res: jax.Array  # (Rm, Bm) f32 strided per-tick per-broker
+    #   load rows (same stride as `res`): the Perfetto broker lanes
 
 
 def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
@@ -175,6 +187,19 @@ def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
         lat_sum=jnp.zeros((spec.telemetry_hist_fogs,), f32),
         lat_seen=jnp.zeros((spec.telemetry_hist_tasks,), jnp.int8),
         **init_exchange_leaves(spec),
+        **init_hier_leaves(spec),
+    )
+
+
+def init_hier_leaves(spec: WorldSpec) -> Dict[str, jax.Array]:
+    """The t=0 hierarchy telemetry leaves for ``spec`` (zero-row unless
+    the spec is a telemetry-on federated world)."""
+    Bm = spec.telemetry_hier_brokers
+    Rm = spec.telemetry_slots if Bm else 0
+    f32 = jnp.float32
+    return dict(
+        hier_load_sum=jnp.zeros((Bm,), f32),
+        hier_load_res=jnp.zeros((Rm, Bm), f32),
     )
 
 
@@ -235,6 +260,7 @@ def accumulate_tick(
     phase_work: Optional[Dict[int, jax.Array]] = None,
     chaos=None,
     fogs_down: Optional[jax.Array] = None,
+    hier_load: Optional[jax.Array] = None,
 ) -> TelemetryState:
     """Fold one finished tick into the telemetry accumulators.
 
@@ -270,6 +296,22 @@ def accumulate_tick(
     )
     if spec.learn_active:
         telem = telem.replace(pick_hist=learn.pick_count)
+    if hier_load is not None:
+        # federated hierarchy: per-broker busy-fraction sum + strided
+        # per-tick lanes (the broker analog of the exchange-plane rows)
+        telem = telem.replace(
+            hier_load_sum=telem.hier_load_sum + hier_load
+        )
+        Rh = telem.hier_load_res.shape[0]
+        if Rh > 0:
+            stride_h = max(1, -(-spec.n_ticks // Rh))
+            slot_h = (tick // stride_h).astype(i32)
+            write_h = (tick % stride_h) == 0
+            telem = telem.replace(
+                hier_load_res=telem.hier_load_res.at[
+                    jnp.where(write_h, slot_h, Rh)
+                ].set(hier_load, mode="drop")
+            )
     if phase_work:
         idxs = np.asarray(sorted(phase_work), np.int32)
         vals = jnp.stack(

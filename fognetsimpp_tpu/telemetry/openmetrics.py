@@ -271,6 +271,40 @@ def render_openmetrics(
                 lines, "chaos_fog_down_ticks", cs["down_ticks"][f],
                 labels=f'{{fog="{f}"}}',
             )
+    # federated-hierarchy per-broker families (hier/): the scalar
+    # counters already rendered as fns_hier_* via summarize(); here the
+    # per-broker gauges — same hier_summary() dict the recorder's
+    # .sca.json hier section reads, so the two cannot drift
+    if spec.hier_active:
+        from ..hier.federation import hier_summary
+
+        hs = hier_summary(spec, final)
+        for family, key, help_text in (
+            ("hier_migrations_out", "mig_out",
+             "tasks migrated away from each broker domain"),
+            ("hier_migrations_in", "mig_in",
+             "tasks migrated into each broker domain"),
+            ("hier_fogs", "fogs_per_broker",
+             "fog nodes owned by each broker domain"),
+            ("hier_users", "users_per_broker",
+             "users publishing to each broker domain"),
+        ):
+            _family(lines, family, help_text=help_text)
+            for b in range(hs["n_brokers"]):
+                _sample(
+                    lines, family, hs[key][b],
+                    labels=f'{{broker="{b}"}}',
+                )
+        if "load_mean" in hs:
+            _family(
+                lines, "hier_load_mean",
+                help_text="mean busy fraction of each broker domain",
+            )
+            for b in range(hs["n_brokers"]):
+                _sample(
+                    lines, "hier_load_mean", hs["load_mean"][b],
+                    labels=f'{{broker="{b}"}}',
+                )
     # streaming latency histogram (spec.telemetry_hist, ISSUE 6)
     if hist is None:
         from .health import hist_summary

@@ -49,6 +49,7 @@ _FAIL_STAGES = {
     int(Stage.DROPPED): "dropped",
     int(Stage.REJECTED): "rejected",
     int(Stage.NO_RESOURCE): "no_resource",
+    int(Stage.HOP_EXHAUSTED): "hop_exhausted",
 }
 
 
@@ -315,6 +316,41 @@ def _tp_exchange_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
     return events
 
 
+def _hier_broker_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
+    """Per-BROKER federation lanes (hier/).
+
+    One dedicated "hier-brokers" process whose threads are counter
+    tracks ``broker{b} load`` — the strided per-tick per-broker domain
+    load rows the telemetry fold keeps in
+    ``TelemetryState.hier_load_res``, timestamped from the matching
+    reservoir rows (the TP exchange-lane discipline).  Empty on
+    single-broker (or telemetry-off) runs, so every existing trace is
+    byte-identical.
+    """
+    from ..hier.federation import hier_summary
+
+    hs = hier_summary(spec, final)
+    if hs is None or "load_rows" not in hs or hs["load_rows"].size == 0:
+        return []
+    events: List[Dict] = []
+    ts = _us(hs["load_rows_t"])
+    for b in range(hs["n_brokers"]):
+        events.extend(
+            _counter(
+                f"broker{b} load", pid, ts[i], "load",
+                hs["load_rows"][i, b],
+            )
+            for i in range(len(ts))
+        )
+    events.append(
+        {
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": "hier-brokers"},
+        }
+    )
+    return events
+
+
 def build_trace(
     spec: WorldSpec, final: WorldState, max_tasks: Optional[int] = None
 ) -> Dict:
@@ -344,6 +380,8 @@ def build_trace(
         events.extend(_tp_exchange_events(spec, final, pid=n_rep))
         # fog crash/recover lifecycle spans on chaos runs (ISSUE 12)
         events.extend(_chaos_lifecycle_events(spec, final, pid=0))
+        # per-broker federation load lanes on hier runs
+        events.extend(_hier_broker_events(spec, final, pid=n_rep + 1))
     # metadata first, then spans by (ts, -dur): a parent span sorts
     # before its children, and Perfetto/golden checks see monotone ts
     events.sort(

@@ -88,6 +88,12 @@ _QUICK_FILES = {
     # and the churn world where the bandits beat every static policy —
     # the hostile-world capability belongs in the edit loop like learn/
     "test_chaos.py",
+    # federated multi-broker hierarchy (ISSUE 14): the single-broker /
+    # inert-B>1 bit-exactness gates, the forced-migration conservation
+    # grid and the per-broker bandit-credit invariant — small worlds;
+    # the cross-entry A/Bs, acceptance-world comparisons and CLI smoke
+    # carry their own slow marks (the test_tp.py tier discipline)
+    "test_hier.py",
     # distributed observability (ISSUE 11): per-shard phase-work /
     # exchange-gauge / hist A/Bs vs the single-device profile, the
     # serve --tp defer-rate watchdog + postmortem shard bisection, and

@@ -225,13 +225,87 @@ def test_chaos_with_tp_is_clear_error(capsys):
     assert "Traceback" not in captured.err
 
 
-def test_chaos_with_replicas_is_clear_error(capsys):
+def test_chaos_with_replicas_now_runs(capsys):
+    """The fleet-chaos follow-up landed: --chaos composes with
+    --replicas (per-replica fold_in(chaos_key, r) schedules), so the
+    old one-line rejection is gone and the fleet reports normally."""
+    import json
+
     rc = main(["--scenario", "smoke", "--chaos", "light",
-               "--set", "scenario.horizon=0.1", "--replicas", "8"])
+               "--set", "scenario.horizon=0.04",
+               "--set", "scenario.send_interval=0.01", "--replicas", "8"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    out = json.loads(captured.out.strip().splitlines()[-1])
+    assert out["n_replicas"] == 8
+    assert out["n_published_sum"] > 0
+
+
+def test_brokers_below_one_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--brokers", "0"])
     captured = capsys.readouterr()
     assert rc == 2
     assert "error:" in captured.err
-    assert "chaos" in captured.err
+    assert "--brokers must be >= 1" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_brokers_above_fog_count_is_clear_error(capsys):
+    """smoke has 2 fogs: --brokers 5 must fail at validate() with the
+    actionable reduce-or-add-fogs line, never a traceback."""
+    rc = main(["--scenario", "smoke", "--brokers", "5"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "exceeds n_fogs" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_unknown_hier_policy_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--brokers", "2",
+               "--hier-policy", "warp_speed"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "unknown hier policy" in captured.err
+    # the valid names are listed so the fix is obvious
+    assert "least_loaded" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_hier_policy_requires_brokers(capsys):
+    rc = main(["--scenario", "smoke", "--hier-policy", "threshold"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "needs --brokers" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_brokers_with_tp_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--brokers", "2", "--tp", "8"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "--brokers" in err and "--tp" in err
+
+
+def test_brokers_with_replicas_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--brokers", "2",
+              "--replicas", "8"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "--brokers" in err and "--replicas" in err
+
+
+def test_hier_unsupported_policy_is_clear_error(capsys):
+    """ROUND_ROBIN does not federate: validate() rejects with the
+    supported-family line."""
+    rc = main(["--scenario", "smoke", "--brokers", "2",
+               "--set", "scenario.n_fogs=4", "--policy", "round_robin"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "does not federate" in captured.err
     assert "Traceback" not in captured.err
 
 

@@ -32,9 +32,14 @@ def _worlds():
         smoke.build(horizon=0.4, telemetry=True, telemetry_hist=True),
         # chaos fault-injection world (ISSUE 12: the lifecycle/sweep
         # phase + retry carry; assume_static off — liveness mutates)
+        # composed with the federated hierarchy (ISSUE 14: the migrate
+        # phase + domain-masked decide, HierState in the carry) — one
+        # world traces both subsystems' phases, keeping the registry
+        # sweep inside the tier-1 time budget
         smoke.build(
             horizon=0.4, chaos=True, chaos_mode=1, chaos_mtbf_s=0.1,
             chaos_mttr_s=0.05, chaos_script=((0, 0.1, 0.2),),
+            n_brokers=2, hier_policy=1, hier_threshold=0.5,
         ),
     ]
 

@@ -62,6 +62,67 @@ def test_fleet_equals_vmap_per_replica_over_three_worlds():
             assert _replica_hash(ref, r) == _replica_hash(got, r), (kw, r)
 
 
+def test_fleet_chaos_per_replica_schedules_match_vmap():
+    """The fleet-chaos follow-up (ROADMAP): chaos worlds run on the
+    fleet with PER-REPLICA fault schedules — replica r's chaos stream
+    is fold_in(chaos_key, r), re-derived at replicate time — and the
+    fleet path equals the vmap path bit-for-bit.  Replicas must NOT
+    share one schedule (the old rejection's failure mode)."""
+    from fognetsimpp_tpu.spec import ChaosMode
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    spec, state, net, bounds = smoke.build(
+        horizon=0.5, start_time_max=0.05, n_fogs=3,
+        assume_static=False,
+        chaos=True, chaos_mode=int(ChaosMode.REOFFLOAD),
+        chaos_mtbf_s=0.08, chaos_mttr_s=0.04, chaos_max_retries=4,
+    )
+    batch = replicate_state(spec, state, n_dev, seed=3)
+    # the replicas draw decorrelated schedules (folded chaos keys)
+    keys = np.asarray(batch.chaos.key)
+    assert len({k.tobytes() for k in keys}) == n_dev
+    ref = run_replicated(spec, batch, net, bounds)
+    crashes = np.asarray(ref.chaos.n_crashes)
+    assert crashes.sum() > 0
+    assert len(set(np.asarray(ref.chaos.down_ticks).sum(axis=1))) > 1, (
+        "replicas shared one fault schedule"
+    )
+    got = run_fleet(spec, batch, net, bounds, mesh, donate=False)
+    for r in range(n_dev):
+        assert _replica_hash(ref, r) == _replica_hash(got, r), r
+
+
+@pytest.mark.slow  # its own 4-replica chaos program: full-suite
+#   tier (the quick tier keeps the fleet-vs-vmap chaos A/B above)
+def test_fleet_chaos_replica_schedule_replays_on_host():
+    """Replica r's schedule is exactly outage_timeline under its folded
+    key — the host-replay contract survives the per-replica re-key."""
+    from fognetsimpp_tpu.chaos.faults import outage_timeline
+    from fognetsimpp_tpu.spec import ChaosMode
+
+    spec, state, net, bounds = smoke.build(
+        horizon=0.5, n_fogs=2, assume_static=False,
+        chaos=True, chaos_mode=int(ChaosMode.LOSE),
+        chaos_mtbf_s=0.1, chaos_mttr_s=0.05,
+    )
+    batch = replicate_state(spec, state, 4, seed=0)
+    final = run_replicated(spec, batch, net, bounds)
+    dt = spec.dt
+    t1s = (np.arange(spec.n_ticks) + 1).astype(np.float32) * np.float32(dt)
+    for r in range(4):
+        timeline = outage_timeline(spec, np.asarray(batch.chaos.key)[r])
+        expect = np.zeros(spec.n_fogs, np.int64)
+        for f, td, tu in timeline:
+            expect[f] += int(
+                ((np.float32(td) < t1s) & (np.float32(tu) >= t1s)).sum()
+            )
+        np.testing.assert_array_equal(
+            np.asarray(final.chaos.down_ticks, np.int64)[r], expect,
+            err_msg=f"replica {r}",
+        )
+
+
 def test_fleet_donated_carry_bit_exact():
     """Donating the sharded carry (the production default) must not
     change a bit vs the keep path — and the dealias pass must survive

@@ -1,0 +1,584 @@
+"""hier/ — federated multi-broker hierarchy (ISSUE 14).
+
+Gates: the zero-row HierState is inert (single-broker bit-exactness
+across every run entry), an inert B>1 world (one real domain, migration
+thresholds at ∞) perturbs zero non-hier bits over the three
+policy-family worlds, active federation is bit-identical across
+run/run_jit/run_chunked, the task-conservation invariant (including
+``n_migrated``/``n_hop_exhausted``) holds exactly on a forced-migration
+grid, THRESHOLD/LEAST_LOADED migration beats NEVER on the imbalanced
+world, a chaos-killed domain's tasks migrate instead of dropping, the
+learn credit of a migrated task resolves exactly-once on the rescuing
+broker's pick, and the hier knobs ride the DynSpec operand.
+"""
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, run
+from fognetsimpp_tpu.hier import stamp_ownership
+from fognetsimpp_tpu.scenarios import smoke
+from fognetsimpp_tpu.spec import ChaosMode, HierPolicy, Stage
+
+#: Deliberately IDENTICAL to tests/test_chaos.py's SMALL shape: the
+#: single-broker matrix below then re-runs programs that earlier tier-1
+#: files already compiled (the jit cache is process-wide), so the
+#: 3-world × 3-entry gate costs runs, not compiles.
+SMALL = dict(n_users=2, n_fogs=2, send_interval=0.05, horizon=0.3,
+             assume_static=False)
+
+#: The three policy-family worlds of the chaos/telemetry A/B
+#: discipline (same policies as test_chaos.WORLDS — shared programs):
+#: dense/fused broker, sequential compacted broker, learned bandit.
+B1_WORLDS = [
+    dict(policy=int(Policy.MIN_BUSY)),
+    dict(policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0),
+    dict(policy=int(Policy.DUCB)),
+]
+
+#: Federatable variants for the B>1 worlds (LOCAL_FIRST does not
+#: federate): dense, task-id-keyed RANDOM, learned bandit.
+WORLDS = [
+    dict(policy=int(Policy.MIN_BUSY)),
+    dict(policy=int(Policy.RANDOM)),
+    dict(policy=int(Policy.DUCB)),
+]
+
+#: The imbalanced acceptance world (hot domain, idle domain): every
+#: user publishes to broker 0, whose single slow fog saturates within a
+#: few sends, while broker 1 owns three fast idle fogs one 5 ms
+#: federation hop away.
+IMBALANCED = dict(
+    n_users=4, n_fogs=4,
+    fog_mips=(900.0, 60000.0, 60000.0, 60000.0),
+    send_interval=0.02, horizon=0.6, dt=1e-3, seed=0,
+    n_brokers=2, hier_threshold=0.5, hier_max_hops=2,
+    hier_rtt_s=0.005, assume_static=False,
+)
+IMB_FOG_OWNER = [0, 1, 1, 1]
+IMB_USER_OWNER = [0, 0, 0, 0]
+
+
+def _state_hash(state) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+def _build_imbalanced(hier_policy, **kw):
+    args = dict(IMBALANCED)
+    args.update(kw)
+    args["hier_policy"] = int(hier_policy)
+    spec, state, net, bounds = smoke.build(**args)
+    state = stamp_ownership(
+        spec, state, user_broker=IMB_USER_OWNER[: spec.n_users],
+        fog_broker=IMB_FOG_OWNER,
+    )
+    return spec, state, net, bounds
+
+
+#: Memoized plain-run() finals: run() re-traces its scan per call, so
+#: tests sharing a world share ONE trace through these instead of
+#: paying ~4 s each (tier-1 time budget; results are read-only).
+_RUN_CACHE: dict = {}
+
+
+def _imb_final(hier_policy, policy=int(Policy.MIN_BUSY)):
+    key = ("imb", int(hier_policy), int(policy))
+    if key not in _RUN_CACHE:
+        spec, state, net, bounds = _build_imbalanced(
+            hier_policy, policy=policy
+        )
+        final, _ = run(spec, state, net, bounds)
+        _RUN_CACHE[key] = (spec, final)
+    return _RUN_CACHE[key]
+
+
+def _small_final(**kw):
+    key = ("small",) + tuple(sorted(kw.items()))
+    if key not in _RUN_CACHE:
+        spec, state, net, bounds = _build(**kw)
+        final, _ = run(spec, state, net, bounds)
+        _RUN_CACHE[key] = (spec, final)
+    return _RUN_CACHE[key]
+
+
+def _census(final) -> dict:
+    stage = np.asarray(final.tasks.stage)
+    return {s.name: int((stage == int(s)).sum()) for s in Stage}
+
+
+def _assert_conservation(final):
+    """spawned = completed + dropped + lost + in-flight +
+    hop-exhausted, exactly (the ISSUE 14 acceptance identity)."""
+    c = _census(final)
+    published = int(np.asarray(final.metrics.n_published))
+    terminal = (
+        c["DONE"] + c["DROPPED"] + c["LOST"] + c["NO_RESOURCE"]
+        + c["REJECTED"] + c["HOP_EXHAUSTED"]
+    )
+    in_flight = (
+        c["PUB_INFLIGHT"] + c["TASK_INFLIGHT"] + c["QUEUED"]
+        + c["RUNNING"] + c["LOCAL_RUN"]
+    )
+    assert published == terminal + in_flight, (published, c)
+    assert c["HOP_EXHAUSTED"] == int(
+        np.asarray(final.hier.n_hop_exhausted)
+    )
+    assert c["DONE"] == int(np.asarray(final.metrics.n_completed))
+
+
+def _task_time_ms(final) -> np.ndarray:
+    from fognetsimpp_tpu.runtime.signals import extract_signals
+
+    return extract_signals(final)["task_time"]
+
+
+# ----------------------------------------------------------------------
+# inert gates: single broker, and a degenerate B>1 world
+# ----------------------------------------------------------------------
+
+def test_single_broker_hier_state_inert():
+    """n_brokers=1 (the default) carries zero-row hier leaves and
+    traces none of the hierarchy machinery: every HierState array leaf
+    is empty and every counter exactly zero after a full run — over the
+    three policy-family worlds (the edit-loop half of the single-broker
+    gate; the full cross-entry state-hash matrix rides the slow twin
+    below)."""
+    for kw in B1_WORLDS:
+        spec, ref = _small_final(**kw)
+        assert not spec.hier_active
+        assert spec.hier_users == 0 and spec.hier_tasks == 0
+        assert ref.hier.fog_broker.shape == (0,)
+        assert ref.hier.task_broker.shape == (0,)
+        assert ref.hier.peer_load.shape == (0, 0)
+        assert int(np.asarray(ref.hier.n_migrated)) == 0
+        assert int(np.asarray(ref.hier.n_hop_exhausted)) == 0
+
+
+@pytest.mark.slow  # run_jit + chunked compiles per world: full-suite
+#   tier (the quick tier keeps the zero-row gate above; run_chunked
+#   compiles its chunk program per call, so this matrix is the file's
+#   compile-heavy half)
+def test_single_broker_bit_exact_across_run_entries():
+    """n_brokers=1 produces bit-identical final states across
+    run / run_jit / run_chunked — over the three policy-family
+    worlds (the ISSUE 14 acceptance matrix)."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    for kw in B1_WORLDS:
+        spec, ref = _small_final(**kw)
+        h_ref = _state_hash(ref)
+        spec2, state2, net2, bounds2 = _build(**kw)
+        assert _state_hash(run_jit(spec2, state2, net2, bounds2)) == h_ref
+        spec3, state3, net3, bounds3 = _build(**kw)
+        assert (
+            _state_hash(run_chunked(spec3, state3, net3, bounds3, 150))
+            == h_ref
+        )
+
+
+def _build_inert_world(**kw):
+    sp, st, n, b = _build(
+        n_brokers=2, hier_policy=int(HierPolicy.THRESHOLD),
+        hier_threshold=float("inf"), **kw
+    )
+    st = stamp_ownership(
+        sp, st, user_broker=[0] * sp.n_users,
+        fog_broker=[0] * sp.n_fogs,
+    )
+    return sp, st, n, b
+
+
+def test_inert_multi_broker_world_perturbs_nothing():
+    """B=2 with every user AND fog stamped into domain 0 and the
+    migration threshold at ∞ is read-only: the hier machinery traces
+    (domain masks, the migrate phase, peer-view aging) but every
+    non-hier leaf of the final state is bit-equal to the single-broker
+    run of the same world — over the three federatable policy-family
+    worlds via run() (the run_jit/run_chunked entries ride the slow
+    twin below — they re-enter the same phase code; their compile
+    budget stays out of the edit loop)."""
+    for kw in WORLDS:
+        _, ref = _small_final(**kw)
+        spec_on, s_on, net2, bounds2 = _build_inert_world(**kw)
+        assert spec_on.hier_active
+        finals = [run(spec_on, s_on, net2, bounds2)[0]]
+        for got in finals:
+            for f in dataclasses.fields(ref):
+                if f.name == "hier":
+                    continue
+                for a, b in zip(
+                    jax.tree.leaves(getattr(ref, f.name)),
+                    jax.tree.leaves(getattr(got, f.name)),
+                ):
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b),
+                        err_msg=f"{kw} {f.name}",
+                    )
+            assert int(np.asarray(got.hier.n_migrated)) == 0
+            assert int(np.asarray(got.hier.n_hop_exhausted)) == 0
+
+
+@pytest.mark.slow  # run_jit + chunked compiles of the inert federated
+#   program: full-suite tier (the quick twin above covers run())
+def test_inert_multi_broker_world_other_entries():
+    """The inert-B>1 world through run_jit and run_chunked as well:
+    both entries bit-equal the single-broker run on every non-hier
+    leaf (dense-family world; the entries re-enter the same phase code
+    for every policy family)."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    kw = WORLDS[0]
+    _, ref = _small_final(**kw)
+    sp3, st3, n3, b3 = _build_inert_world(**kw)
+    sp4, st4, n4, b4 = _build_inert_world(**kw)
+    for got in (
+        run_jit(sp3, st3, n3, b3),
+        run_chunked(sp4, st4, n4, b4, 150),
+    ):
+        for f in dataclasses.fields(ref):
+            if f.name == "hier":
+                continue
+            for a, b in zip(
+                jax.tree.leaves(getattr(ref, f.name)),
+                jax.tree.leaves(getattr(got, f.name)),
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=f.name
+                )
+
+
+def test_phase_contract_registered():
+    from fognetsimpp_tpu.core.contracts import check_phase_contracts
+
+    spec, state, net, _ = _build_imbalanced(HierPolicy.THRESHOLD)
+    checked = check_phase_contracts(spec, state, net)
+    assert "_phase_broker_migrate" in checked
+
+
+# ----------------------------------------------------------------------
+# active federation: determinism + conservation on a forced grid
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow  # run_jit + chunked compiles of the federated
+#   program: full-suite tier (the quick tier keeps the run()-level
+#   migration grid below — the test_tp.py cross-entry discipline)
+def test_active_federation_bit_identical_across_run_entries():
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    _, ref = _imb_final(HierPolicy.THRESHOLD)
+    assert int(np.asarray(ref.hier.n_migrated)) > 0
+    h_ref = _state_hash(ref)
+    spec2, state2, net2, bounds2 = _build_imbalanced(HierPolicy.THRESHOLD)
+    assert _state_hash(run_jit(spec2, state2, net2, bounds2)) == h_ref
+    spec3, state3, net3, bounds3 = _build_imbalanced(HierPolicy.THRESHOLD)
+    assert (
+        _state_hash(run_chunked(spec3, state3, net3, bounds3, 300))
+        == h_ref
+    )
+
+
+@pytest.mark.parametrize(
+    "policy", [int(Policy.MIN_BUSY), int(Policy.DUCB)],
+)
+@pytest.mark.parametrize(
+    "hier_policy", [int(HierPolicy.THRESHOLD), int(HierPolicy.LEAST_LOADED)]
+)
+def test_forced_migration_conservation_grid(policy, hier_policy):
+    """Migration actually fires on the imbalanced world under
+    (dense / learned scheduler) × (THRESHOLD / LEAST_LOADED) cells, and
+    the conservation identity holds exactly.  (The compacted RANDOM
+    family's domain masking is covered by the inert-B>1 gate above;
+    keeping it out of this grid saves two tier-1 compiles.)"""
+    spec, final = _imb_final(hier_policy, policy)
+    assert int(np.asarray(final.hier.n_migrated)) > 0
+    h = final.hier
+    np.testing.assert_array_equal(
+        np.asarray(h.mig_out).sum(), np.asarray(h.n_migrated)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(h.mig_in).sum(), np.asarray(h.n_migrated)
+    )
+    # migrated tasks live on domain-1 fogs only after the hop
+    fog = np.asarray(final.tasks.fog)
+    hops = np.asarray(h.hops)
+    done = np.asarray(final.tasks.stage) == int(Stage.DONE)
+    rescued = done & (hops > 0)
+    assert rescued.any()
+    assert np.all(np.isin(fog[rescued], [1, 2, 3]))
+    _assert_conservation(final)
+
+
+@pytest.mark.slow  # its own chaos+hier program: full-suite tier
+#   (the quick-tier grid covers conservation incl. HOP_EXHAUSTED=0)
+def test_hop_budget_exhausts_in_dead_federation():
+    """Every domain dead (scripted chaos kills all fogs), REOFFLOAD
+    bounces tasks back to brokers: with nowhere to go the migrate phase
+    terminates them as HOP_EXHAUSTED, counted exactly."""
+    spec, state, net, bounds = _build(
+        horizon=0.6,
+        n_brokers=2, hier_policy=int(HierPolicy.THRESHOLD),
+        hier_threshold=0.5, hier_max_hops=1,
+        chaos=True, chaos_mode=int(ChaosMode.REOFFLOAD),
+        chaos_max_retries=8,
+        chaos_script=((0, 0.1, 0.55), (1, 0.1, 0.55)),
+    )
+    final, _ = run(spec, state, net, bounds)
+    exhausted = int(np.asarray(final.hier.n_hop_exhausted))
+    assert exhausted > 0
+    _assert_conservation(final)
+
+
+# ----------------------------------------------------------------------
+# the acceptance results: migration beats NEVER
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow  # adds the NEVER-policy program: full-suite tier
+#   (the measured result of record is the committed bench.py --hier
+#   capture, BENCH_r07.json / BENCHMARKS.md)
+def test_migration_beats_never_on_imbalanced_world():
+    """Hot domain 0 (one slow fog), idle domain 1 (three fast fogs):
+    THRESHOLD and LEAST_LOADED migration both beat NEVER on mean AND
+    p95 task latency — the BENCHMARKS.md federation-under-imbalance
+    result."""
+    results = {}
+    for pol in (HierPolicy.NEVER, HierPolicy.THRESHOLD,
+                HierPolicy.LEAST_LOADED):
+        spec, final = _imb_final(pol)
+        tt = _task_time_ms(final)
+        assert tt.size > 0, pol
+        results[pol] = (float(tt.mean()), float(np.percentile(tt, 95)))
+        if pol is HierPolicy.NEVER:
+            assert int(np.asarray(final.hier.n_migrated)) == 0
+        else:
+            assert int(np.asarray(final.hier.n_migrated)) > 0
+        _assert_conservation(final)
+    never_mean, never_p95 = results[HierPolicy.NEVER]
+    for pol in (HierPolicy.THRESHOLD, HierPolicy.LEAST_LOADED):
+        mean, p95 = results[pol]
+        assert mean < never_mean, (pol, results)
+        assert p95 < never_p95, (pol, results)
+
+
+@pytest.mark.slow  # two chaos+hier programs: full-suite tier
+def test_chaos_dead_domain_migrates_instead_of_dropping():
+    """A whole domain down (scripted outage over every domain-0 fog):
+    under NEVER its re-offloaded tasks die (NO_RESOURCE / retry
+    exhaustion); under THRESHOLD they migrate to the surviving domain
+    and complete — the federation actually buys robustness."""
+    kw = dict(
+        n_users=4, n_fogs=4,
+        fog_mips=(60000.0, 60000.0, 60000.0, 60000.0),
+        send_interval=0.02, horizon=1.0, dt=1e-3, seed=0,
+        n_brokers=2, hier_threshold=0.5, hier_max_hops=2,
+        assume_static=False,
+        chaos=True, chaos_mode=int(ChaosMode.REOFFLOAD),
+        chaos_max_retries=8,
+        chaos_script=((0, 0.1, 0.95), (1, 0.1, 0.95)),
+    )
+
+    def run_one(pol):
+        spec, state, net, bounds = smoke.build(
+            **kw, hier_policy=int(pol)
+        )
+        state = stamp_ownership(
+            spec, state, user_broker=[0, 0, 0, 0],
+            fog_broker=[0, 0, 1, 1],
+        )
+        final, _ = run(spec, state, net, bounds)
+        _assert_conservation(final)
+        return final
+
+    never = run_one(HierPolicy.NEVER)
+    mig = run_one(HierPolicy.THRESHOLD)
+    c_never, c_mig = _census(never), _census(mig)
+    lost_never = (
+        c_never["NO_RESOURCE"] + c_never["LOST"]
+        + c_never["HOP_EXHAUSTED"]
+    )
+    lost_mig = (
+        c_mig["NO_RESOURCE"] + c_mig["LOST"] + c_mig["HOP_EXHAUSTED"]
+    )
+    assert lost_never > 0, c_never
+    assert int(np.asarray(mig.hier.n_migrated)) > 0
+    assert lost_mig < lost_never, (c_mig, c_never)
+    assert c_mig["DONE"] > c_never["DONE"], (c_mig, c_never)
+
+
+# ----------------------------------------------------------------------
+# learn interplay: exactly-once credit on the rescuing broker's pick
+# ----------------------------------------------------------------------
+
+def test_learn_credit_exactly_once_survives_migration():
+    """Bandit world on the imbalanced federation: every credit resolves
+    exactly once (reward_cnt == lat_cnt with no chaos penalties), every
+    DONE-and-acked task's credit went to the fog the RESCUING broker
+    picked (tasks.fog provenance), and credited rows never exceed
+    picks."""
+    spec, final = _imb_final(HierPolicy.THRESHOLD, int(Policy.DUCB))
+    assert int(np.asarray(final.hier.n_migrated)) > 0
+    reward_cnt = float(np.sum(np.asarray(final.learn.reward_cnt)))
+    picks = float(np.sum(np.asarray(final.learn.pick_count)))
+    lat_cnt = float(np.asarray(final.learn.lat_cnt))
+    assert reward_cnt == pytest.approx(lat_cnt)
+    assert reward_cnt <= picks + 1e-6
+    # rescued tasks were decided (and credited) on domain-1 arms
+    hops = np.asarray(final.hier.hops)
+    done = np.asarray(final.tasks.stage) == int(Stage.DONE)
+    credited = np.asarray(final.learn.credited) == 1
+    rescued = done & credited & (hops > 0)
+    assert rescued.any()
+    assert np.all(np.isin(np.asarray(final.tasks.fog)[rescued], [1, 2, 3]))
+
+
+# ----------------------------------------------------------------------
+# dynspec: migration knobs ride the operand
+# ----------------------------------------------------------------------
+
+def test_hier_knobs_ride_the_dynspec_operand():
+    """Threshold / RTT / hop-budget changes stay inside one shape
+    bucket (zero recompiles via apply_knobs), and the derived (B, B)
+    RTT leaf matches the spec's matrix/uniform derivation."""
+    from fognetsimpp_tpu import dynspec
+
+    spec, _, _, _ = _build_imbalanced(HierPolicy.THRESHOLD)
+    spec2 = dynspec.apply_knobs(
+        spec, {"hier_threshold": 0.9, "hier_rtt_s": 0.02,
+               "hier_max_hops": 4},
+    )
+    assert dynspec.same_program(spec, spec2)
+    d = dynspec.dyn_of(spec2)
+    assert d.hier_rtt.shape == (2, 2)
+    assert float(d.hier_rtt[0, 1]) == np.float32(0.02)
+    assert float(d.hier_rtt[0, 0]) == 0.0
+    assert int(d.hier_max_hops) == 4
+    # an explicit matrix rides verbatim
+    spec3 = dataclasses.replace(
+        spec, hier_rtt_matrix=((0.0, 0.008), (0.012, 0.0))
+    ).validate()
+    d3 = dynspec.dyn_of(spec3)
+    assert float(d3.hier_rtt[1, 0]) == np.float32(0.012)
+
+
+@pytest.mark.slow  # pays the federated run_jit cold compile
+def test_warm_threshold_reconfig_is_zero_compiles():
+    """Re-tuning the migration threshold on a live federated world is
+    a pure jit-cache hit: zero backend compile events (the ISSUE 13
+    warm-reconfig contract extended to the hier knobs)."""
+    from fognetsimpp_tpu import compile_cache, dynspec
+    from fognetsimpp_tpu.core.engine import run_jit
+
+    spec, state, net, bounds = _build_imbalanced(HierPolicy.THRESHOLD)
+    run_jit(spec, state, net, bounds)  # cold
+    snap = compile_cache.snapshot()
+    spec2 = dynspec.apply_knobs(spec, {"hier_threshold": 0.25})
+    _, state2, net2, bounds2 = _build_imbalanced(
+        HierPolicy.THRESHOLD, hier_threshold=0.25
+    )
+    run_jit(spec2, state2, net2, bounds2)
+    assert compile_cache.delta_since(snap)["compiles"] == 0
+
+
+# ----------------------------------------------------------------------
+# observability + sharded-runner gates
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow  # its own telemetry-on federated program
+def test_recorder_exposition_and_timeline_carry_hier(tmp_path):
+    """One federated run through the full output layer: .sca.json hier
+    section, fns_hier_* OpenMetrics families, and the Perfetto broker
+    load lanes — all from the one hier_summary() source."""
+    import json
+
+    from fognetsimpp_tpu.runtime.recorder import record_run
+    from fognetsimpp_tpu.telemetry.timeline import build_trace
+
+    spec, state, net, bounds = _build_imbalanced(
+        HierPolicy.THRESHOLD, telemetry=True, telemetry_reservoir=64
+    )
+    final, _ = run(spec, state, net, bounds)
+    assert final.telem.hier_load_sum.shape == (2,)
+    paths = record_run(str(tmp_path), spec, final, run_id="Hier-0")
+    sca = json.loads(open(paths["sca"]).read())
+    assert sca["hier"]["n_brokers"] == 2
+    assert sca["hier"]["policy"] == "threshold"
+    assert sca["hier"]["migrated"] == int(
+        np.asarray(final.hier.n_migrated)
+    )
+    assert sca["hier"]["fogs_per_broker"] == [1, 3]
+    assert sca["scalars"]["hier_migrated"] == sca["hier"]["migrated"]
+    om = open(paths["om"]).read()
+    assert "fns_hier_migrated" in om
+    assert 'fns_hier_migrations_out{broker="0"}' in om
+    assert 'fns_hier_load_mean{broker="1"}' in om
+    trace = build_trace(spec, final)
+    lanes = [
+        e for e in trace["traceEvents"]
+        if e.get("name", "").startswith("broker") and e.get("ph") == "C"
+    ]
+    assert lanes, "per-broker load lanes missing from the trace"
+
+
+def test_hier_telemetry_leaves_zero_row_when_off():
+    spec, _, _, _ = _build_imbalanced(HierPolicy.THRESHOLD)
+    assert spec.telemetry_hier_brokers == 0  # telemetry off
+    spec2, state2, _, _ = _build(n_brokers=2, telemetry=True)
+    assert spec2.telemetry_hier_brokers == 2
+    assert state2.telem.hier_load_sum.shape == (2,)
+    spec3, state3, _, _ = _build(telemetry=True)
+    assert state3.telem.hier_load_sum.shape == (0,)
+
+
+def test_sharded_runners_reject_hier_with_one_line():
+    """The TP tick and the fleet runner gate federated specs off with
+    the ONE shared hier_reject_reason message."""
+    from fognetsimpp_tpu.core.engine import tp_reject_reason
+    from fognetsimpp_tpu.parallel import make_mesh, replicate_state
+    from fognetsimpp_tpu.parallel.fleet import run_fleet
+
+    spec, state, net, bounds = _build(
+        n_brokers=2, n_fogs=4, assume_static=True
+    )
+    reason = tp_reject_reason(spec)
+    assert reason is not None and "hierarchy" in reason
+    batch = replicate_state(spec, state, 8)
+    with pytest.raises(ValueError, match="hierarchy"):
+        run_fleet(spec, batch, net, bounds, make_mesh(8))
+
+
+@pytest.mark.slow  # in-process CLI: its own program (test_tp.py
+#   CLI-smoke discipline)
+def test_cli_hier_composes_with_policy_and_telemetry(tmp_path, capsys):
+    """--brokers/--hier-policy compose with --policy/--telemetry and
+    the run lands hier counters in every output."""
+    import json
+
+    from fognetsimpp_tpu.__main__ import main
+
+    rc = main([
+        "--scenario", "smoke",
+        "--set", "scenario.horizon=0.3",
+        "--set", "scenario.n_fogs=4",
+        "--brokers", "2", "--hier-policy", "least_loaded",
+        "--policy", "min_busy", "--telemetry",
+        "--out", str(tmp_path),
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    json.loads(captured.out.splitlines()[-1])
+    sca = json.loads((tmp_path / "General-0.sca.json").read_text())
+    assert sca["hier"]["n_brokers"] == 2
+    assert sca["hier"]["policy"] == "least_loaded"
+    assert sca["spec"]["n_brokers"] == 2

@@ -20,10 +20,11 @@ pytestmark = pytest.mark.filterwarnings("ignore")
 
 @pytest.fixture(scope="module")
 def measured():
-    # tp=False: the TP sharded-tick compile is covered by test_tp.py's
-    # own programs in this tier; the TP budget gate runs in CI via the
-    # op_budget CLI (--check), which measures with tp=True
-    return op_budget.measure(tp=False)
+    # tp=False / hier=False: the TP sharded-tick and federated-tick
+    # compiles are covered by test_tp.py / test_hier.py's own programs
+    # in this tier; both budget gates still run in CI via the op_budget
+    # CLI (--check), which measures everything
+    return op_budget.measure(tp=False, hier=False)
 
 
 def test_budget_file_present_and_consistent():

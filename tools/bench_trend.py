@@ -55,6 +55,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 SHAPE_FIELDS = (
     "metric", "backend", "n_users", "n_fogs", "dt", "arrival_window",
     "policy", "n_devices", "n_replicas", "tp_shards", "chaos",
+    "n_brokers",
 )
 
 #: Shape values a capture that predates the field is known to have run
@@ -75,6 +76,11 @@ SHAPE_DEFAULTS = {
     # (bench.py --chaos records a "chaos" string) form their own
     # trajectory instead of regressing the happy-path ratchet.
     "chaos": None,
+    # the federated multi-broker hierarchy arrived with ISSUE 14: every
+    # prior capture ran the single base broker — backfill None so
+    # federation rows (bench.py --hier records n_brokers) ratchet as
+    # their own trajectories.
+    "n_brokers": None,
 }
 
 
@@ -131,7 +137,7 @@ def _shape_str(shape: Tuple) -> str:
     d = dict(shape)
     bits = [str(d.get("metric") or "?"), str(d.get("backend") or "?")]
     for k in ("n_users", "n_fogs", "dt", "arrival_window", "n_devices",
-              "tp_shards", "chaos"):
+              "tp_shards", "chaos", "n_brokers"):
         if d.get(k) is not None:
             bits.append(f"{k}={d[k]}")
     return " ".join(bits)

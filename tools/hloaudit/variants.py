@@ -83,6 +83,17 @@ CHAOS_OVERRIDES = dict(
 )
 
 
+#: The federated-hierarchy tick overrides (ISSUE 14): 2 broker domains
+#: over the op-budget pinned 8-fog world, THRESHOLD migration live —
+#: the domain-masked dense decide + the migrate phase both trace.
+HIER_OVERRIDES = dict(
+    n_brokers=2,
+    hier_policy=1,  # HierPolicy.THRESHOLD
+    hier_threshold=0.5,
+    hier_max_hops=2,
+)
+
+
 def _compile_tick(**build_overrides):
     """Compile ONE tick of the op-budget pinned world; returns
     (hlo_text, spec).  The same lower/compile path op_budget gates, so
@@ -246,6 +257,16 @@ def variants() -> List[Variant]:
             "fault path must stay host-transfer-free, f64-free and "
             "collective-free like every single-device tick",
             lambda: _compile_tick(**CHAOS_OVERRIDES),
+        ),
+        Variant(
+            "tick_hier",
+            "the op-budget tick with the federated multi-broker "
+            "hierarchy live (2 domains, THRESHOLD migration: "
+            "domain-masked per-broker winners + the broker_migrate "
+            "phase + aged peer views) — the federation path must stay "
+            "host-transfer-free, f64-free and collective-free like "
+            "every single-device tick",
+            lambda: _compile_tick(**HIER_OVERRIDES),
         ),
         Variant(
             "tick_dyn",

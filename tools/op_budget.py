@@ -116,6 +116,20 @@ def compile_chaos_counts() -> dict:
     return entry_op_counts(text)
 
 
+def compile_hier_counts() -> dict:
+    """Compile the federated-hierarchy tick (the hloaudit ``tick_hier``
+    shape: 2 broker domains, THRESHOLD migration) and count its HLO
+    ops — the federation path's own kernel-count pin (ISSUE 14): the
+    domain-masked winners and the migrate phase ride every federated
+    tick, so a regression here is a multi-broker throughput loss CI
+    should catch like any other."""
+    from tools.hloaudit.variants import variants
+
+    v = next(x for x in variants() if x.name == "tick_hier")
+    text, _spec = v.compile_fn()
+    return entry_op_counts(text)
+
+
 def compile_dyn_counts() -> dict:
     """Compile the promoted-operand tick (the hloaudit ``tick_dyn``
     shape: the tick_chaos world with every promoted knob a DynSpec
@@ -175,18 +189,22 @@ def compile_tp_counts(telemetry: bool = False) -> dict:
     }
 
 
-def measure(tp: bool = True) -> dict:
+def measure(tp: bool = True, hier: bool = True) -> dict:
     """Compile and count the gated programs.
 
     ``tp=False`` skips the TP sharded-tick compile (tier-1's
     test_op_budget fixture: test_tp.py already compiles TP programs,
     and the TP budget gate still runs in CI via
-    ``python tools/op_budget.py --check``).
+    ``python tools/op_budget.py --check``).  ``hier=False`` likewise
+    skips the federated-tick compile in the tier-1 fixture
+    (test_hier.py compiles hier programs in-tier; the tick_hier budget
+    gate still runs in CI via ``--check``).
     """
     fused = compile_tick_counts(fused=True)
     unfused = compile_tick_counts(fused=False)
     chaos = compile_chaos_counts()
     dyn = compile_dyn_counts()
+    hier_counts = compile_hier_counts() if hier else None
     out_tp = {}
     if tp:
         for key, telem in (("tp_tick", False),
@@ -219,6 +237,19 @@ def measure(tp: bool = True) -> dict:
             "max_ops": int(dyn["ops"] * COUNT_SLACK),
             "max_fusions": int(dyn["fusions"] * COUNT_SLACK),
         },
+        **(
+            {
+                "tick_hier": {
+                    **hier_counts,
+                    "max_ops": int(hier_counts["ops"] * COUNT_SLACK),
+                    "max_fusions": int(
+                        hier_counts["fusions"] * COUNT_SLACK
+                    ),
+                }
+            }
+            if hier_counts is not None
+            else {}
+        ),
         **out_tp,
     }
 
@@ -246,8 +277,9 @@ def check(measured: dict, budget: dict) -> list:
             f"fused/unfused ops ratio {ratio:.3f} > {cap} — the "
             f"fused front-end lost its kernel-count reduction"
         )
-    # --- the chaos (ISSUE 12) and promoted-operand (ISSUE 13) ticks ----
-    for vname in ("tick_chaos", "tick_dyn"):
+    # --- the chaos (ISSUE 12), promoted-operand (ISSUE 13) and
+    # federated-hierarchy (ISSUE 14) ticks -----------------------------
+    for vname in ("tick_chaos", "tick_dyn", "tick_hier"):
         tc, btc = measured.get(vname), budget.get(vname)
         if tc is None:
             continue

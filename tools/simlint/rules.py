@@ -950,7 +950,9 @@ DYN_PROMOTED_FIELDS = frozenset({
     "link_drain2_s", "link_rate_bps", "chaos_mtbf_s", "chaos_mttr_s",
     "chaos_rtt_amp", "chaos_rtt_period_s", "chaos_rtt_burst_prob",
     "chaos_rtt_burst_mult", "chaos_max_retries", "learn_discount",
-    "learn_reward_scale", "idle_power_w", "tx_energy_j", "rx_energy_j",
+    "learn_reward_scale", "hier_threshold", "hier_max_hops",
+    "hier_rtt_s", "hier_rtt_matrix",
+    "idle_power_w", "tx_energy_j", "rx_energy_j",
     "compute_power_w", "harvest_power_w", "harvest_period_s",
     "harvest_duty", "shutdown_frac", "start_frac",
 })
