@@ -42,7 +42,11 @@ inside the tick).
 Telemetry (ISSUE 4): ``BENCH_TELEMETRY=1`` runs the same world with the
 device-resident TelemetryState riding the carry (spec.telemetry) — the
 value/off-value ratio is the telemetry-on overhead BENCHMARKS.md
-quotes.  ``python bench.py --profile`` (or ``BENCH_PROFILE=<dir>``)
+quotes.  ``BENCH_JOURNEYS=1`` (ISSUE 15) additionally runs an
+interleaved journeys-off/on A/B over telemetry-on twins of the bench
+world (``BENCH_JOURNEYS_N`` sampled tasks, default 16) and records the
+``journey_overhead`` ratio tools/bench_trend.py gates at the
+established <= 1.10 bar.  ``python bench.py --profile`` (or ``BENCH_PROFILE=<dir>``)
 wraps the timed section in ``jax.profiler.trace`` (engine phases appear
 as named scopes) and appends a per-call dispatch-latency histogram plus
 the cold-compile time to the JSON line.
@@ -62,8 +66,15 @@ def _env_float(name: str, default: float) -> float:
     return float(os.environ.get(name, default))
 
 
-def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
-    """The shared bench world + its knob dict (single-chip and fleet)."""
+def _build_bench_world(
+    on_accel: bool, cpu_users: int = 1_000, **spec_overrides
+):
+    """The shared bench world + its knob dict (single-chip and fleet).
+
+    ``spec_overrides`` refine the env-derived build kwargs — the
+    journey-overhead A/B (``BENCH_JOURNEYS=1``) builds its off/on twin
+    worlds through here so both arms share every other knob.
+    """
     from fognetsimpp_tpu.scenarios import smoke
     from fognetsimpp_tpu.spec import LEARNED_POLICIES, policy_from_name
 
@@ -108,6 +119,7 @@ def _build_bench_world(on_accel: bool, cpu_users: int = 1_000):
         # streaming histogram, which bins them at ack time (ISSUE 6)
         derive_acks=policy not in LEARNED_POLICIES and not hist,
     )
+    build_kw.update(spec_overrides)
     # default window: the K=4096 O(K^2)-rank sweet spot — warm-up
     # overflow defers to later windows (counted in n_deferred) and
     # saturation tail-drops take the dense fast path.  BENCH_WINDOW=auto
@@ -232,11 +244,51 @@ def main() -> None:
     n_ticks = spec.n_ticks * n_replicas * n_pipeline
     value = decisions / wall
 
+    # interleaved journey-overhead A/B (ISSUE 15, BENCH_JOURNEYS=1):
+    # telemetry-on worlds with the journey rings off vs on, everything
+    # else identical — the measured journeys-on overhead BENCHMARKS.md
+    # quotes, gated <= OVERHEAD_BAR by tools/bench_trend.py (the
+    # BENCH_TELEMETRY methodology)
+    journey_fields = {}
+    if os.environ.get("BENCH_JOURNEYS", "") not in ("", "0"):
+        J = _env_int("BENCH_JOURNEYS_N", 16)
+        arms = {}
+        for label, j in (("off", 0), ("on", J)):
+            sp, st, nt, bd, _k = _build_bench_world(
+                on_accel, telemetry=True, telemetry_journeys=j
+            )
+            f = jax.jit(
+                lambda s, sp=sp, nt=nt, bd=bd: run(sp, s, nt, bd)[
+                    0
+                ].metrics.n_scheduled
+            )
+            f(st).block_until_ready()  # untimed compile+warm
+            arms[label] = (f, st)
+        n_ab = max(3, n_reps)
+        ab_walls = {"off": [], "on": []}
+        for rep in range(n_ab):
+            for label in ("off", "on"):
+                f, st = arms[label]
+                s2 = st.replace(key=jax.random.PRNGKey(100 + rep))
+                t0 = time.perf_counter()
+                int(np.asarray(f(s2)))
+                ab_walls[label].append(time.perf_counter() - t0)
+        off_med = float(np.median(ab_walls["off"]))
+        on_med = float(np.median(ab_walls["on"]))
+        journey_fields = {
+            "journey_overhead": round(on_med / max(off_med, 1e-9), 4),
+            "journey_off_wall_s": round(off_med, 4),
+            "journey_on_wall_s": round(on_med, 4),
+            "journey_sampled": J,
+            "journey_ab_reps": n_ab,
+        }
+
     print(
         json.dumps(
             {
                 "metric": "task_offload_decisions_per_sec_per_chip",
                 "value": round(value, 1),
+                **journey_fields,
                 "unit": "decisions/s",
                 "vs_baseline": round(value / 1e6, 4),
                 "policy": policy.name.lower(),

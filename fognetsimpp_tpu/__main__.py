@@ -71,6 +71,15 @@ def main(argv=None) -> int:
                     "adds '# TYPE histogram' series and p50/p95/p99 "
                     "quantile gauges to the OpenMetrics output and "
                     "lat_* rows to .sca.json")
+    ap.add_argument("--journeys", type=int, metavar="N", default=None,
+                    help="sample N task slots into device-resident "
+                    "journey event rings (telemetry/journeys.py): every "
+                    "lifecycle edge of a sampled task — spawn, decide, "
+                    "chaos re-offload, broker migration hop, enqueue, "
+                    "service, terminal — is appended on device and "
+                    "decoded into .sca.json, fns_journey_* families and "
+                    "Perfetto flow chains in --trace-out; shorthand for "
+                    "spec.telemetry_journeys=N (needs --telemetry)")
     ap.add_argument("--serve", type=int, metavar="PORT", default=None,
                     help="live health plane (telemetry/live.py): run "
                     "the horizon in chunks behind an OpenMetrics pull "
@@ -309,6 +318,34 @@ def main(argv=None) -> int:
                  "grids own their replica fan-out — run chaos worlds "
                  "without --sweep")
 
+    # ---- journey guard rails (ISSUE 15) -------------------------------
+    if args.journeys is not None:
+        if args.journeys < 1:
+            print(
+                f"error: --journeys samples N >= 1 task slots, got "
+                f"{args.journeys} (omit the flag to disable the "
+                "journey plane)",
+                file=sys.stderr,
+            )
+            return 2
+        if not (
+            args.telemetry
+            or args.hist
+            or args.serve is not None
+            or args.slo is not None
+        ):
+            print(
+                "error: --journeys rides the device-resident telemetry "
+                "plane (the event rings live in TelemetryState); add "
+                "--telemetry (or --serve/--hist)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.tp is not None:
+            ap.error("--journeys traces single-world event rings; the "
+                     "TP sharded tick does not carry them yet — run "
+                     "journey worlds without --tp")
+
     text = ""
     if args.config:
         with open(args.config) as f:
@@ -377,6 +414,8 @@ def main(argv=None) -> int:
     if args.hist or args.slo is not None:
         pre.append("spec.telemetry = true")
         pre.append("spec.telemetry_hist = true")
+    if args.journeys is not None:
+        pre.append(f"spec.telemetry_journeys = {args.journeys}")
     cfg = Config.from_str("\n".join(pre) + "\n" + text)
 
     if args.sweep:

@@ -209,6 +209,13 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             when=lambda sp: sp.learn_active,
         ),
         PhaseContract(
+            "_phase_journeys",
+            lambda sp, s, n, c, b, t0, t1: E._phase_journeys(
+                sp, s, n, c, b, t1
+            ),
+            when=lambda sp: sp.journey_active,
+        ),
+        PhaseContract(
             "_phase_telemetry",
             lambda sp, s, n, c, b, t0, t1: E._phase_telemetry(
                 sp, s, n, c, b, t1
@@ -338,6 +345,7 @@ def check_telemetry_contract(spec: WorldSpec, state) -> None:
     the whole WorldState structure, or the scan carry would mismatch /
     silently recompile mid-run.
     """
+    from ..telemetry.journeys import J_COLS
     from ..telemetry.metrics import EXG_OCC_BINS, PHASES, RES_FIELDS
 
     t = state.telem
@@ -373,6 +381,14 @@ def check_telemetry_contract(spec: WorldSpec, state) -> None:
             R if spec.telemetry_hier_brokers else 0,
             spec.telemetry_hier_brokers,
         ),
+        # causal task-journey rings (ISSUE 15): zero-row unless the
+        # spec.telemetry_journeys gate is on — its OWN gate, nested
+        # inside spec.telemetry like the hist/TP/hier gates
+        "j_task": (spec.journey_slots,),
+        "j_prev": (spec.journey_slots, len(J_COLS)),
+        "j_ring": (spec.journey_slots, spec.journey_ring, 4),
+        "j_cursor": (spec.journey_slots,),
+        "j_dropped": (),
     }
     for name, shape in expect.items():
         got = tuple(getattr(t, name).shape)

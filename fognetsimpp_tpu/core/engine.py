@@ -168,6 +168,16 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
         # same subsystem-first ordering as chaos: ONE message source
         # (hier/federation.hier_reject_reason) shared with the fleet gate
         return hier_reject_reason(spec, "TP")
+    if spec.journey_active:
+        # journeys ride the single-device tap (the fleet vmap carries
+        # them; the sharded tick would need shard-local rings with a
+        # per-shard ownership fold — the chaos/hier follow-up pattern)
+        return (
+            "TP tick does not carry the task-journey event rings yet "
+            "(shard-local rings need a per-shard ownership fold); run "
+            "journey worlds on single-device run/run_jit/run_chunked "
+            "or the fleet runner"
+        )
     if spec.fog_model != int(FogModel.FIFO):
         return "TP tick covers FIFO fogs only (POOL pools are sequential)"
     if not _broker_dense_ok(spec):
@@ -3299,6 +3309,34 @@ def _phase_latency_hist(
     return state.replace(telem=telem), buf
 
 
+def _phase_journeys(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t1: jax.Array,
+) -> Tuple[WorldState, TickBuf]:
+    """Causal task-journey tap (telemetry/journeys.py, ISSUE 15).
+
+    Diffs each sampled task's packed row against the previous tick's
+    snapshot and appends one ``(t_bits, code, a, b)`` event per
+    lifecycle edge to its bounded ring in :class:`TelemetryState` —
+    J-sized gathers plus one drop-scatter, nothing task-capacity-sized.
+    Runs LAST among the task-mutating phases (after the fused write
+    set has flushed, after learn credit), so one diff observes the
+    whole tick's causal chain with each edge stamped from its own
+    exact event-time column.  Statically gated on
+    ``spec.journey_active``: journey-off worlds trace none of this and
+    stay bit-exact (tests/test_journeys.py).  Pure carry endomorphism,
+    so it rides the scan and the fleet ``vmap`` unchanged.
+    """
+    from ..telemetry.journeys import journey_tick
+
+    telem = journey_tick(
+        spec, state.telem, state.tasks, t1,
+        chaos=state.chaos if spec.chaos else None,
+        hier=state.hier if spec.hier_active else None,
+    )
+    return state.replace(telem=telem), buf
+
+
 def _phase_telemetry(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t1: jax.Array,
@@ -3809,6 +3847,17 @@ def make_step(
             state = state.replace(
                 nodes=state.nodes.replace(energy=energy, alive=alive)
             )
+
+        # 8b. journey tap (spec.telemetry_journeys): diff the sampled
+        # tasks' rows against last tick's snapshot and append this
+        # tick's lifecycle edges to the per-task rings — after every
+        # task-mutating phase (and the fused flush), before the
+        # telemetry fold
+        if spec.journey_active:
+            with jax.named_scope("phase_journeys"):
+                state, buf = _phase_journeys(
+                    spec, state, net, cache, buf, t1
+                )
 
         # 9. plane-1 telemetry accumulation (after every phase booked
         # its work; before the tick counter advances so the reservoir

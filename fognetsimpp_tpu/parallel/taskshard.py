@@ -274,6 +274,16 @@ def pad_users_to_multiple(
                 )
             )
         )
+    # journey rings (ISSUE 15) survive padding UNCHANGED by design:
+    # the leaves are J-sized (never task-capacity-sized), the sampled
+    # task ids keep addressing the same (user, send) slots because
+    # ghost task rows append at the END of the table, and ghost rows
+    # stay UNUSED forever so the per-tick diff can never fire on them.
+    # dynspec.bucket_spec relies on this — a bucketed journey world
+    # keeps its original sample (tests/test_journeys.py pins it).  The
+    # TP runner itself still gates journeys off (tp_reject_reason):
+    # shard-local rings need a per-shard ownership fold, the chaos/hier
+    # follow-up pattern.
     _ = f32  # (dtype alias kept for symmetry with init_state)
     return spec2, state2, net2
 

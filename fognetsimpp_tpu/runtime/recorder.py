@@ -296,6 +296,12 @@ def record_run(
         }
     else:
         hier_sca = None
+    if spec.journey_active:
+        from ..telemetry.journeys import journey_summary
+
+        journey_sca = journey_summary(spec, final)
+    else:
+        journey_sca = None
     sca = {
         "run": run_id,
         "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -315,6 +321,15 @@ def record_run(
         # same hier_summary() dict the fns_hier_* exposition and the
         # Perfetto broker lanes read, so the outputs cannot drift
         **({"hier": hier_sca} if hier_sca is not None else {}),
+        # causal task-journey section (spec.telemetry_journeys, ISSUE
+        # 15): the same journey_summary() dict the fns_journey_*
+        # exposition and the Perfetto journey lanes read — per-task
+        # decoded event chains included (J and the ring bound it)
+        **(
+            {"journeys": journey_sca}
+            if journey_sca is not None
+            else {}
+        ),
         # global latency-histogram roll-up (spec.telemetry_hist): the
         # quantiles are hist_summary()'s — identical to the OpenMetrics
         # quantile gauges by construction

@@ -638,6 +638,26 @@ class WorldSpec:
     telemetry_hist_bins: int = 24
     telemetry_hist_min_ms: float = 0.1  # lowest finite bucket edge
     telemetry_hist_max_ms: float = 10_000.0  # highest finite bucket edge
+    # --- causal task-journey tracing (telemetry/journeys.py) -----------
+    # Sample J task slots (a deterministic hash-select from the WORLD
+    # key — folded, never split, so enabling journeys perturbs no draw
+    # of the main simulation stream) and carry one bounded event ring
+    # per sampled task in TelemetryState: every lifecycle edge an
+    # engine phase produces for a sampled task (spawn, broker decide,
+    # broker→broker migration hop, chaos re-offload / crash loss, fog
+    # enqueue, service start, terminal) appends one packed
+    # ``(t_bits, code, a, b)`` i32 row.  0 (the default) keeps every
+    # journey leaf zero-row and the run bit-exact vs the journey-less
+    # engine — the inert-LearnState gate discipline
+    # (tests/test_journeys.py A/Bs it).  Requires spec.telemetry.
+    telemetry_journeys: int = 0
+    # Ring rows per sampled task.  Overflow keeps drop-OLDEST
+    # semantics: the append cursor wraps, so the ring always holds the
+    # LAST `telemetry_journey_ring` events of the task's journey (the
+    # flight-recorder question is "what was it doing most recently"),
+    # and overwritten rows are counted in the ``journeys_dropped``
+    # scalar.
+    telemetry_journey_ring: int = 64
     # --- distributed observability (ISSUE 11) --------------------------
     # Shard count of the TP (task-table-sharded) world view this spec
     # describes: 0 for unsharded worlds; run_tp_sharded stamps the mesh
@@ -834,6 +854,28 @@ class WorldSpec:
             else 0
         )
 
+    # --- journey sizing (zero-row when the plane is off) ---------------
+    @property
+    def journey_active(self) -> bool:
+        """Whether the task-journey event rings are live.  Static under
+        jit: it gates whether the engine traces the per-tick journey
+        tap at all, so journey-off worlds stay bit-exact (the
+        inert-LearnState discipline, tests/test_journeys.py)."""
+        return self.telemetry and self.telemetry_journeys > 0
+
+    @property
+    def journey_slots(self) -> int:
+        """Rows of the per-sampled-task journey leaves (ring, cursor,
+        previous-snapshot): J when the plane is on, zero otherwise."""
+        if not self.journey_active:
+            return 0
+        return min(self.telemetry_journeys, self.task_capacity)
+
+    @property
+    def journey_ring(self) -> int:
+        """Event rows of each sampled task's ring (0 when off)."""
+        return self.telemetry_journey_ring if self.journey_active else 0
+
     @property
     def telemetry_tp_shards(self) -> int:
         """Rows of the per-shard TP exchange-plane telemetry leaves
@@ -893,6 +935,35 @@ class WorldSpec:
                 "inside the tick; derive_acks reconstructs the ack "
                 "columns only after the scan"
             )
+        # --- journey tracing (ValueError: user-reachable knobs) --------
+        if self.telemetry_journeys < 0:
+            raise ValueError(
+                f"telemetry_journeys is a sampled-task count (>= 0), "
+                f"got {self.telemetry_journeys}"
+            )
+        if self.telemetry_journeys > 0:
+            if not self.telemetry:
+                raise ValueError(
+                    "telemetry_journeys rides TelemetryState in the "
+                    "scan carry: set spec.telemetry=True as well"
+                )
+            if self.telemetry_journeys > self.task_capacity:
+                raise ValueError(
+                    f"telemetry_journeys={self.telemetry_journeys} "
+                    f"exceeds the task capacity "
+                    f"{self.task_capacity}: there are not that many "
+                    "task slots to sample"
+                )
+            if self.telemetry_journey_ring < 8:
+                raise ValueError(
+                    "telemetry_journey_ring needs >= 8 event rows per "
+                    "sampled task: one tick can append up to 8 edges "
+                    "(spawn, re-offload, migrate, decide, local, "
+                    "enqueue, service start, terminal), and a ring "
+                    "smaller than one tick's worth would wrap WITHIN "
+                    "the tick's scatter (duplicate-index order is "
+                    "undefined)"
+                )
         if self.chaos:
             # ValueError (not assert) on the user-reachable knobs: the
             # CLI/config tier surfaces these as one actionable line

@@ -387,5 +387,8 @@ def init_state(spec: WorldSpec, key: Optional[jax.Array] = None) -> WorldState:
         # enabling it perturbs no draw of the main simulation stream
         chaos=init_chaos_state(spec, key),
         hier=init_hier_state(spec),
-        telem=init_telemetry_state(spec),
+        # the journey sample is FOLDED from the world key (never
+        # split), the chaos-stream discipline: enabling journeys
+        # perturbs no draw of the main simulation stream
+        telem=init_telemetry_state(spec, key),
     )

@@ -1,0 +1,594 @@
+"""Causal task-journey tracing: device-resident sampled event rings.
+
+The reference gets per-task causality for free — every OMNeT++
+``cMessage`` is a live object whose hops are observable end-to-end
+(iFogSim-class debuggability, arXiv:1606.02007) and FogMQ-style broker
+federations make the cross-broker message journey the unit of analysis
+(arXiv:1610.00620).  Our Perfetto plane reconstructs spans post-run
+from the FINAL task table, so every restamping phase — the chaos
+re-offload bounce, ``_phase_broker_migrate``'s ``t_at_broker`` advance,
+a TP exchange defer — overwrites the intermediate history and the
+rendered trace silently lies about what actually happened.
+
+This module is the journey plane that fixes it:
+
+* **Sampling**: ``spec.telemetry_journeys = J`` hash-selects J task
+  slots from the WORLD key (:func:`journey_sample_ids` — threefry
+  *folded*, never split, so enabling journeys perturbs no draw of the
+  main simulation stream, the chaos-key discipline).
+* **Rings**: each sampled task owns a bounded
+  ``(spec.telemetry_journey_ring, 4)`` i32 event ring riding
+  :class:`~fognetsimpp_tpu.telemetry.metrics.TelemetryState` in the
+  scan carry (``j_ring``), with a per-slot append cursor and
+  drop-OLDEST overflow (the cursor wraps; overwrites are counted in
+  the ``j_dropped`` scalar) — the ring always holds the LAST R events,
+  which is the flight-recorder question ("what was task 4711 doing
+  when the watchdog paged").
+* **Taps**: once per tick, after every phase has run (and the fused
+  write set has flushed), the engine's ``_phase_journeys`` diffs each
+  sampled task's packed row against the previous tick's snapshot
+  (``j_prev``) and appends one packed ``(t_bits, code, a, b)`` row per
+  lifecycle edge — spawn, chaos re-offload, broker→broker migration
+  hop, broker decide, fog enqueue, service start and every terminal.
+  Event times are the EXACT event-time columns of the task table
+  (f32 bit patterns via ``bitcast_convert_type``), not tick-quantised;
+  the per-tick diff only controls when an edge is *observed*, exactly
+  the engine's own staleness contract.
+* **Determinism**: :func:`journey_edges` is ONE array-module-generic
+  rule set — the jitted tap calls it with ``jnp``, the host replay
+  (:func:`replay_tick`) with ``numpy`` — so the device-decoded chain
+  can be bit-compared against a host replay of the same schedule
+  (tests/test_journeys.py drives the real step tick-by-tick and
+  asserts event-for-event equality).
+
+Everything is spec-gated with the inert-LearnState discipline: when
+``spec.journey_active`` is off every journey leaf has zero rows and no
+journey code is traced, so journey-off worlds are bit-exact vs the
+journey-less engine on every entry point.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..spec import Stage, WorldSpec
+
+#: Domain separator folded into the world key to derive the journey
+#: sample (the chaos `_CHAOS_FOLD` discipline: folded, never split).
+_JOURNEY_FOLD = 0x10A7
+
+#: Columns of one packed per-task snapshot row (all i32; the time
+#: columns are f32 bit patterns).  Shared by the device tap, the host
+#: replay and every decoder — indices below are load-bearing.
+J_COLS = (
+    "stage",            # 0
+    "fog",              # 1
+    "broker",           # 2  hier task_broker (0 when hier off; -1 = init)
+    "hops",             # 3  hier migration hop count
+    "retry",            # 4  chaos re-offload count
+    "t_create",         # 5
+    "t_at_broker",      # 6
+    "t_at_fog",         # 7
+    "t_q_enter",        # 8
+    "t_service_start",  # 9
+    "t_complete",       # 10
+)
+
+#: f32 +inf bit pattern: the "not yet stamped" sentinel of every time
+#: column (the task table never stores NaN — state.py's init note).
+INF_BITS = int(np.float32(np.inf).view(np.int32))
+
+
+class JourneyEvent(enum.IntEnum):
+    """Lifecycle edge codes of one packed ring row.
+
+    Operand conventions (``a``/``b`` of the ``(t_bits, code, a, b)``
+    row) are documented per code; -1 means "not applicable".
+    """
+
+    SPAWN = 1          # a=user, b=send index k (slot = u*S + k)
+    REOFFLOAD = 2      # chaos bounce: a=crashed fog, b=retry count
+    MIGRATE = 3        # broker→broker hop: a=src broker, b=dst broker
+    DECIDE = 4         # a=chosen fog, b=owning broker
+    LOCAL_RUN = 5      # v1 broker-local accept: a=-1
+    ENQUEUE = 6        # a=fog
+    SVC_START = 7      # a=fog
+    DONE = 8           # terminal: a=fog
+    NO_RESOURCE = 9    # terminal: a=broker
+    REJECTED = 10      # terminal: a=fog (pool reject / v1 unsendable)
+    DROPPED = 11       # terminal: a=fog (queue overflow)
+    LOST = 12          # terminal: uplink/link loss, a=-1
+    CRASH_LOST = 13    # terminal: LOSE-mode crash, a=crashed fog
+    RETRY_EXHAUST = 14  # terminal: a=crashed fog, b=retry count
+    HOP_EXHAUSTED = 15  # terminal: a=broker, b=hop count
+
+
+EVENT_NAMES: Dict[int, str] = {
+    int(e): e.name.lower() for e in JourneyEvent
+}
+
+#: Codes that end a journey (the terminal census buckets).
+TERMINAL_EVENTS = frozenset(
+    int(e)
+    for e in (
+        JourneyEvent.DONE,
+        JourneyEvent.NO_RESOURCE,
+        JourneyEvent.REJECTED,
+        JourneyEvent.DROPPED,
+        JourneyEvent.LOST,
+        JourneyEvent.CRASH_LOST,
+        JourneyEvent.RETRY_EXHAUST,
+        JourneyEvent.HOP_EXHAUSTED,
+    )
+)
+
+#: Events handled at a broker (Perfetto broker-lane placement); the
+#: rest land on the handling fog's lane.
+BROKER_SIDE_EVENTS = frozenset(
+    int(e)
+    for e in (
+        JourneyEvent.SPAWN,
+        JourneyEvent.REOFFLOAD,
+        JourneyEvent.MIGRATE,
+        JourneyEvent.DECIDE,
+        JourneyEvent.LOCAL_RUN,
+        JourneyEvent.NO_RESOURCE,
+        JourneyEvent.LOST,
+        JourneyEvent.HOP_EXHAUSTED,
+    )
+)
+
+
+# ----------------------------------------------------------------------
+# sampling + init (zero-row when the plane is off)
+# ----------------------------------------------------------------------
+
+def journey_sample_ids(spec: WorldSpec, key: jax.Array) -> jax.Array:
+    """The J sampled task ids for ``spec`` on world key ``key``.
+
+    A deterministic hash-select: the journey stream is threefry-FOLDED
+    from the world key (never split), so the selection is a pure
+    function of (key, J) and the main simulation stream is untouched —
+    host tooling can re-derive the sample exactly.  Sorted ascending
+    for stable slot order.
+    """
+    jkey = jax.random.fold_in(key, _JOURNEY_FOLD)
+    ids = jax.random.choice(
+        jkey, spec.task_capacity, (spec.journey_slots,), replace=False
+    )
+    return jnp.sort(ids.astype(jnp.int32))
+
+
+def _init_prev_row() -> np.ndarray:
+    """The pre-first-tick snapshot row: an UNUSED task with no fog, an
+    UNKNOWN owning broker (-1: `stamp_ownership` may restamp domains
+    after state init, so the first tick learns the real owner from the
+    live table) and every time column at +inf."""
+    return np.asarray(
+        [int(Stage.UNUSED), -1, -1, 0, 0] + [INF_BITS] * 6, np.int32
+    )
+
+
+def init_journey_leaves(
+    spec: WorldSpec, key: Optional[jax.Array] = None
+) -> Dict[str, jax.Array]:
+    """The t=0 journey leaves for ``spec`` (zero-row when off)."""
+    J, R, NC = spec.journey_slots, spec.journey_ring, len(J_COLS)
+    i32 = jnp.int32
+    if J:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        j_task = journey_sample_ids(spec, key)
+        j_prev = jnp.tile(jnp.asarray(_init_prev_row()), (J, 1))
+    else:
+        j_task = jnp.zeros((0,), i32)
+        j_prev = jnp.zeros((0, NC), i32)
+    return dict(
+        j_task=j_task,
+        j_prev=j_prev,
+        j_ring=jnp.zeros((J, R, 4), i32),
+        j_cursor=jnp.zeros((J,), i32),
+        j_dropped=jnp.zeros((), i32),
+    )
+
+
+# ----------------------------------------------------------------------
+# the per-tick tap (device; also reused eagerly by the host replay)
+# ----------------------------------------------------------------------
+
+def snapshot_rows(
+    spec: WorldSpec, tasks, chaos, hier, ids: jax.Array
+) -> jax.Array:
+    """Gather the sampled tasks' packed ``(J, len(J_COLS))`` i32 rows.
+
+    J-sized gathers only — the tap never materialises a task-capacity
+    intermediate.  Time columns become exact f32 bit patterns.
+    """
+    i32 = jnp.int32
+    J = ids.shape[0]
+
+    def bits(col):
+        return jax.lax.bitcast_convert_type(
+            col[ids].astype(jnp.float32), i32
+        )
+
+    if spec.hier_active:
+        brk = hier.task_broker[ids].astype(i32)
+        hop = hier.hops[ids].astype(i32)
+    else:
+        brk = jnp.zeros((J,), i32)
+        hop = jnp.zeros((J,), i32)
+    if spec.chaos and chaos.retry.shape[0]:
+        rty = chaos.retry[ids].astype(i32)
+    else:
+        rty = jnp.zeros((J,), i32)
+    return jnp.stack(
+        [
+            tasks.stage[ids].astype(i32),
+            tasks.fog[ids].astype(i32),
+            brk,
+            hop,
+            rty,
+            bits(tasks.t_create),
+            bits(tasks.t_at_broker),
+            bits(tasks.t_at_fog),
+            bits(tasks.t_q_enter),
+            bits(tasks.t_service_start),
+            bits(tasks.t_complete),
+        ],
+        axis=1,
+    )
+
+
+def journey_edges(xp, prev, cur, users, sends, t1_bits):
+    """Synthesise this tick's lifecycle edges from two snapshots.
+
+    ONE rule set, generic over the array module: the jitted tap passes
+    ``jnp``, the host replay passes ``numpy`` — so device and host can
+    never drift (the bit-match test's backbone).  ``prev``/``cur`` are
+    ``(J, len(J_COLS))`` i32; returns five ``(J, E)`` arrays
+    ``(valid, code, t_bits, a, b)`` with the E=8 candidate slots in
+    canonical causal order: spawn, re-offload, migrate, decide, local,
+    enqueue, service start, terminal.
+    """
+    i32 = np.int32
+    st_p, st_c = prev[:, 0], cur[:, 0]
+    fog_p, fog_c = prev[:, 1], cur[:, 1]
+    brk_p, brk_c = prev[:, 2], cur[:, 2]
+    rty_c = cur[:, 4]
+    tc, tb, tf = cur[:, 5], cur[:, 6], cur[:, 7]
+    tq, ts, td = cur[:, 8], cur[:, 9], cur[:, 10]
+    inf = i32(INF_BITS)
+    neg1 = xp.full_like(st_c, i32(-1))
+    zero = xp.zeros_like(st_c)
+
+    def st(v):
+        return i32(int(v))
+
+    # --- edge predicates (each fires at most once per tick per task) --
+    spawn = (st_p == st(Stage.UNUSED)) & (st_c != st(Stage.UNUSED))
+    rty_delta = rty_c > prev[:, 4]
+    reoff = rty_delta & (st_c != st(Stage.LOST))
+    mig = cur[:, 3] > prev[:, 3]  # hop-count delta: exact migrate mark
+    decide = (fog_c >= 0) & ((fog_c != fog_p) | (tf != prev[:, 7]))
+    local = (st_c == st(Stage.LOCAL_RUN)) & (
+        st_p != st(Stage.LOCAL_RUN)
+    )
+    enq = (tq != prev[:, 8]) & (tq != inf)
+    svc = (ts != prev[:, 9]) & (ts != inf)
+    changed = st_c != st_p
+    was_on_fog = (
+        (st_p == st(Stage.TASK_INFLIGHT))
+        | (st_p == st(Stage.QUEUED))
+        | (st_p == st(Stage.RUNNING))
+    )
+    lost = changed & (st_c == st(Stage.LOST))
+    is_done = changed & (st_c == st(Stage.DONE))
+    is_nores = changed & (st_c == st(Stage.NO_RESOURCE))
+    is_rej = changed & (st_c == st(Stage.REJECTED))
+    is_drop = changed & (st_c == st(Stage.DROPPED))
+    is_hopx = changed & (st_c == st(Stage.HOP_EXHAUSTED))
+    is_retryx = lost & rty_delta
+    is_crash = lost & ~rty_delta & was_on_fog
+    # (plain uplink/link loss — lost & ~rty_delta & ~was_on_fog — is
+    # term_code's sel default below, so it needs no mask of its own)
+    term = (
+        is_done | is_nores | is_rej | is_drop | is_hopx | lost
+    )
+
+    # --- terminal code / time / operand selection ---------------------
+    def sel(pairs, default):
+        out = default
+        for mask, val in reversed(pairs):
+            out = xp.where(mask, val, out)
+        return out
+
+    ev = JourneyEvent
+    term_code = sel(
+        [
+            (is_done, i32(int(ev.DONE))),
+            (is_nores, i32(int(ev.NO_RESOURCE))),
+            (is_rej, i32(int(ev.REJECTED))),
+            (is_drop, i32(int(ev.DROPPED))),
+            (is_hopx, i32(int(ev.HOP_EXHAUSTED))),
+            (is_retryx, i32(int(ev.RETRY_EXHAUST))),
+            (is_crash, i32(int(ev.CRASH_LOST))),
+        ],
+        xp.full_like(st_c, i32(int(ev.LOST))),
+    )
+    tf_or_tb = xp.where(tf != inf, tf, tb)
+    term_t = sel(
+        [
+            (is_done, td),
+            (is_nores | is_hopx, tb),
+            (is_rej | is_drop, tf_or_tb),
+            # crash edges carry no exact time column (the sweep wiped
+            # them): stamp the observing tick's end — the host replay
+            # applies the identical rule
+            (is_retryx | is_crash, xp.full_like(st_c, t1_bits)),
+        ],
+        tc,  # plain uplink/link loss: the publish creation time
+    )
+    term_a = sel(
+        [
+            (is_done | is_rej | is_drop | is_retryx | is_crash, fog_c),
+            (is_nores | is_hopx, brk_c),
+        ],
+        neg1,
+    )
+    term_b = sel(
+        [(is_hopx, cur[:, 3]), (is_retryx, rty_c)], zero
+    )
+
+    stack = lambda cols: xp.stack(cols, axis=1)  # noqa: E731
+    valid = stack([spawn, reoff, mig, decide, local, enq, svc, term])
+    code = stack(
+        [
+            xp.full_like(st_c, i32(int(ev.SPAWN))),
+            xp.full_like(st_c, i32(int(ev.REOFFLOAD))),
+            xp.full_like(st_c, i32(int(ev.MIGRATE))),
+            xp.full_like(st_c, i32(int(ev.DECIDE))),
+            xp.full_like(st_c, i32(int(ev.LOCAL_RUN))),
+            xp.full_like(st_c, i32(int(ev.ENQUEUE))),
+            xp.full_like(st_c, i32(int(ev.SVC_START))),
+            term_code,
+        ]
+    )
+    t_bits = stack([tc, tb, tb, tb, tb, tq, ts, term_t])
+    a = stack([users, fog_p, brk_p, fog_c, neg1, fog_c, fog_c, term_a])
+    b = stack([sends, rty_c, brk_c, brk_c, zero, zero, zero, term_b])
+    return valid, code, t_bits, a, b
+
+
+def journey_tick(
+    spec: WorldSpec, telem, tasks, t1: jax.Array, chaos=None, hier=None
+):
+    """Fold one finished tick into the journey rings (device).
+
+    Pure function of its arguments and a TelemetryState endomorphism —
+    scan-carry safe, ``vmap``s over the fleet replica axis unchanged.
+    Only traced when ``spec.journey_active``.  Appends every edge the
+    snapshot diff surfaces via the established drop-scatter idiom
+    (invalid candidates target row J and fall off under
+    ``mode="drop"``); the cursor wraps for drop-oldest overflow, with
+    overwrites counted in ``j_dropped``.
+    """
+    J, R = spec.journey_slots, spec.journey_ring
+    i32 = jnp.int32
+    S = spec.max_sends_per_user
+    ids = telem.j_task
+    cur = snapshot_rows(spec, tasks, chaos, hier, ids)
+    t1_bits = jax.lax.bitcast_convert_type(t1.astype(jnp.float32), i32)
+    valid, code, t_bits, a, b = journey_edges(
+        jnp, telem.j_prev, cur, ids // S, ids % S, t1_bits
+    )
+    vi = valid.astype(i32)
+    # per-slot append positions: cursor + in-tick offset, ring-wrapped
+    off = jnp.cumsum(vi, axis=1) - 1
+    pos = (telem.j_cursor[:, None] + jnp.maximum(off, 0)) % R
+    slot = jnp.where(valid, jnp.arange(J, dtype=i32)[:, None], J)
+    rows = jnp.stack([t_bits, code, a, b], axis=-1).astype(i32)
+    ring = telem.j_ring.at[slot, pos].set(rows, mode="drop")
+    n_new = jnp.sum(vi, axis=1)
+    cursor = telem.j_cursor + n_new
+    # drop-oldest accounting: appends that landed on a live row
+    over = jnp.sum(
+        jnp.maximum(cursor - R, 0) - jnp.maximum(telem.j_cursor - R, 0)
+    )
+    return telem.replace(
+        j_prev=cur,
+        j_ring=ring,
+        j_cursor=cursor,
+        j_dropped=telem.j_dropped + over,
+    )
+
+
+# ----------------------------------------------------------------------
+# host replay (the determinism oracle; numpy, no tracing)
+# ----------------------------------------------------------------------
+
+def replay_tick(
+    spec: WorldSpec,
+    prev: np.ndarray,
+    cur: np.ndarray,
+    ids: np.ndarray,
+    t1: float,
+) -> List[List[Dict]]:
+    """Host twin of one :func:`journey_tick` diff.
+
+    ``prev``/``cur`` are host ``(J, len(J_COLS))`` i32 snapshots (e.g.
+    ``np.asarray(snapshot_rows(...))`` of two consecutive tick states);
+    returns, per slot, this tick's decoded events in append order —
+    the SAME :func:`journey_edges` rule set the device tap traces, so
+    a mismatch against the device-decoded ring is a tap bug, never a
+    rule drift.
+    """
+    S = spec.max_sends_per_user
+    ids = np.asarray(ids, np.int64)
+    t1_bits = int(np.float32(t1).view(np.int32))
+    valid, code, t_bits, a, b = journey_edges(
+        np,
+        np.asarray(prev, np.int32),
+        np.asarray(cur, np.int32),
+        (ids // S).astype(np.int32),
+        (ids % S).astype(np.int32),
+        np.int32(t1_bits),
+    )
+    out: List[List[Dict]] = []
+    for j in range(valid.shape[0]):
+        evs = []
+        for e in range(valid.shape[1]):
+            if valid[j, e]:
+                evs.append(
+                    _event_dict(
+                        int(t_bits[j, e]), int(code[j, e]),
+                        int(a[j, e]), int(b[j, e]),
+                    )
+                )
+        out.append(evs)
+    return out
+
+
+# ----------------------------------------------------------------------
+# host-side readers (post-run; one fetch each)
+# ----------------------------------------------------------------------
+
+def _bits_to_time(bits: int) -> float:
+    return float(np.int32(bits).view(np.float32))
+
+
+def _event_dict(t_bits: int, code: int, a: int, b: int) -> Dict:
+    return {
+        "t": _bits_to_time(t_bits),
+        "code": int(code),
+        "name": EVENT_NAMES.get(int(code), f"code{code}"),
+        "a": int(a),
+        "b": int(b),
+    }
+
+
+def decode_rings(spec: WorldSpec, final) -> List[Dict]:
+    """Decode every sampled task's ring into its event list (in causal
+    append order; drop-oldest wrap resolved).  One host fetch."""
+    t = final.telem
+    J = t.j_task.shape[0]
+    if J == 0:
+        return []
+    ids = np.asarray(t.j_task, np.int64)
+    cursor = np.asarray(t.j_cursor, np.int64)
+    ring = np.asarray(t.j_ring, np.int64)
+    R = ring.shape[1]
+    S = spec.max_sends_per_user
+    out = []
+    for j in range(J):
+        n = int(cursor[j])
+        if n <= R:
+            order = range(n)
+        else:
+            # cursor wrapped: the oldest retained row sits at n % R
+            order = ((n + k) % R for k in range(R))
+        events = [
+            _event_dict(*(int(x) for x in ring[j, k])) for k in order
+        ]
+        out.append(
+            {
+                "task": int(ids[j]),
+                "user": int(ids[j]) // S,
+                "send": int(ids[j]) % S,
+                "events_total": n,
+                "dropped": max(0, n - R),
+                "events": events,
+            }
+        )
+    return out
+
+
+def journey_summary(spec: WorldSpec, final) -> Optional[Dict]:
+    """Host roll-up of a finished journey-on run (None when off).
+
+    THE values every exposition publishes — the recorder's
+    ``.sca.json`` ``journeys`` section, the ``fns_journey_*``
+    OpenMetrics families, the Perfetto journey lanes and the
+    flight-recorder bundles all read this one dict (the
+    ``busy_fractions`` single-source discipline).
+    """
+    if not spec.journey_active:
+        return None
+    t = final.telem
+    if t.j_task.shape[0] == 0:
+        return None
+    decoded = decode_rings(spec, final)
+    terminal: Dict[str, int] = {}
+    in_flight = 0
+    untouched = 0
+    for d in decoded:
+        if not d["events"]:
+            untouched += 1
+            continue
+        last = d["events"][-1]
+        if last["code"] in TERMINAL_EVENTS:
+            terminal[last["name"]] = terminal.get(last["name"], 0) + 1
+        else:
+            in_flight += 1
+    return {
+        "sampled": len(decoded),
+        "ring": int(spec.journey_ring),
+        "events_total": int(np.asarray(t.j_cursor).sum()),
+        "dropped_total": int(np.asarray(t.j_dropped)),
+        "terminal": dict(sorted(terminal.items())),
+        "in_flight": in_flight,
+        "unspawned": untouched,
+        "tasks": decoded,
+    }
+
+
+def snapshot_rings(final) -> Optional[Dict]:
+    """JSON-safe raw ring snapshot for flight-recorder bundles.
+
+    Raw ``(t_bits, code, a, b)`` rows (plain ints) plus cursors — the
+    bundle stays loadable by :func:`rings_from_snapshot` without the
+    spec, so ``tools/postmortem.py`` can decode a crash dump from the
+    manifest alone (pre-journey bundles simply lack the key: the
+    ``.get``-safe contract).
+    """
+    t = getattr(final, "telem", None)
+    if t is None or t.j_task.shape[0] == 0:
+        return None
+    return {
+        "task": [int(x) for x in np.asarray(t.j_task)],
+        "cursor": [int(x) for x in np.asarray(t.j_cursor)],
+        "dropped": int(np.asarray(t.j_dropped)),
+        "ring": np.asarray(t.j_ring, np.int64).tolist(),
+    }
+
+
+def rings_from_snapshot(snap: Dict) -> List[Dict]:
+    """Decode a :func:`snapshot_rings` bundle (postmortem's reader)."""
+    out = []
+    tasks = snap.get("task") or []
+    cursor = snap.get("cursor") or []
+    ring = snap.get("ring") or []
+    for j, task in enumerate(tasks):
+        n = int(cursor[j]) if j < len(cursor) else 0
+        rows = ring[j] if j < len(ring) else []
+        R = len(rows)
+        if n <= R:
+            order = range(n)
+        else:
+            order = ((n + k) % R for k in range(R))
+        out.append(
+            {
+                "task": int(task),
+                "events_total": n,
+                "dropped": max(0, n - R) if R else n,
+                "events": [
+                    _event_dict(*(int(x) for x in rows[k]))
+                    for k in order
+                ],
+            }
+        )
+    return out

@@ -347,6 +347,25 @@ class FlightRecorder:
             from ..chaos.faults import chaos_summary
 
             manifest["chaos"] = chaos_summary(spec, final)
+        if (
+            spec is not None
+            and final is not None
+            and getattr(spec, "journey_active", False)
+        ):
+            # journey rings ride the bundle RAW (ISSUE 15): the decode
+            # needs no spec, so tools/postmortem.py can print "what was
+            # task 4711 doing when the watchdog paged" from the
+            # manifest alone; pre-journey bundles simply lack the key
+            # (the .get-safe contract)
+            from .journeys import snapshot_rings
+
+            rings = snapshot_rings(final)
+            if rings is not None:
+                manifest["journeys"] = {
+                    "sampled": len(rings["task"]),
+                    "dropped_total": rings["dropped"],
+                    "rings": rings,
+                }
         if spec is not None and final is not None:
             from .health import hist_summary
 

@@ -162,10 +162,33 @@ class TelemetryState:
     #   over ticks (mean = / ticks; the fns_hier_load gauge)
     hier_load_res: jax.Array  # (Rm, Bm) f32 strided per-tick per-broker
     #   load rows (same stride as `res`): the Perfetto broker lanes
+    # --- causal task-journey rings (spec.telemetry_journeys, ISSUE 15)
+    # Per-sampled-task bounded event rings, appended by the engine's
+    # end-of-tick journey tap (telemetry/journeys.journey_tick).  All
+    # leaves are zero-row unless spec.journey_active; j_dropped is a
+    # scalar and stays exactly zero on journey-off worlds.
+    j_task: jax.Array  # (Jm,) i32 sampled task ids (sorted; the
+    #   deterministic hash-select from the world key)
+    j_prev: jax.Array  # (Jm, len(journeys.J_COLS)) i32 previous
+    #   end-of-tick snapshot rows the per-tick diff runs against
+    j_ring: jax.Array  # (Jm, Rj, 4) i32 packed (t_bits, code, a, b)
+    #   event rows; drop-oldest wrap via j_cursor
+    j_cursor: jax.Array  # (Jm,) i32 total events appended per slot
+    j_dropped: jax.Array  # () i32 ring rows overwritten (drop-oldest)
 
 
-def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
-    """The t=0 telemetry state for ``spec`` (zero-row when off)."""
+def init_telemetry_state(
+    spec: WorldSpec, key: Optional[jax.Array] = None
+) -> TelemetryState:
+    """The t=0 telemetry state for ``spec`` (zero-row when off).
+
+    ``key`` is the WORLD key: the journey plane hash-selects its task
+    sample from it (threefry-folded, never split — see
+    :func:`..telemetry.journeys.journey_sample_ids`); only consulted
+    when ``spec.journey_active``.
+    """
+    from .journeys import init_journey_leaves
+
     Fm, Pm, Rm = (
         spec.telemetry_fogs, spec.telemetry_phases, spec.telemetry_slots
     )
@@ -188,6 +211,7 @@ def init_telemetry_state(spec: WorldSpec) -> TelemetryState:
         lat_seen=jnp.zeros((spec.telemetry_hist_tasks,), jnp.int8),
         **init_exchange_leaves(spec),
         **init_hier_leaves(spec),
+        **init_journey_leaves(spec, key),
     )
 
 

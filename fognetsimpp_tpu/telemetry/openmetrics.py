@@ -177,6 +177,42 @@ def _render_tp_exchange(lines: List[str], ex: Dict) -> None:
             _sample(lines, name, vec[s], labels=f'{{shard="{s}"}}')
 
 
+def _render_journeys(lines: List[str], js: Dict) -> None:
+    """Emit the ``fns_journey_*`` scalar families (ISSUE 15).
+
+    ``js`` is :func:`telemetry.journeys.journey_summary`'s dict — the
+    single source the recorder's ``.sca.json`` ``journeys`` section,
+    the Perfetto journey lanes and the flight-recorder bundles also
+    read.  The terminal census labels each sampled task by the LAST
+    decoded stage of its ring (``in_flight`` = sampled, spawned, not
+    yet terminal; ``unspawned`` = sampled slot never used).
+    """
+    for name, key, kind, h in (
+        ("journey_sampled", "sampled", "gauge",
+         "task slots sampled into journey event rings"),
+        ("journey_ring_rows", "ring", "gauge",
+         "event rows per sampled task's ring (drop-oldest overflow)"),
+        ("journey_events_total", "events_total", "counter",
+         "journey lifecycle events appended across all sampled tasks"),
+        ("journey_dropped_total", "dropped_total", "counter",
+         "journey events overwritten by ring overflow (drop-oldest)"),
+    ):
+        _family(lines, name, kind, help_text=h)
+        _sample(lines, name, js[key])
+    _family(
+        lines, "journey_tasks",
+        help_text="sampled-task census by the last decoded journey "
+        "stage",
+    )
+    census = dict(js["terminal"])
+    census["in_flight"] = js["in_flight"]
+    census["unspawned"] = js["unspawned"]
+    for stage, n in sorted(census.items()):
+        _sample(
+            lines, "journey_tasks", n, labels=f'{{stage="{stage}"}}'
+        )
+
+
 def _render_compile_stats(lines: List[str]) -> None:
     """Compile-latency observability (ISSUE 6): the persistent-cache
     hit/miss counters and backend compile seconds from
@@ -279,6 +315,14 @@ def render_openmetrics(
         from ..hier.federation import hier_summary
 
         hs = hier_summary(spec, final)
+        # the published broker count: the linter's gap rule
+        # (tools/check_openmetrics.py) cross-checks every per-broker
+        # family against it, the fns_tp_shards discipline
+        _family(
+            lines, "hier_brokers",
+            help_text="broker domain count of the federation",
+        )
+        _sample(lines, "hier_brokers", hs["n_brokers"])
         for family, key, help_text in (
             ("hier_migrations_out", "mig_out",
              "tasks migrated away from each broker domain"),
@@ -305,6 +349,16 @@ def render_openmetrics(
                     lines, "hier_load_mean", hs["load_mean"][b],
                     labels=f'{{broker="{b}"}}',
                 )
+    # causal task-journey families (spec.telemetry_journeys, ISSUE 15):
+    # same journey_summary() dict the recorder's .sca.json journeys
+    # section and the Perfetto journey lanes read, so the outputs
+    # cannot drift
+    if spec.journey_active:
+        from .journeys import journey_summary
+
+        js = journey_summary(spec, final)
+        if js is not None:
+            _render_journeys(lines, js)
     # streaming latency histogram (spec.telemetry_hist, ISSUE 6)
     if hist is None:
         from .health import hist_summary

@@ -351,6 +351,136 @@ def _hier_broker_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
     return events
 
 
+def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
+    """Causal task-journey lanes + Perfetto FLOW chains (ISSUE 15).
+
+    One dedicated "journeys" process: tids ``0..B-1`` are broker lanes,
+    ``B..B+F-1`` fog lanes.  Every decoded ring event becomes one
+    slice on the lane of the entity handling it (the broker owning the
+    task for spawn/decide/migrate/re-offload, the fog for
+    enqueue/service/terminals), sized to the gap until the task's next
+    event — and the events of one task are joined by flow events
+    (``ph`` ``s``/``t``/``f`` with ``id`` = task id + 1), so Perfetto
+    draws ONE connected arrow chain following the task through crash,
+    re-offload, broker→broker migration and completion across lanes.
+    Unlike the post-run span reconstruction above, these events come
+    from the device-resident rings, so restamped columns cannot erase
+    the intermediate history.  Empty on journey-off runs: every
+    existing trace stays byte-identical.
+    """
+    from .journeys import (
+        BROKER_SIDE_EVENTS,
+        JourneyEvent,
+        decode_rings,
+    )
+
+    if not spec.journey_active:
+        return []
+    decoded = decode_rings(spec, final)
+    if not decoded:
+        return []
+    B = max(1, spec.n_brokers)
+    F = spec.n_fogs
+    ub = (
+        np.asarray(final.hier.user_broker, np.int64)
+        if spec.hier_active
+        else None
+    )
+    mig = int(JourneyEvent.MIGRATE)
+    events: List[Dict] = []
+    used_tids = set()
+    for d in decoded:
+        evs = d["events"]
+        if not evs:
+            continue
+        task = d["task"]
+        cur_b = (
+            int(ub[d["user"]])
+            if ub is not None and d["user"] < len(ub)
+            else 0
+        )
+        flow_id = task + 1  # Perfetto treats id 0 as unset
+        ts_all = [e["t"] * 1e6 for e in evs]  # seconds -> trace us
+        for i, e in enumerate(evs):
+            code = e["code"]
+            if code in BROKER_SIDE_EVENTS:
+                if code == mig:
+                    # the hop slice sits on the SRC lane; later events
+                    # land on the destination broker's lane
+                    tid = e["a"] if e["a"] >= 0 else cur_b
+                    cur_b = min(max(e["b"], 0), B - 1)
+                elif code == int(JourneyEvent.DECIDE):
+                    cur_b = min(max(e["b"], 0), B - 1)
+                    tid = cur_b
+                else:
+                    tid = cur_b
+                tid = min(max(int(tid), 0), B - 1)
+            else:
+                tid = B + min(max(e["a"], 0), max(F - 1, 0))
+            used_tids.add(int(tid))
+            ts = ts_all[i]
+            dur = (
+                max(ts_all[i + 1] - ts, 0.0) if i + 1 < len(evs) else 0.0
+            )
+            args = {"task": task, "a": e["a"], "b": e["b"]}
+            events.append(
+                {
+                    "name": e["name"],
+                    "ph": "X",
+                    "pid": int(pid),
+                    "tid": int(tid),
+                    "ts": float(ts),
+                    "dur": float(dur),
+                    "cat": "journey",
+                    "args": args,
+                }
+            )
+            # the flow chain: s (first) -> t ... -> f (last), bound to
+            # the enclosing slice just emitted on the same lane/ts; a
+            # single-event chain gets NO flow (an "s" with no "f" is a
+            # dangling Perfetto binding)
+            if len(evs) < 2:
+                continue
+            ph = "s" if i == 0 else ("f" if i + 1 == len(evs) else "t")
+            flow = {
+                "name": f"journey{task}",
+                "ph": ph,
+                "id": int(flow_id),
+                "pid": int(pid),
+                "tid": int(tid),
+                "ts": float(ts),
+                "cat": "journey",
+            }
+            if ph != "s":
+                flow["bp"] = "e"
+            events.append(flow)
+    if not events:
+        return []
+    for b in range(B):
+        if b in used_tids:
+            events.append(
+                {
+                    "name": "thread_name", "ph": "M", "pid": int(pid),
+                    "tid": b, "args": {"name": f"broker{b}"},
+                }
+            )
+    for f in range(F):
+        if B + f in used_tids:
+            events.append(
+                {
+                    "name": "thread_name", "ph": "M", "pid": int(pid),
+                    "tid": B + f, "args": {"name": f"fog{f}"},
+                }
+            )
+    events.append(
+        {
+            "name": "process_name", "ph": "M", "pid": int(pid),
+            "args": {"name": "journeys"},
+        }
+    )
+    return events
+
+
 def build_trace(
     spec: WorldSpec, final: WorldState, max_tasks: Optional[int] = None
 ) -> Dict:
@@ -382,6 +512,8 @@ def build_trace(
         events.extend(_chaos_lifecycle_events(spec, final, pid=0))
         # per-broker federation load lanes on hier runs
         events.extend(_hier_broker_events(spec, final, pid=n_rep + 1))
+        # causal journey lanes + flow chains on journey runs (ISSUE 15)
+        events.extend(_journey_events(spec, final, pid=n_rep + 2))
     # metadata first, then spans by (ts, -dur): a parent span sorts
     # before its children, and Perfetto/golden checks see monotone ts
     events.sort(

@@ -341,3 +341,46 @@ def test_set_unknown_spec_field_is_clear_error(capsys):
     assert "Traceback" not in captured.err
     # classification fails BEFORE any world is built: no recompile line
     assert "recompile:" not in captured.err
+
+
+# ---------------------------------------------------------------------
+# journey guard rails (ISSUE 15)
+# ---------------------------------------------------------------------
+
+def test_journeys_below_one_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--telemetry", "--journeys", "0"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "--journeys" in captured.err and ">= 1" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_journeys_without_telemetry_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--journeys", "4"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "--telemetry" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_journeys_above_task_capacity_is_clear_error(capsys):
+    rc = main(["--scenario", "smoke", "--telemetry",
+               "--journeys", "999999999"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "task capacity" in captured.err
+    assert "Traceback" not in captured.err
+
+
+def test_journeys_with_tp_is_clear_error(capsys):
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--telemetry", "--journeys", "4",
+              "--tp", "8"])
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "--journeys" in err and "--tp" in err

@@ -41,6 +41,12 @@ def _worlds():
             chaos_mttr_s=0.05, chaos_script=((0, 0.1, 0.2),),
             n_brokers=2, hier_policy=1, hier_threshold=0.5,
         ),
+        # journey-tap world (ISSUE 15: the end-of-tick snapshot-diff
+        # phase + the j_* ring leaves in the TelemetryState carry)
+        smoke.build(
+            horizon=0.4, telemetry=True, telemetry_journeys=4,
+            telemetry_journey_ring=16,
+        ),
     ]
 
 

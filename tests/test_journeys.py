@@ -1,0 +1,616 @@
+"""telemetry/journeys — causal task-journey tracing (ISSUE 15).
+
+The gates, in the established inert-subsystem order: journeys OFF is
+bit-exact across every entry point and journeys ON perturbs not a
+single non-journey leaf (the inert-LearnState discipline); the
+device-decoded event chain of a scripted chaos+hier world bit-matches
+a deterministic host replay of the same schedules (ONE shared
+journey_edges rule set, two array backends); a sampled task provably
+crashes → re-offloads → broker-migrates → completes as one connected
+Perfetto flow chain across two broker lanes (strict RFC-8259 JSON);
+ring overflow keeps exact drop-oldest accounting.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, run
+from fognetsimpp_tpu.hier import stamp_ownership
+from fognetsimpp_tpu.scenarios import smoke
+from fognetsimpp_tpu.spec import ChaosMode, HierPolicy
+from fognetsimpp_tpu.telemetry import journeys as jn
+
+SMALL = dict(n_users=2, n_fogs=2, send_interval=0.05, horizon=0.4)
+
+#: The acceptance world: domain 0 owns every user and two SLOW fogs
+#: that a scripted outage kills mid-run; REOFFLOAD bounces their
+#: in-flight tasks back to broker 0, whose dead domain migrates them
+#: to domain 1's fast fogs — crash → re-offload → migrate → complete,
+#: all inside one run.
+CHAOS_HIER = dict(
+    n_users=4, n_fogs=4,
+    fog_mips=(2000.0, 2000.0, 60000.0, 60000.0),
+    send_interval=0.02, horizon=0.5, dt=1e-3, seed=0,
+    max_sends_per_user=32,
+    n_brokers=2, hier_policy=int(HierPolicy.THRESHOLD),
+    hier_threshold=0.5, hier_max_hops=2,
+    assume_static=False,
+    chaos=True, chaos_mode=int(ChaosMode.REOFFLOAD),
+    chaos_max_retries=8,
+    chaos_script=((0, 0.05, 0.45), (1, 0.05, 0.45)),
+    telemetry=True,
+)
+
+
+def _state_hash(state) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+def _build_chaos_hier(**kw):
+    args = dict(CHAOS_HIER)
+    args.update(kw)
+    spec, state, net, bounds = smoke.build(**args)
+    state = stamp_ownership(
+        spec, state, user_broker=[0] * spec.n_users,
+        fog_broker=[0, 0, 1, 1],
+    )
+    return spec, state, net, bounds
+
+
+#: The PR-2/PR-4 policy-family triptych: dense broker, compacted
+#: LOCAL_FIRST, learned bandit.
+WORLDS = [
+    dict(policy=int(Policy.MIN_BUSY)),
+    dict(policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0),
+    dict(policy=int(Policy.UCB)),
+]
+
+#: Memoized finals (the test_hier run-cache discipline: run() retraces
+#: per call, so tests sharing a world share one trace).
+_RUN_CACHE: dict = {}
+
+
+def _chaos_hier_final(**kw):
+    key = ("ch",) + tuple(sorted(kw.items()))
+    if key not in _RUN_CACHE:
+        spec, state, net, bounds = _build_chaos_hier(**kw)
+        final, _ = run(spec, state, net, bounds)
+        _RUN_CACHE[key] = (spec, final)
+    return _RUN_CACHE[key]
+
+
+# ----------------------------------------------------------------------
+# inert gates
+# ----------------------------------------------------------------------
+
+def test_journeys_off_leaves_zero_row_and_bit_exact_entries():
+    """Journeys off (the default): every journey leaf has zero rows,
+    the dropped counter stays 0, and run / run_jit / run_chunked
+    produce bit-identical final states over the three policy-family
+    worlds."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    for kw in WORLDS:
+        spec, state, net, bounds = _build(**kw)
+        assert not spec.journey_active
+        assert spec.journey_slots == 0 and spec.journey_ring == 0
+        ref, _ = run(spec, state, net, bounds)
+        assert ref.telem.j_task.shape == (0,)
+        assert ref.telem.j_ring.shape == (0, 0, 4)
+        assert int(np.asarray(ref.telem.j_dropped)) == 0
+        h_ref = _state_hash(ref)
+        spec2, state2, net2, bounds2 = _build(**kw)
+        assert _state_hash(run_jit(spec2, state2, net2, bounds2)) == h_ref
+        spec3, state3, net3, bounds3 = _build(**kw)
+        assert (
+            _state_hash(run_chunked(spec3, state3, net3, bounds3, 170))
+            == h_ref
+        )
+
+
+def test_journeys_on_perturbs_zero_non_journey_leaves():
+    """Journeys ON is read-only: every non-journey leaf of the final
+    state — including every OTHER telemetry leaf — is bit-equal to
+    the journeys-off run of the same telemetry-on world."""
+    import dataclasses
+
+    J_LEAVES = {"j_task", "j_prev", "j_ring", "j_cursor", "j_dropped"}
+    for kw in WORLDS:
+        spec0, st0, net0, b0 = _build(telemetry=True, **kw)
+        ref, _ = run(spec0, st0, net0, b0)
+        spec1, st1, net1, b1 = _build(
+            telemetry=True, telemetry_journeys=4, **kw
+        )
+        on, _ = run(spec1, st1, net1, b1)
+        for f in ("nodes", "users", "fogs", "broker", "tasks",
+                  "metrics", "learn", "chaos", "hier"):
+            assert _state_hash(getattr(ref, f)) == _state_hash(
+                getattr(on, f)
+            ), (kw, f)
+        for fld in dataclasses.fields(ref.telem):
+            if fld.name in J_LEAVES:
+                continue
+            assert np.array_equal(
+                np.asarray(getattr(ref.telem, fld.name)),
+                np.asarray(getattr(on.telem, fld.name)),
+            ), (kw, fld.name)
+        # and the journey plane actually recorded something
+        assert int(np.asarray(on.telem.j_cursor).sum()) > 0, kw
+
+
+def test_journeys_on_bit_identical_across_run_entries():
+    """Journeys ON: run / run_jit / run_chunked agree bit-for-bit
+    (ring contents included) — the chunk boundary carries the rings."""
+    from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+
+    kw = dict(telemetry=True, telemetry_journeys=4)
+    spec, state, net, bounds = _build(**kw)
+    ref, _ = run(spec, state, net, bounds)
+    h_ref = _state_hash(ref)
+    spec2, state2, net2, bounds2 = _build(**kw)
+    assert _state_hash(run_jit(spec2, state2, net2, bounds2)) == h_ref
+    spec3, state3, net3, bounds3 = _build(**kw)
+    assert (
+        _state_hash(run_chunked(spec3, state3, net3, bounds3, 170))
+        == h_ref
+    )
+
+
+def test_fleet_vmap_carries_journey_rings():
+    """The fleet path is vmap(step): per-replica rings accumulate
+    independently and replica 0 of a 2-replica batch bit-matches the
+    single-world run with the same key."""
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.net.mobility import default_bounds
+    from fognetsimpp_tpu.parallel import replicate_state
+
+    kw = dict(telemetry=True, telemetry_journeys=4)
+    spec, state, net, _ = _build(**kw)
+    bounds = default_bounds()
+    step = make_step(spec)
+    batch = replicate_state(spec, state, 2, seed=0)
+    vstep = jax.jit(
+        lambda b: jax.vmap(lambda s: step(s, net, bounds))(b)
+    )
+    sstep = jax.jit(lambda s: step(s, net, bounds))
+    single = jax.tree.map(lambda x: x[0], batch)
+    for _ in range(40):
+        batch = vstep(batch)
+        single = sstep(single)
+    for name in ("j_task", "j_prev", "j_ring", "j_cursor"):
+        got = np.asarray(getattr(batch.telem, name))[0]
+        want = np.asarray(getattr(single.telem, name))
+        assert np.array_equal(got, want), name
+
+
+def test_bucket_padding_preserves_the_journey_sample():
+    """dynspec.bucket_spec pads the task table with END-appended ghost
+    rows: the J-sized journey leaves ride through untouched and the
+    sampled ids keep addressing the same (user, send) slots."""
+    from fognetsimpp_tpu.parallel.taskshard import pad_users_to_multiple
+
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_journeys=4
+    )
+    ids0 = np.asarray(state.telem.j_task)
+    spec2, state2, net2 = pad_users_to_multiple(spec, state, net, 3)
+    assert spec2.n_users > spec.n_users
+    assert spec2.journey_slots == spec.journey_slots
+    assert np.array_equal(np.asarray(state2.telem.j_task), ids0)
+    assert np.array_equal(
+        np.asarray(state2.telem.j_prev),
+        np.asarray(state.telem.j_prev),
+    )
+    # padded slot layout: old ids still address the same (user, send)
+    S = spec.max_sends_per_user
+    assert spec2.max_sends_per_user == S
+    for t in ids0:
+        assert int(t) // S < spec.n_users
+
+
+def test_phase_contract_registered_and_shapes():
+    from fognetsimpp_tpu.core.contracts import (
+        check_phase_contracts,
+        check_step_contract,
+        check_telemetry_contract,
+    )
+
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_journeys=4
+    )
+    checked = check_phase_contracts(spec, state, net)
+    assert "_phase_journeys" in checked
+    check_step_contract(spec, state, net, bounds)
+    check_telemetry_contract(spec, state)
+    # off-world: zero-row shapes also contract-checked
+    spec0, state0, _, _ = _build(telemetry=True)
+    check_telemetry_contract(spec0, state0)
+
+
+def test_sharded_runner_rejects_journeys_with_one_line():
+    from fognetsimpp_tpu.core.engine import tp_reject_reason
+
+    spec, *_ = _build(
+        telemetry=True, telemetry_journeys=4, assume_static=True,
+        derive_acks=True,
+    )
+    reason = tp_reject_reason(spec)
+    assert reason is not None and "journey" in reason
+
+
+def test_spec_validation_one_liners():
+    with pytest.raises(ValueError, match="rides TelemetryState"):
+        _build(telemetry_journeys=4)
+    with pytest.raises(ValueError, match="exceeds the task capacity"):
+        _build(telemetry=True, telemetry_journeys=10**9)
+    with pytest.raises(ValueError, match=">= 8 event rows"):
+        _build(
+            telemetry=True, telemetry_journeys=4,
+            telemetry_journey_ring=4,
+        )
+
+
+def test_sample_is_deterministic_and_key_folded():
+    """The sample is a pure function of (world key, J) — re-building
+    the same world re-derives it — and enabling journeys consumes
+    nothing from the main stream (the spawn draws are untouched, which
+    the perturbs-zero-leaves test already proves end-to-end)."""
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_journeys=4
+    )
+    spec2, state2, *_ = _build(telemetry=True, telemetry_journeys=4)
+    ids, ids2 = (
+        np.asarray(state.telem.j_task), np.asarray(state2.telem.j_task)
+    )
+    assert np.array_equal(ids, ids2)
+    assert len(set(ids.tolist())) == 4  # distinct slots
+    assert np.all(np.diff(ids) > 0)  # sorted
+    assert ids.min() >= 0 and ids.max() < spec.task_capacity
+
+
+# ----------------------------------------------------------------------
+# the acceptance chain: crash -> re-offload -> migrate -> complete
+# ----------------------------------------------------------------------
+
+def test_chaos_hier_chain_is_recorded():
+    """On the scripted domain-death world at full sampling, at least
+    one sampled task's decoded ring shows the full causal rescue:
+    re-offload off the crashed fog, broker 0 -> broker 1 migration,
+    decide at the rescuing broker, completion on a domain-1 fog — in
+    that causal order."""
+    spec, final = _chaos_hier_final(
+        telemetry_journeys=128, telemetry_journey_ring=32
+    )
+    decoded = jn.decode_rings(spec, final)
+    chains = []
+    for d in decoded:
+        names = [e["name"] for e in d["events"]]
+        if {"reoffload", "migrate", "done"} <= set(names):
+            chains.append(d)
+    assert chains, "no crash->reoffload->migrate->done chain sampled"
+    d = chains[0]
+    names = [e["name"] for e in d["events"]]
+    i_r = names.index("reoffload")
+    i_m = names.index("migrate")
+    i_d = names.index("done")
+    assert i_r < i_m < i_d, names
+    mig = d["events"][i_m]
+    assert (mig["a"], mig["b"]) == (0, 1)  # broker 0 -> broker 1
+    reoff = d["events"][i_r]
+    assert reoff["a"] in (0, 1)  # bounced off a domain-0 fog
+    assert reoff["b"] >= 1  # retry count stamped
+    done = d["events"][i_d]
+    assert done["a"] in (2, 3)  # completed on a domain-1 fog
+    # the re-decide at the rescuing broker sits between hop and done
+    i_d2 = names.index("decide", i_m)
+    assert i_m < i_d2 < i_d
+    assert d["events"][i_d2]["b"] == 1  # owning broker after the hop
+
+
+def test_device_chain_bit_matches_host_replay():
+    """THE determinism oracle: drive the real compiled step
+    tick-by-tick, re-derive every tick's edges on host with the SAME
+    journey_edges rule set over numpy, and require the device-decoded
+    rings to match the replay event-for-event (drop-oldest tail
+    included) — so the in-scan tap provably records the schedule the
+    engine actually executed."""
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.net.mobility import default_bounds
+
+    spec, state, net, bounds = _build_chaos_hier(
+        telemetry_journeys=128, telemetry_journey_ring=16
+    )
+    step = make_step(spec)
+    jstep = jax.jit(lambda s: step(s, net, default_bounds()))
+    ids = np.asarray(state.telem.j_task)
+
+    def snap(s):
+        return np.asarray(
+            jn.snapshot_rows(
+                spec, s.tasks, s.chaos, s.hier, jnp.asarray(ids)
+            )
+        )
+
+    expected = [[] for _ in ids]
+    prev = snap(state)
+    s = state
+    for i in range(spec.n_ticks):
+        s = jstep(s)
+        cur = snap(s)
+        t1 = np.float32(np.float32(i + 1) * np.float32(spec.dt))
+        for j, evs in enumerate(
+            jn.replay_tick(spec, prev, cur, ids, float(t1))
+        ):
+            expected[j].extend(evs)
+        prev = cur
+    decoded = jn.decode_rings(spec, s)
+    R = spec.journey_ring
+    n_events = 0
+    n_dropped = 0
+    for j, d in enumerate(decoded):
+        exp = expected[j]
+        n_events += len(exp)
+        n_dropped += max(0, len(exp) - R)
+        assert d["events_total"] == len(exp), (j, d, exp)
+        want = exp[-R:] if len(exp) > R else exp
+        assert d["events"] == want, (j, d["events"], want)
+    assert n_events == int(np.asarray(s.telem.j_cursor).sum())
+    assert n_dropped == int(np.asarray(s.telem.j_dropped))
+    assert n_events > 0
+
+
+# ----------------------------------------------------------------------
+# Perfetto flow chains
+# ----------------------------------------------------------------------
+
+def test_perfetto_flow_chain_crosses_broker_lanes(tmp_path):
+    """The acceptance render: the chaos+hier world's trace carries one
+    connected s->t...->f flow chain per journeyed task; for a rescued
+    task the chain's slices span BOTH broker lanes of the dedicated
+    "journeys" process.  The export round-trips strict RFC-8259
+    json.loads (no NaN/Infinity tokens)."""
+    from fognetsimpp_tpu.telemetry.timeline import export_trace
+
+    spec, final = _chaos_hier_final(
+        telemetry_journeys=128, telemetry_journey_ring=32
+    )
+    p = export_trace(spec, final, str(tmp_path / "journeys.json"))
+
+    def _no_nonfinite(name):
+        raise AssertionError(f"non-RFC-8259 token in trace JSON: {name}")
+
+    trace = json.loads(open(p).read(), parse_constant=_no_nonfinite)
+    events = trace["traceEvents"]
+    # the journeys process exists and is labelled
+    jpids = {
+        e["pid"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and e.get("args", {}).get("name") == "journeys"
+    }
+    assert len(jpids) == 1
+    jpid = jpids.pop()
+    jev = [e for e in events if e.get("cat") == "journey"]
+    flows = [e for e in jev if e["ph"] in ("s", "t", "f")]
+    assert flows, "no flow events rendered"
+    # every flow id forms one connected chain: exactly one s, one f,
+    # and every flow binds to a slice at the same (tid, ts)
+    slices = {
+        (e["tid"], e["ts"]) for e in jev if e["ph"] == "X"
+    }
+    by_id: dict = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+        assert (e["tid"], e["ts"]) in slices
+    rescued = 0
+    B = spec.n_brokers
+    for fid, chain in by_id.items():
+        phases = [e["ph"] for e in chain]
+        assert phases[0] == "s" and phases[-1] == "f", (fid, phases)
+        assert all(ph == "t" for ph in phases[1:-1]), (fid, phases)
+        broker_lanes = {
+            e["tid"] for e in chain if e["tid"] < B
+        }
+        if len(broker_lanes) >= 2:
+            rescued += 1
+    assert rescued > 0, "no flow chain crosses two broker lanes"
+    # broker lane metadata present for both lanes
+    lanes = {
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("pid") == jpid
+        and e.get("name") == "thread_name"
+    }
+    assert {"broker0", "broker1"} <= lanes, lanes
+
+
+def test_journey_off_trace_is_unchanged(tmp_path):
+    """No journeys => byte-identical Perfetto export vs a build without
+    the journey renderer's output (no 'journeys' process, no flow
+    events) — existing goldens stay valid."""
+    from fognetsimpp_tpu.telemetry.timeline import export_trace
+
+    spec, state, net, bounds = _build(telemetry=True)
+    final, _ = run(spec, state, net, bounds)
+    p = export_trace(spec, final, str(tmp_path / "plain.json"))
+    trace = json.loads(open(p).read())
+    assert not [
+        e for e in trace["traceEvents"] if e.get("cat") == "journey"
+    ]
+
+
+# ----------------------------------------------------------------------
+# ring overflow: exact drop-oldest accounting
+# ----------------------------------------------------------------------
+
+def test_ring_overflow_drop_oldest_accounting():
+    """Drive journey_tick eagerly with synthetic snapshots that fire
+    one enqueue edge per tick: the cursor keeps counting past the ring
+    size, the ring holds exactly the LAST R events, and j_dropped
+    counts every overwrite."""
+    spec, state, net, bounds = _build(
+        telemetry=True, telemetry_journeys=2, telemetry_journey_ring=8
+    )
+    telem = state.telem
+    tasks = state.tasks
+    ids = np.asarray(telem.j_task)
+    R = spec.journey_ring
+    n_ticks = 13  # > R: forces wrap on every slot
+    for i in range(n_ticks):
+        # restamp the sampled tasks' queue-enter time each "tick": the
+        # diff rule fires exactly one ENQUEUE per sampled task
+        tq = tasks.t_q_enter.at[jnp.asarray(ids)].set(
+            jnp.float32(0.001 * (i + 1))
+        )
+        tasks = tasks.replace(t_q_enter=tq)
+        telem = jn.journey_tick(
+            spec, telem, tasks, jnp.float32(0.001 * (i + 1)),
+        )
+    cursor = np.asarray(telem.j_cursor)
+    assert np.all(cursor == n_ticks)
+    assert int(np.asarray(telem.j_dropped)) == 2 * (n_ticks - R)
+    final = state.replace(telem=telem)
+    for d in jn.decode_rings(spec, final):
+        assert d["events_total"] == n_ticks
+        assert d["dropped"] == n_ticks - R
+        assert len(d["events"]) == R
+        # the retained tail is the LAST R enqueues, oldest first
+        ts = [round(e["t"], 6) for e in d["events"]]
+        want = [
+            round(float(np.float32(0.001 * (k + 1))), 6)
+            for k in range(n_ticks - R, n_ticks)
+        ]
+        assert ts == want
+        assert all(e["name"] == "enqueue" for e in d["events"])
+
+
+# ----------------------------------------------------------------------
+# expositions: .sca.json / OpenMetrics / flight recorder / postmortem
+# ----------------------------------------------------------------------
+
+def test_recorder_exposition_and_postmortem_carry_journeys(tmp_path):
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    from fognetsimpp_tpu.runtime.recorder import record_run
+    from fognetsimpp_tpu.telemetry.live import FlightRecorder
+
+    spec, final = _chaos_hier_final(
+        telemetry_journeys=128, telemetry_journey_ring=32
+    )
+    paths = record_run(str(tmp_path), spec, final, scave=False)
+    sca = json.load(open(paths["sca"]))
+    js = sca["journeys"]
+    assert js["sampled"] == 128
+    assert js["events_total"] > 0
+    assert "done" in js["terminal"]
+    assert any(
+        {"reoffload", "migrate"} <= {e["name"] for e in t["events"]}
+        for t in js["tasks"]
+    )
+    # OpenMetrics: families present and the file passes the lint
+    om = open(paths["om"]).read()
+    assert "fns_journey_sampled 128" in om
+    assert "fns_journey_events_total" in om
+    assert 'fns_journey_tasks{stage="done"}' in om
+    assert "fns_hier_brokers 2" in om
+    import tools.check_openmetrics as lint
+
+    assert lint.check_text(om, "journeys.om") == 0
+    # flight-recorder bundle: rings snapshot + postmortem --task
+    rec = FlightRecorder(capacity=4)
+    rec.note_chunk(100, rows={"t": np.asarray([0.1])})
+    manifest = rec.dump(
+        str(tmp_path), "anomaly", spec=spec, final=final,
+    )
+    d = json.load(open(manifest))
+    assert d["journeys"]["sampled"] == 128
+    task_id = d["journeys"]["rings"]["task"][0]
+    repo = Path(__file__).resolve().parents[1]
+    out = subprocess.run(
+        [_sys.executable, str(repo / "tools" / "postmortem.py"),
+         "--task", str(task_id), manifest],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert f"task {task_id}" in out.stdout
+    # pre-journey bundles still summarize (the .get-safe contract)
+    legacy = tmp_path / "old.json"
+    legacy.write_text(json.dumps({"reason": "nan", "ring": []}))
+    out2 = subprocess.run(
+        [_sys.executable, str(repo / "tools" / "postmortem.py"),
+         str(legacy)],
+        capture_output=True, text=True,
+    )
+    assert out2.returncode == 0, out2.stderr
+
+
+def test_openmetrics_lint_broker_label_rule():
+    """The PR 9 shard-label rule, replayed for the per-broker
+    federation families: a missing trailing broker series — which
+    previously passed — now fails the lint, as do a missing or
+    non-integer broker label."""
+    from tools.check_openmetrics import check_text
+
+    def fam(name, samples):
+        lines = [f"# HELP {name} x", f"# TYPE {name} gauge"]
+        lines += samples
+        return lines
+
+    base = fam("fns_hier_brokers", ["fns_hier_brokers 2"])
+    good = base + fam(
+        "fns_hier_fogs",
+        ['fns_hier_fogs{broker="0"} 2', 'fns_hier_fogs{broker="1"} 2'],
+    )
+    assert check_text("\n".join(good + ["# EOF"]), "t") == 0
+    # missing trailing broker series: the published count exposes it
+    truncated = base + fam(
+        "fns_hier_fogs", ['fns_hier_fogs{broker="0"} 2']
+    )
+    assert check_text("\n".join(truncated + ["# EOF"]), "t") == 1
+    # no broker label at all on a per-broker family
+    unlabeled = fam("fns_hier_users", ["fns_hier_users 4"])
+    assert check_text("\n".join(unlabeled + ["# EOF"]), "t") == 1
+    # non-integer broker label
+    stringy = fam(
+        "fns_hier_load_mean", ['fns_hier_load_mean{broker="a"} 0.5']
+    )
+    assert check_text("\n".join(stringy + ["# EOF"]), "t") == 1
+    # gap without a published count: still caught via max+1
+    gappy = fam(
+        "fns_hier_migrations_in",
+        [
+            'fns_hier_migrations_in{broker="0"} 1',
+            'fns_hier_migrations_in{broker="2"} 1',
+        ],
+    )
+    assert check_text("\n".join(gappy + ["# EOF"]), "t") == 1
+
+
+def test_cli_journeys_composes_with_trace_and_out(tmp_path, capsys):
+    from fognetsimpp_tpu.__main__ import main
+
+    trace = tmp_path / "t.json"
+    rc = main([
+        "--scenario", "smoke", "--telemetry", "--journeys", "3",
+        "--out", str(tmp_path), "--trace-out", str(trace),
+    ])
+    assert rc == 0 or rc is None
+    sca = json.load(open(tmp_path / "General-0.sca.json"))
+    assert sca["journeys"]["sampled"] == 3
+    t = json.loads(trace.read_text())
+    assert [e for e in t["traceEvents"] if e.get("cat") == "journey"]

@@ -20,11 +20,12 @@ pytestmark = pytest.mark.filterwarnings("ignore")
 
 @pytest.fixture(scope="module")
 def measured():
-    # tp=False / hier=False: the TP sharded-tick and federated-tick
-    # compiles are covered by test_tp.py / test_hier.py's own programs
-    # in this tier; both budget gates still run in CI via the op_budget
-    # CLI (--check), which measures everything
-    return op_budget.measure(tp=False, hier=False)
+    # tp=False / hier=False / journeys=False: the TP sharded-tick,
+    # federated-tick and journey-tap compiles are covered by
+    # test_tp.py / test_hier.py / test_journeys.py's own programs in
+    # this tier; all three budget gates still run in CI via the
+    # op_budget CLI (--check), which measures everything
+    return op_budget.measure(tp=False, hier=False, journeys=False)
 
 
 def test_budget_file_present_and_consistent():

@@ -126,6 +126,9 @@ def load_rounds(root: str = ".") -> List[Dict]:
                         "reconfig_compile_events"
                     ),
                     "telemetry_overhead": parsed.get("telemetry_overhead"),
+                    # journey-ring overhead (ISSUE 15): interleaved
+                    # off/on A/B recorded by bench.py BENCH_JOURNEYS=1
+                    "journey_overhead": parsed.get("journey_overhead"),
                     "parsed": parsed,
                 }
             )
@@ -155,15 +158,21 @@ def trajectories(rows: List[Dict]) -> Dict[Tuple, List[Dict]]:
 def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
     """Regression findings (empty = green)."""
     problems = []
-    # telemetry-overhead bar: gate every capture that measured it
+    # telemetry/journey-overhead bars: gate every capture that measured
+    # one (the same <= 10% bar every observability plane ships under)
     for r in rows:
-        oh = r.get("telemetry_overhead")
-        if oh is not None and float(oh) > OVERHEAD_BAR:
-            problems.append(
-                f"{r['file']}: telemetry-on overhead ratio {oh:.3f} "
-                f"exceeds the {OVERHEAD_BAR:.2f} bar (interleaved "
-                "off/on A/B; the observability planes ship under <=10%)"
-            )
+        for field, what in (
+            ("telemetry_overhead", "telemetry-on"),
+            ("journey_overhead", "journey-rings-on"),
+        ):
+            oh = r.get(field)
+            if oh is not None and float(oh) > OVERHEAD_BAR:
+                problems.append(
+                    f"{r['file']}: {what} overhead ratio {oh:.3f} "
+                    f"exceeds the {OVERHEAD_BAR:.2f} bar (interleaved "
+                    "off/on A/B; the observability planes ship under "
+                    "<=10%)"
+                )
         # warm-reconfig bars (ISSUE 13): every capture that measured a
         # reconfig_s must (a) have compiled NOTHING during the warm
         # runs and (b) beat the cold compile by RECONFIG_SPEEDUP_BAR
@@ -257,6 +266,11 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                 oh = (
                     f", telemetry x{r['telemetry_overhead']:.3f}"
                     if r.get("telemetry_overhead") is not None
+                    else ""
+                )
+                oh += (
+                    f", journeys x{r['journey_overhead']:.3f}"
+                    if r.get("journey_overhead") is not None
                     else ""
                 )
                 rcs = (

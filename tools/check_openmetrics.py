@@ -22,7 +22,13 @@ health plane (r6):
   non-negative decimal-integer values and no gaps (shards 0..N-1 all
   present per family) — a missing shard in the scrape is a silent
   observability hole, and duplicate (family, shard, fog) series are
-  already rejected by the generic duplicate-series rule.
+  already rejected by the generic duplicate-series rule;
+* the per-broker federation families (``fns_hier_migrations_out/in``,
+  ``fns_hier_fogs``, ``fns_hier_users``, ``fns_hier_load_mean``) carry
+  the ``broker`` label dimension on every sample, integer-valued and
+  gap-free ``0..B-1`` cross-checked against the published
+  ``fns_hier_brokers`` count — exactly the ISSUE 11 shard-label rule;
+  previously a missing trailing broker series passed the lint.
 """
 import math
 import re
@@ -38,6 +44,21 @@ HELP = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) \S.*$")
 LABEL_ONE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"\\\n]*)"')
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+#: The per-broker federation families (hier/): every sample of these
+#: must carry a ``broker`` label.  Scalar fns_hier_* roll-ups
+#: (``fns_hier_migrated``, ``fns_hier_hop_exhausted``,
+#: ``fns_hier_brokers``) are legitimately label-free and stay outside
+#: this set.
+_HIER_BROKER_FAMILIES = frozenset(
+    (
+        "fns_hier_migrations_out",
+        "fns_hier_migrations_in",
+        "fns_hier_fogs",
+        "fns_hier_users",
+        "fns_hier_load_mean",
+    )
+)
 
 
 def _parse_labels(text):
@@ -133,6 +154,36 @@ def check_lines(lines, where: str) -> int:
         if vals != want:
             print(
                 f"{where}: family {fam} has shard gaps: saw "
+                f"{sorted(vals)}, expected 0..{max(want)}"
+            )
+            return 1
+    # federation broker-label contract: the PR 9 shard rule replayed
+    # for the per-broker fns_hier_* families
+    broker_vals = {}  # family -> set of broker ints
+    n_brokers = None  # the exposition's own fns_hier_brokers sample
+    for i, name, labels_text, v in samples:
+        if name == "fns_hier_brokers":
+            n_brokers = int(v)
+        fam = _family(name, types)
+        if fam not in _HIER_BROKER_FAMILIES:
+            continue
+        labels = _parse_labels(labels_text)
+        if "broker" not in labels:
+            print(f"{where}:{i}: {name} sample without a 'broker' label")
+            return 1
+        bv = labels["broker"]
+        if not bv.isdigit():
+            print(f"{where}:{i}: {name} has non-integer broker={bv!r}")
+            return 1
+        broker_vals.setdefault(fam, set()).add(int(bv))
+    for fam, vals in broker_vals.items():
+        # cross-check against the published broker count when present:
+        # a MISSING TRAILING broker series (a truncated render loop)
+        # previously passed — only fns_hier_brokers knows the true B
+        want = set(range(n_brokers if n_brokers else max(vals) + 1))
+        if vals != want:
+            print(
+                f"{where}: family {fam} has broker gaps: saw "
                 f"{sorted(vals)}, expected 0..{max(want)}"
             )
             return 1

@@ -94,6 +94,20 @@ HIER_OVERRIDES = dict(
 )
 
 
+#: The journey-tap tick overrides (ISSUE 15): the chaos+hier world with
+#: the telemetry plane AND the task-journey event rings live — the
+#: RICHEST tap surface (re-offload retry deltas, migration hop deltas
+#: and every terminal all trace), so the audit covers the full edge
+#: synthesis, not just the happy-path subset.
+JOURNEY_OVERRIDES = dict(
+    **CHAOS_OVERRIDES,
+    **HIER_OVERRIDES,
+    telemetry=True,
+    telemetry_journeys=8,
+    telemetry_journey_ring=16,
+)
+
+
 def _compile_tick(**build_overrides):
     """Compile ONE tick of the op-budget pinned world; returns
     (hlo_text, spec).  The same lower/compile path op_budget gates, so
@@ -267,6 +281,15 @@ def variants() -> List[Variant]:
             "host-transfer-free, f64-free and collective-free like "
             "every single-device tick",
             lambda: _compile_tick(**HIER_OVERRIDES),
+        ),
+        Variant(
+            "tick_journeys",
+            "the chaos+hier tick with the telemetry plane and the "
+            "task-journey event rings live (ISSUE 15: per-sampled-task "
+            "snapshot diff + ring drop-scatter every tick) — the "
+            "journey tap must stay host-transfer-free, f64-free and "
+            "collective-free like every single-device tick",
+            lambda: _compile_tick(**JOURNEY_OVERRIDES),
         ),
         Variant(
             "tick_dyn",

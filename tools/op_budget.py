@@ -130,6 +130,21 @@ def compile_hier_counts() -> dict:
     return entry_op_counts(text)
 
 
+def compile_journeys_counts() -> dict:
+    """Compile the journey-tap tick (the hloaudit ``tick_journeys``
+    shape: the chaos+hier world with telemetry + the task-journey
+    event rings live) and count its HLO ops — the observability
+    plane's own kernel-count pin (ISSUE 15): the per-tick snapshot
+    diff and ring drop-scatter ride every journey-on tick, so a
+    regression here is a traced-world throughput loss CI should catch
+    like any other."""
+    from tools.hloaudit.variants import variants
+
+    v = next(x for x in variants() if x.name == "tick_journeys")
+    text, _spec = v.compile_fn()
+    return entry_op_counts(text)
+
+
 def compile_dyn_counts() -> dict:
     """Compile the promoted-operand tick (the hloaudit ``tick_dyn``
     shape: the tick_chaos world with every promoted knob a DynSpec
@@ -189,7 +204,9 @@ def compile_tp_counts(telemetry: bool = False) -> dict:
     }
 
 
-def measure(tp: bool = True, hier: bool = True) -> dict:
+def measure(
+    tp: bool = True, hier: bool = True, journeys: bool = True
+) -> dict:
     """Compile and count the gated programs.
 
     ``tp=False`` skips the TP sharded-tick compile (tier-1's
@@ -198,13 +215,16 @@ def measure(tp: bool = True, hier: bool = True) -> dict:
     ``python tools/op_budget.py --check``).  ``hier=False`` likewise
     skips the federated-tick compile in the tier-1 fixture
     (test_hier.py compiles hier programs in-tier; the tick_hier budget
-    gate still runs in CI via ``--check``).
+    gate still runs in CI via ``--check``), and ``journeys=False`` the
+    journey-tap tick (test_journeys.py compiles journey programs
+    in-tier; the tick_journeys budget gate still runs via ``--check``).
     """
     fused = compile_tick_counts(fused=True)
     unfused = compile_tick_counts(fused=False)
     chaos = compile_chaos_counts()
     dyn = compile_dyn_counts()
     hier_counts = compile_hier_counts() if hier else None
+    journey_counts = compile_journeys_counts() if journeys else None
     out_tp = {}
     if tp:
         for key, telem in (("tp_tick", False),
@@ -250,6 +270,19 @@ def measure(tp: bool = True, hier: bool = True) -> dict:
             if hier_counts is not None
             else {}
         ),
+        **(
+            {
+                "tick_journeys": {
+                    **journey_counts,
+                    "max_ops": int(journey_counts["ops"] * COUNT_SLACK),
+                    "max_fusions": int(
+                        journey_counts["fusions"] * COUNT_SLACK
+                    ),
+                }
+            }
+            if journey_counts is not None
+            else {}
+        ),
         **out_tp,
     }
 
@@ -277,9 +310,10 @@ def check(measured: dict, budget: dict) -> list:
             f"fused/unfused ops ratio {ratio:.3f} > {cap} — the "
             f"fused front-end lost its kernel-count reduction"
         )
-    # --- the chaos (ISSUE 12), promoted-operand (ISSUE 13) and
-    # federated-hierarchy (ISSUE 14) ticks -----------------------------
-    for vname in ("tick_chaos", "tick_dyn", "tick_hier"):
+    # --- the chaos (ISSUE 12), promoted-operand (ISSUE 13),
+    # federated-hierarchy (ISSUE 14) and journey-tap (ISSUE 15) ticks --
+    for vname in ("tick_chaos", "tick_dyn", "tick_hier",
+                  "tick_journeys"):
         tc, btc = measured.get(vname), budget.get(vname)
         if tc is None:
             continue

@@ -17,8 +17,67 @@ from __future__ import annotations
 
 import argparse
 import json
+import struct
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+#: Journey event-code names (ISSUE 15) — resolved from the package
+#: when importable (so a renumbered/extended JourneyEvent can never
+#: drift from this table), with a literal fallback so the inspector
+#: stays a stdlib-only tool (a post-mortem box may not have jax at
+#: hand; importing journeys pulls in jax).
+try:
+    from fognetsimpp_tpu.telemetry.journeys import (
+        EVENT_NAMES as _JOURNEY_NAMES,
+    )
+except Exception:
+    _JOURNEY_NAMES = {
+        1: "spawn", 2: "reoffload", 3: "migrate", 4: "decide",
+        5: "local_run", 6: "enqueue", 7: "svc_start", 8: "done",
+        9: "no_resource", 10: "rejected", 11: "dropped", 12: "lost",
+        13: "crash_lost", 14: "retry_exhaust", 15: "hop_exhausted",
+    }
+
+
+def _bits_to_time(bits: int) -> float:
+    """i32 bit pattern -> the exact f32 event time it encodes."""
+    return struct.unpack("<f", struct.pack("<i", int(bits)))[0]
+
+
+def _decode_journey(snap: Dict, task_id: Optional[int] = None) -> List[Dict]:
+    """Decode a manifest's raw ring snapshot (``journeys.rings``) into
+    per-task event chains — drop-oldest wrap resolved, stdlib only.
+    ``task_id`` filters to one task (the ``--task`` flag)."""
+    out = []
+    tasks = snap.get("task") or []
+    cursor = snap.get("cursor") or []
+    ring = snap.get("ring") or []
+    for j, task in enumerate(tasks):
+        if task_id is not None and int(task) != int(task_id):
+            continue
+        n = int(cursor[j]) if j < len(cursor) else 0
+        rows = ring[j] if j < len(ring) else []
+        R = len(rows)
+        order = range(n) if n <= R else [(n + k) % R for k in range(R)]
+        out.append(
+            {
+                "task": int(task),
+                "events_total": n,
+                "dropped": max(0, n - R) if R else n,
+                "events": [
+                    {
+                        "t": _bits_to_time(rows[k][0]),
+                        "name": _JOURNEY_NAMES.get(
+                            int(rows[k][1]), f"code{rows[k][1]}"
+                        ),
+                        "a": int(rows[k][2]),
+                        "b": int(rows[k][3]),
+                    }
+                    for k in order
+                ],
+            }
+        )
+    return out
 
 
 def load(path: str) -> Dict:
@@ -86,6 +145,24 @@ def summarize(d: Dict) -> List[str]:
             f"reoffloaded={chaos.get('reoffloaded')} "
             f"retry_exhausted={chaos.get('retry_exhausted')}"
         )
+    journeys = d.get("journeys") or {}
+    if journeys:
+        # journey rings (ISSUE 15): .get-safe like every other optional
+        # field — pre-journey bundles simply skip the section
+        out.append(
+            "journeys:    "
+            f"{journeys.get('sampled')} sampled task(s), "
+            f"dropped={journeys.get('dropped_total')}"
+        )
+        for chain in _decode_journey(journeys.get("rings") or {})[:3]:
+            tail = chain["events"][-3:]
+            out.append(
+                f"  - task {chain['task']}: {chain['events_total']} "
+                "event(s), last "
+                + " -> ".join(
+                    f"{e['name']}@{e['t']:.4f}" for e in tail
+                )
+            )
     cc = d.get("compile_cache") or {}
     if cc:
         out.append(
@@ -180,7 +257,43 @@ def main(argv=None) -> int:
         "--diff", action="store_true",
         help="diff exactly two dumps instead of summarizing each",
     )
+    ap.add_argument(
+        "--task", type=int, metavar="ID", default=None,
+        help="print one sampled task's decoded journey event chain "
+        "from the dump's ring snapshot (needs a journey-on bundle)",
+    )
     args = ap.parse_args(argv)
+    if args.task is not None:
+        rc = 0
+        for p in args.paths:
+            d = load(p)
+            snap = (d.get("journeys") or {}).get("rings") or {}
+            chains = _decode_journey(snap, task_id=args.task)
+            if not chains:
+                sampled = snap.get("task") or []
+                print(
+                    f"{p}: task {args.task} is not in the journey "
+                    f"sample ({len(sampled)} sampled"
+                    + (
+                        f": {sampled[:16]}..." if len(sampled) > 16
+                        else f": {sampled}"
+                    )
+                    + ")"
+                )
+                rc = 1
+                continue
+            chain = chains[0]
+            print(
+                f"== {p}: task {chain['task']} "
+                f"({chain['events_total']} event(s), "
+                f"{chain['dropped']} dropped) =="
+            )
+            for e in chain["events"]:
+                print(
+                    f"  {e['t']:.6f}s  {e['name']:<14s} "
+                    f"a={e['a']} b={e['b']}"
+                )
+        return rc
     if args.diff:
         if len(args.paths) != 2:
             ap.error("--diff needs exactly two dump paths")
