@@ -248,25 +248,27 @@ def main(argv=None) -> int:
         or args.sweep
         or args.progress
     ):
-        ap.error("--checkify/FNS_CHECKIFY is the single-world debug "
-                 "slow path; it does not combine with "
+        ap.error("[CLI-CHECKIFY-SOLO] --checkify/FNS_CHECKIFY is the "
+                 "single-world debug slow path; it does not combine with "
                  "--serve/--replicas/--mesh/--tp/--sweep/--progress")
 
     if args.tp is not None:
         # ---- TP guard rails: one parallel axis per run ----------------
         if args.replicas is not None or args.mesh is not None:
-            ap.error("--tp shards ONE world's task table over the mesh; "
-                     "--replicas/--mesh fan out independent worlds — "
-                     "pick one parallel axis per run")
+            ap.error("[CLI-TP-FLEET] --tp shards ONE world's task table "
+                     "over the mesh; --replicas/--mesh fan out "
+                     "independent worlds — pick one parallel axis per run")
         if args.sweep:
-            ap.error("--sweep owns its own replica fan-out; it does not "
-                     "combine with --tp")
+            ap.error("[CLI-SWEEP-TP] --sweep owns its own replica "
+                     "fan-out; it does not combine with --tp")
         if args.progress or args.ticks or args.trails:
-            ap.error("--tp runs one jitted sharded scan; "
+            # same cell as the engine gate's [TP-SERIES] clause: the CLI
+            # one-liner keys on the gate's ID, never re-words the cell
+            ap.error("[TP-SERIES] --tp runs one jitted sharded scan; "
                      "--progress/--ticks/--trails do not apply")
     elif args.tp_window is not None:
-        ap.error("--tp-window sizes the TP arrival exchange; it needs "
-                 "--tp N")
+        ap.error("[CLI-TPWINDOW] --tp-window sizes the TP arrival "
+                 "exchange; it needs --tp N")
 
     # ---- hierarchy guard rails (hier/) --------------------------------
     if args.brokers is not None:
@@ -278,22 +280,25 @@ def main(argv=None) -> int:
             )
             return 2
         if args.tp is not None:
-            ap.error("--brokers federates ONE world's decide phase; "
-                     "the TP sharded tick does not carry the hierarchy "
-                     "yet — pick one of --brokers/--tp per run")
+            # same cells as the hier_reject_reason gate: the CLI keys on
+            # the gate's [TP-HIER]/[FLEET-HIER] IDs, never re-words them
+            ap.error("[TP-HIER] --brokers federates ONE world's decide "
+                     "phase; the TP sharded tick does not carry the "
+                     "hierarchy yet — pick one of --brokers/--tp per run")
         if args.replicas is not None or args.mesh is not None:
-            ap.error("--brokers federates ONE world; the fleet runner "
-                     "does not carry the hierarchy yet — run federated "
-                     "worlds without --replicas/--mesh")
+            ap.error("[FLEET-HIER] --brokers federates ONE world; the "
+                     "fleet runner does not carry the hierarchy yet — "
+                     "run federated worlds without --replicas/--mesh")
         if args.sweep:
-            ap.error("--sweep grids own their replica fan-out and do "
-                     "not carry the hierarchy; run federated worlds "
-                     "without --sweep")
+            ap.error("[CLI-SWEEP-HIER] --sweep grids own their replica "
+                     "fan-out and do not carry the hierarchy; run "
+                     "federated worlds without --sweep")
     if args.hier_policy is not None:
         if args.brokers is None or args.brokers < 2:
             print(
-                "error: --hier-policy selects the broker↔broker "
-                "migration policy; it needs --brokers B with B > 1",
+                "error: [CLI-HIERPOLICY] --hier-policy selects the "
+                "broker↔broker migration policy; it needs --brokers B "
+                "with B > 1",
                 file=sys.stderr,
             )
             return 2
@@ -311,12 +316,12 @@ def main(argv=None) -> int:
                           ("--chaos-mode", args.chaos_mode),
                           ("--chaos-script", args.chaos_script)):
             if val is not None:
-                ap.error(f"{flag} refines a chaos profile; it needs "
-                         "--chaos <profile>")
+                ap.error(f"[CLI-CHAOS-KNOBS] {flag} refines a chaos "
+                         "profile; it needs --chaos <profile>")
     elif args.sweep:
-        ap.error("--chaos perturbs one world's fault schedule; --sweep "
-                 "grids own their replica fan-out — run chaos worlds "
-                 "without --sweep")
+        ap.error("[CLI-SWEEP-CHAOS] --chaos perturbs one world's fault "
+                 "schedule; --sweep grids own their replica fan-out — "
+                 "run chaos worlds without --sweep")
 
     # ---- journey guard rails (ISSUE 15) -------------------------------
     if args.journeys is not None:
@@ -335,16 +340,17 @@ def main(argv=None) -> int:
             or args.slo is not None
         ):
             print(
-                "error: --journeys rides the device-resident telemetry "
-                "plane (the event rings live in TelemetryState); add "
-                "--telemetry (or --serve/--hist)",
+                "error: [SPEC-JOURNEYS-TELEM] --journeys rides the "
+                "device-resident telemetry plane (the event rings live "
+                "in TelemetryState); add --telemetry (or --serve/--hist)",
                 file=sys.stderr,
             )
             return 2
         if args.tp is not None:
-            ap.error("--journeys traces single-world event rings; the "
-                     "TP sharded tick does not carry them yet — run "
-                     "journey worlds without --tp")
+            # the [TP-JOURNEYS] gate's cell, keyed on the gate's ID
+            ap.error("[TP-JOURNEYS] --journeys traces single-world "
+                     "event rings; the TP sharded tick does not carry "
+                     "them yet — run journey worlds without --tp")
 
     text = ""
     if args.config:
@@ -425,24 +431,26 @@ def main(argv=None) -> int:
         from .parallel import sweep_explore, sweep_policies
 
         if args.ticks or args.trails:
-            ap.error("--sweep is incompatible with --ticks/--trails "
-                     "(sweeps return counter grids, not series)")
+            ap.error("[CLI-SWEEP-SERIES] --sweep is incompatible with "
+                     "--ticks/--trails (sweeps return counter grids, "
+                     "not series)")
         if args.telemetry or args.trace_out or args.profile:
-            ap.error("--sweep returns counter grids, not a final "
-                     "world; --telemetry/--trace-out/--profile apply "
-                     "to single-scenario runs")
+            ap.error("[CLI-SWEEP-TELEM] --sweep returns counter grids, "
+                     "not a final world; --telemetry/--trace-out/"
+                     "--profile apply to single-scenario runs")
         if args.serve is not None or args.slo is not None or args.hist:
-            ap.error("--sweep returns counter grids, not a live "
-                     "world; --serve/--slo/--hist apply to "
+            ap.error("[CLI-SWEEP-SERVE] --sweep returns counter grids, "
+                     "not a live world; --serve/--slo/--hist apply to "
                      "single-scenario runs")
         if args.replicas is not None or args.mesh is not None:
-            ap.error("--sweep owns its own replica fan-out (reps=); "
-                     "--replicas/--mesh apply to single-scenario runs")
+            ap.error("[CLI-SWEEP-FLEET] --sweep owns its own replica "
+                     "fan-out (reps=); --replicas/--mesh apply to "
+                     "single-scenario runs")
         if args.policy is not None:
             print(
-                "error: --policy conflicts with --sweep (the sweep owns "
-                "the policy axis: use 'policies=...' or 'policy=...' "
-                "inside the grid spec)",
+                "error: [CLI-SWEEP-POLICY] --policy conflicts with "
+                "--sweep (the sweep owns the policy axis: use "
+                "'policies=...' or 'policy=...' inside the grid spec)",
                 file=sys.stderr,
             )
             return 2
@@ -740,11 +748,13 @@ def main(argv=None) -> int:
     if args.serve is not None:
         # ---- live health plane (telemetry/live.py, ISSUE 6) -----------
         if args.progress or args.ticks or args.trails:
-            ap.error("--serve owns the chunking (--serve-chunk); "
-                     "--progress/--ticks/--trails do not apply")
+            ap.error("[CLI-SERVE-SERIES] --serve owns the chunking "
+                     "(--serve-chunk); --progress/--ticks/--trails do "
+                     "not apply")
         if args.replicas is not None or args.mesh is not None:
-            ap.error("--serve is a single-world loop; fleet serving is "
-                     "a follow-up (run --replicas without --serve)")
+            ap.error("[CLI-SERVE-FLEET] --serve is a single-world loop; "
+                     "fleet serving is a follow-up (run --replicas "
+                     "without --serve)")
         from .telemetry.live import serve_run
         from .telemetry.profile import profile_trace
 
@@ -763,11 +773,13 @@ def main(argv=None) -> int:
     if args.replicas is not None or args.mesh is not None:
         # ---- replica-sharded fleet run (parallel/fleet.py) ------------
         if args.progress:
-            ap.error("--replicas/--mesh and --progress are mutually "
-                     "exclusive (the fleet scan is one jitted call)")
+            ap.error("[CLI-FLEET-PROGRESS] --replicas/--mesh and "
+                     "--progress are mutually exclusive (the fleet scan "
+                     "is one jitted call)")
         if args.trails:
-            ap.error("--trails renders one world's movement; slice a "
-                     "replica out of a fleet run instead")
+            ap.error("[CLI-FLEET-TRAILS] --trails renders one world's "
+                     "movement; slice a replica out of a fleet run "
+                     "instead")
         import jax
 
         from .parallel import make_mesh, replicate_state
@@ -849,9 +861,10 @@ def main(argv=None) -> int:
     with profile_trace(args.profile) as prof:
         if args.progress:
             if args.ticks or args.trails:
-                ap.error("--progress and --ticks/--trails are mutually "
-                         "exclusive (chunked runs record via snapshots, "
-                         "not series)")
+                ap.error("[CLI-PROGRESS-SERIES] --progress and "
+                         "--ticks/--trails are mutually exclusive "
+                         "(chunked runs record via snapshots, not "
+                         "series)")
             from .core.engine import run_chunked
             from .runtime.signals import summarize as _sumz
 

@@ -152,16 +152,22 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
     regime, on a static topology.  Everything else keeps the GSPMD
     fallback (:func:`fognetsimpp_tpu.parallel.taskshard.run_node_sharded`
     dispatches) or the single-device engine.
+
+    Every clause leads with a stable machine-parseable ID (``[TP-*]``):
+    the featmat tier (``tools/featmat``) extracts the composition matrix
+    from these clauses, the CLI one-liners key on the IDs, and
+    ``tests/test_cli_errors.py`` asserts IDs rather than prose — the
+    prose can be reworded freely, the bracketed ID cannot.
     """
     if spec.n_fogs <= 0:
-        return "TP tick needs fog nodes (n_fogs >= 1)"
+        return "[TP-NOFOGS] TP tick needs fog nodes (n_fogs >= 1)"
     if spec.chaos:
         # checked FIRST among the feature gates: a chaos spec also
         # fails the assume_static hoist below (chaos mutates liveness),
         # and the actionable reason is the subsystem, not the symptom
         return (
-            "TP tick does not carry the chaos fault-injection subsystem "
-            "yet (run chaos worlds on single-device run/run_jit/"
+            "[TP-CHAOS] TP tick does not carry the chaos fault-injection "
+            "subsystem yet (run chaos worlds on single-device run/run_jit/"
             "run_chunked)"
         )
     if spec.hier_active:
@@ -173,40 +179,49 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
         # them; the sharded tick would need shard-local rings with a
         # per-shard ownership fold — the chaos/hier follow-up pattern)
         return (
-            "TP tick does not carry the task-journey event rings yet "
-            "(shard-local rings need a per-shard ownership fold); run "
-            "journey worlds on single-device run/run_jit/run_chunked "
-            "or the fleet runner"
+            "[TP-JOURNEYS] TP tick does not carry the task-journey event "
+            "rings yet (shard-local rings need a per-shard ownership "
+            "fold); run journey worlds on single-device run/run_jit/"
+            "run_chunked or the fleet runner"
         )
     if spec.fog_model != int(FogModel.FIFO):
-        return "TP tick covers FIFO fogs only (POOL pools are sequential)"
+        return (
+            "[TP-POOL] TP tick covers FIFO fogs only (POOL pools are "
+            "sequential)"
+        )
     if not _broker_dense_ok(spec):
         return (
-            "TP tick covers the dense-broker policy family "
+            "[TP-POLICY] TP tick covers the dense-broker policy family "
             "(MIN_BUSY/MIN_LATENCY/ENERGY_AWARE with bug_compat."
             "mips0_divisor, or MAX_MIPS); sequential-pool and learned "
             "policies keep the single-device / GSPMD paths"
         )
     if not spec.two_stage_arrivals:
-        return "TP tick needs the two-stage arrival front-end"
+        return "[TP-ARRIVALS] TP tick needs the two-stage arrival front-end"
     if spec.window < spec.task_capacity:
         return (
-            "TP tick runs the no-window candidate tail: needs "
+            "[TP-WINDOW] TP tick runs the no-window candidate tail: needs "
             "arrival_window=None (window >= task_capacity)"
         )
     if not spec.assume_static:
         return (
-            "TP tick hoists one association/delay cache for the whole "
-            "run: needs assume_static"
+            "[TP-DYNTOPO] TP tick hoists one association/delay cache for "
+            "the whole run: needs assume_static"
         )
     if spec.energy_enabled:
-        return "TP tick does not carry the energy/lifecycle model yet"
+        return (
+            "[TP-ENERGY] TP tick does not carry the energy/lifecycle "
+            "model yet"
+        )
     if spec.wired_queue_enabled:
-        return "TP tick does not carry DropTail backpressure yet"
+        return "[TP-WIRED] TP tick does not carry DropTail backpressure yet"
     if spec.learn_active:
-        return "TP tick does not carry bandit learner state yet"
+        return "[TP-LEARN] TP tick does not carry bandit learner state yet"
     if spec.record_tick_series:
-        return "TP tick records no per-tick series (record via summary)"
+        return (
+            "[TP-SERIES] TP tick records no per-tick series (record via "
+            "summary)"
+        )
     return None
 
 

@@ -168,14 +168,17 @@ def hier_reject_reason(spec: WorldSpec, runner: str) -> Optional[str]:
     can — i.e. the hierarchy is off).  ONE message source for the
     TP-tick gate (``core/engine.tp_reject_reason``) and the fleet
     runner (``parallel/fleet._check_fleet_spec``), so the entries can
-    never drift apart."""
+    never drift apart.  The leading ``[{RUNNER}-HIER]`` clause ID is
+    the machine-parseable key (``[TP-HIER]`` / ``[FLEET-HIER]``) that
+    ``tools/featmat`` extraction and the ID-asserting tests hang on."""
     if not spec.hier_active:
         return None
     return (
-        f"the {runner} runner does not carry the multi-broker hierarchy "
-        "yet (per-domain decide masks and the migrate phase need "
-        f"cross-shard load summaries); run n_brokers={spec.n_brokers} "
-        "worlds on single-device run/run_jit/run_chunked"
+        f"[{runner.upper()}-HIER] the {runner} runner does not carry the "
+        "multi-broker hierarchy yet (per-domain decide masks and the "
+        "migrate phase need cross-shard load summaries); run "
+        f"n_brokers={spec.n_brokers} worlds on single-device "
+        "run/run_jit/run_chunked"
     )
 
 

@@ -34,7 +34,7 @@ from typing import Optional, Tuple
 #: belt-and-braces) and engine.make_step() — the entries must agree
 #: (ADVICE r5), so the text lives in exactly one place.
 STATIC_MAC_ERR = (
-    "assume_static cannot hoist a Bianchi-keyed association: "
+    "[SPEC-STATIC-MAC] assume_static cannot hoist a Bianchi-keyed association: "
     "MAC contention is keyed on per-tick offered load (r5). "
     "Disable assume_static for this world, or build the net "
     "with mac_model='linear'."
@@ -944,8 +944,9 @@ class WorldSpec:
         if self.telemetry_journeys > 0:
             if not self.telemetry:
                 raise ValueError(
-                    "telemetry_journeys rides TelemetryState in the "
-                    "scan carry: set spec.telemetry=True as well"
+                    "[SPEC-JOURNEYS-TELEM] telemetry_journeys rides "
+                    "TelemetryState in the scan carry: set "
+                    "spec.telemetry=True as well"
                 )
             if self.telemetry_journeys > self.task_capacity:
                 raise ValueError(
@@ -969,15 +970,17 @@ class WorldSpec:
             # CLI/config tier surfaces these as one actionable line
             if self.assume_static:
                 raise ValueError(
-                    "chaos cannot run under assume_static: crash/recover "
+                    "[SPEC-CHAOS-STATIC] chaos cannot run under "
+                    "assume_static: crash/recover "
                     "schedules mutate fog liveness per tick (the energy-"
                     "lifecycle restriction); build with assume_static="
                     "False"
                 )
             if self.energy_enabled:
                 raise ValueError(
-                    "chaos and the energy lifecycle both drive node "
-                    "liveness; enable one failure source per world"
+                    "[SPEC-CHAOS-ENERGY] chaos and the energy lifecycle "
+                    "both drive node liveness; enable one failure "
+                    "source per world"
                 )
             if self.chaos_mode not in tuple(int(m) for m in ChaosMode):
                 raise ValueError(
@@ -1074,7 +1077,8 @@ class WorldSpec:
                 int(Policy.DYNAMIC),
             ):
                 raise ValueError(
-                    f"policy {Policy(self.policy).name.lower()} does not "
+                    f"[SPEC-HIER-POLICY] policy "
+                    f"{Policy(self.policy).name.lower()} does not "
                     "federate (n_brokers > 1): round_robin needs a "
                     "per-domain cursor, local_first/dynamic are single-"
                     "broker constructs — use the argmin family "

@@ -1,6 +1,11 @@
 """CLI error-path regressions: an .ini referencing an unknown scenario/
 network name — or a ``--policy``/``--sweep`` naming an unknown policy —
-must produce a one-line actionable error, not a traceback."""
+must produce a one-line actionable error, not a traceback.
+
+Composition rejections assert the bracketed clause ID ([TP-CHAOS],
+[CLI-SWEEP-*], ...) rather than the prose: the ID is the stable
+machine-parseable contract (tools/featmat extracts the composition
+matrix from it), the wording may change freely."""
 import pytest
 
 from fognetsimpp_tpu.__main__ import main
@@ -69,7 +74,7 @@ def test_policy_flag_conflicts_with_sweep(capsys):
                "--sweep", "policies=min_busy loads=0.05"])
     captured = capsys.readouterr()
     assert rc == 2
-    assert "--policy" in captured.err and "--sweep" in captured.err
+    assert "[CLI-SWEEP-POLICY]" in captured.err
     assert "Traceback" not in captured.err
 
 
@@ -78,7 +83,7 @@ def test_replicas_conflicts_with_sweep(capsys):
         main(["--scenario", "smoke", "--replicas", "8",
               "--sweep", "policies=min_busy loads=0.05"])
     assert e.value.code == 2
-    assert "--replicas" in capsys.readouterr().err
+    assert "[CLI-SWEEP-FLEET]" in capsys.readouterr().err
 
 
 def test_fleet_replicas_not_dividing_mesh_is_clear_error(capsys):
@@ -119,7 +124,7 @@ def test_tp_conflicts_with_replicas(capsys):
         main(["--scenario", "smoke", "--tp", "8", "--replicas", "8"])
     assert e.value.code == 2
     err = capsys.readouterr().err
-    assert "--tp" in err and "--replicas" in err
+    assert "[CLI-TP-FLEET]" in err
 
 
 def test_tp_outside_policy_family_is_clear_error(capsys):
@@ -130,7 +135,7 @@ def test_tp_outside_policy_family_is_clear_error(capsys):
     captured = capsys.readouterr()
     assert rc == 2
     assert "error:" in captured.err
-    assert "dense-broker" in captured.err
+    assert "[TP-POLICY]" in captured.err and "dense-broker" in captured.err
     assert "Traceback" not in captured.err
 
 
@@ -143,7 +148,7 @@ def test_tp_window_requires_tp(capsys):
     with pytest.raises(SystemExit) as e:
         main(["--scenario", "smoke", "--tp-window", "4"])
     assert e.value.code == 2
-    assert "--tp N" in capsys.readouterr().err
+    assert "[CLI-TPWINDOW]" in capsys.readouterr().err
 
 
 # ---- chaos CLI surface (ISSUE 12) ------------------------------------
@@ -162,14 +167,14 @@ def test_chaos_seed_requires_chaos(capsys):
     with pytest.raises(SystemExit) as e:
         main(["--scenario", "smoke", "--chaos-seed", "3"])
     assert e.value.code == 2
-    assert "--chaos <profile>" in capsys.readouterr().err
+    assert "[CLI-CHAOS-KNOBS]" in capsys.readouterr().err
 
 
 def test_chaos_script_requires_chaos(capsys):
     with pytest.raises(SystemExit) as e:
         main(["--scenario", "smoke", "--chaos-script", "/tmp/x.json"])
     assert e.value.code == 2
-    assert "--chaos <profile>" in capsys.readouterr().err
+    assert "[CLI-CHAOS-KNOBS]" in capsys.readouterr().err
 
 
 def test_unknown_chaos_mode_is_clear_error(capsys):
@@ -210,7 +215,7 @@ def test_chaos_conflicts_with_sweep(capsys):
               "--sweep", "policies=min_busy loads=0.05"])
     assert e.value.code == 2
     err = capsys.readouterr().err
-    assert "--chaos" in err and "--sweep" in err
+    assert "[CLI-SWEEP-CHAOS]" in err
 
 
 def test_chaos_with_tp_is_clear_error(capsys):
@@ -221,7 +226,7 @@ def test_chaos_with_tp_is_clear_error(capsys):
     captured = capsys.readouterr()
     assert rc == 2
     assert "error:" in captured.err
-    assert "chaos" in captured.err
+    assert "[TP-CHAOS]" in captured.err
     assert "Traceback" not in captured.err
 
 
@@ -277,7 +282,7 @@ def test_hier_policy_requires_brokers(capsys):
     rc = main(["--scenario", "smoke", "--hier-policy", "threshold"])
     captured = capsys.readouterr()
     assert rc == 2
-    assert "needs --brokers" in captured.err
+    assert "[CLI-HIERPOLICY]" in captured.err
     assert "Traceback" not in captured.err
 
 
@@ -286,7 +291,7 @@ def test_brokers_with_tp_is_clear_error(capsys):
         main(["--scenario", "smoke", "--brokers", "2", "--tp", "8"])
     assert e.value.code == 2
     err = capsys.readouterr().err
-    assert "--brokers" in err and "--tp" in err
+    assert "[TP-HIER]" in err
 
 
 def test_brokers_with_replicas_is_clear_error(capsys):
@@ -295,7 +300,7 @@ def test_brokers_with_replicas_is_clear_error(capsys):
               "--replicas", "8"])
     assert e.value.code == 2
     err = capsys.readouterr().err
-    assert "--brokers" in err and "--replicas" in err
+    assert "[FLEET-HIER]" in err
 
 
 def test_hier_unsupported_policy_is_clear_error(capsys):
@@ -305,6 +310,7 @@ def test_hier_unsupported_policy_is_clear_error(capsys):
                "--set", "scenario.n_fogs=4", "--policy", "round_robin"])
     captured = capsys.readouterr()
     assert rc == 2
+    assert "[SPEC-HIER-POLICY]" in captured.err
     assert "does not federate" in captured.err
     assert "Traceback" not in captured.err
 
@@ -361,7 +367,7 @@ def test_journeys_without_telemetry_is_clear_error(capsys):
     captured = capsys.readouterr()
     assert rc == 2
     assert "error:" in captured.err
-    assert "--telemetry" in captured.err
+    assert "[SPEC-JOURNEYS-TELEM]" in captured.err
     assert "Traceback" not in captured.err
 
 
@@ -383,4 +389,4 @@ def test_journeys_with_tp_is_clear_error(capsys):
               "--tp", "8"])
     assert e.value.code == 2
     err = capsys.readouterr().err
-    assert "--journeys" in err and "--tp" in err
+    assert "[TP-JOURNEYS]" in err

@@ -1,0 +1,333 @@
+"""featmat quick tier: extraction units, matrix consistency, seeded
+fatal regressions (deleted gate / de-aliased donation / peak-memory
+blowup), golden artifacts — and the gate-driving rejection tests whose
+literal clause IDs ARE the matrix's rejected-cell coverage.
+
+The parametrized cases below drive every rejected cell/composition
+through its real gate (tp_reject_reason / hier_reject_reason /
+WorldSpec.validate / the CLI) and assert the bracketed ID, never the
+prose — `python -m tools.featmat --check` fails CI if any rejected
+clause loses its ID assertion under tests/.
+"""
+import dataclasses
+import json
+import os
+
+import pytest
+
+from tools.featmat.extract import (
+    GATE_FILES, extract_module, extract_sites, sites_by_id,
+)
+from tools.featmat.matrix import (
+    CELLS, COMPOSITIONS, FEATURES, RUNNERS, build_matrix,
+    consistency_findings, matrix_json, render_markdown,
+)
+from tools.simlint.core import ModuleInfo
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _base_spec():
+    from fognetsimpp_tpu.scenarios import smoke
+
+    spec, _state, _net, _bounds = smoke.build(
+        n_users=4, n_fogs=2, horizon=0.05, send_interval=0.01
+    )
+    return spec
+
+
+# ----------------------------------------------------------------------
+# extraction units
+# ----------------------------------------------------------------------
+
+def test_extraction_finds_definitions_with_roles():
+    sites = extract_sites(ROOT)
+    by_id = sites_by_id(sites)
+    # engine-owned clause: one definition in the engine
+    tp_chaos = by_id["TP-CHAOS"]
+    assert [s.role for s in tp_chaos] == ["definition"]
+    assert tp_chaos[0].relpath == "fognetsimpp_tpu/core/engine.py"
+    # spec-owned clause defined in spec.py, cited by the CLI
+    jt = by_id["SPEC-JOURNEYS-TELEM"]
+    roles = {s.relpath: s.role for s in jt}
+    assert roles["fognetsimpp_tpu/spec.py"] == "definition"
+    assert roles["fognetsimpp_tpu/__main__.py"] == "citation"
+
+
+def test_hier_template_synthesizes_at_call_sites():
+    """hier_reject_reason's f-string template defines [TP-HIER] at the
+    engine's call site and [FLEET-HIER] at the fleet's — and the
+    federation module itself (template + docstring prose) contributes
+    no sites at all."""
+    sites = extract_sites(ROOT)
+    by_id = sites_by_id(sites)
+    tp_defs = [s for s in by_id["TP-HIER"] if s.role == "definition"]
+    fl_defs = [s for s in by_id["FLEET-HIER"] if s.role == "definition"]
+    assert [s.relpath for s in tp_defs] == ["fognetsimpp_tpu/core/engine.py"]
+    assert [s.relpath for s in fl_defs] == [
+        "fognetsimpp_tpu/parallel/fleet.py"
+    ]
+    assert not any("federation.py" in s.relpath for s in sites)
+
+
+def test_docstring_mentions_are_not_sites():
+    """Prose about an ID (module/function docstrings) is not a gate."""
+    src = (
+        '"""Module prose citing [TP-CHAOS] is not a gate."""\n'
+        "def f():\n"
+        '    """Nor is [CLI-SWEEP-TP] here."""\n'
+        '    return "[TP-CHAOS] but this string IS a gate site"\n'
+    )
+    mod = ModuleInfo(
+        "fognetsimpp_tpu/core/engine.py",
+        "fognetsimpp_tpu/core/engine.py", src,
+    )
+    sites = extract_module(mod)
+    assert [(s.id, s.line, s.role) for s in sites] == [
+        ("TP-CHAOS", 4, "definition")
+    ]
+
+
+# ----------------------------------------------------------------------
+# matrix consistency + seeded fatal regressions
+# ----------------------------------------------------------------------
+
+def test_matrix_is_clean():
+    """The checked-in matrix, the gates, the hloaudit manifests and the
+    tests corpus agree — zero findings (the CI gate's green state)."""
+    assert consistency_findings(extract_sites(ROOT), ROOT) == []
+
+
+def test_deleted_gate_clause_is_fatal():
+    """Seeded regression: strip the [TP-CHAOS] clause out of the engine
+    source — the matrix still claims the rejection, so featmat must
+    report the deleted gate."""
+    rel = "fognetsimpp_tpu/core/engine.py"
+    sites = []
+    for gf in GATE_FILES:
+        full = os.path.join(ROOT, gf)
+        with open(full, encoding="utf-8") as fh:
+            src = fh.read()
+        if gf == rel:
+            src = src.replace("[TP-CHAOS] ", "")
+        sites += extract_module(ModuleInfo(full, gf, src))
+    findings = consistency_findings(sites, ROOT)
+    assert any(
+        f.startswith("deleted gate: [TP-CHAOS]") for f in findings
+    ), findings
+    # and ONLY that gate regressed
+    assert all("[TP-CHAOS]" in f or "untested" not in f for f in findings)
+
+
+def test_duplicate_definition_is_drift():
+    sites = extract_sites(ROOT)
+    dup = next(
+        s for s in sites
+        if s.id == "TP-CHAOS" and s.role == "definition"
+    )
+    findings = consistency_findings(
+        sites + [dataclasses.replace(dup, line=dup.line + 1)], ROOT
+    )
+    assert any(
+        f.startswith("drifting gate: [TP-CHAOS]") for f in findings
+    ), findings
+
+
+def test_dealiased_donation_is_fatal_a6():
+    """Seeded regression: a donating variant whose compiled module lost
+    its input_output_alias header must fail A6."""
+    from tools.hloaudit.audit import check_donation_alias
+    from tools.hloaudit.hlo import parse_hlo
+
+    body = (
+        "\n\nENTRY %main.1 (p0: f32[8]) -> f32[8] {\n"
+        "  ROOT %add.1 = f32[8]{0} add(f32[8]{0} %p0, f32[8]{0} %p0)\n"
+        "}\n"
+    )
+    aliased = parse_hlo(
+        "HloModule m, input_output_alias={ {0}: (0, {}, may-alias) }"
+        + body
+    )
+    dealiased = parse_hlo("HloModule m" + body)
+    assert len(aliased.input_output_aliases) == 1
+    assert aliased.input_output_aliases[0].param_number == 0
+    # honoured donation: clean
+    assert check_donation_alias(aliased, "v", donated=(1,)) == []
+    # silently-declined donation: fatal
+    bad = check_donation_alias(dealiased, "v", donated=(1,))
+    assert len(bad) == 1 and bad[0].rule == "A6"
+    # alias-count floor regression against the manifest: fatal
+    floor = check_donation_alias(
+        aliased, "v", donated=(1,),
+        manifest={"aliases": 3, "min_aliases": 2},
+    )
+    assert len(floor) == 1 and "regressed" in floor[0].message
+    # aliases on a variant that declares no donation: registry drift
+    undecl = check_donation_alias(aliased, "v", donated=())
+    assert len(undecl) == 1 and "no donation" in undecl[0].message
+
+
+def test_peak_memory_blowup_is_fatal_a7():
+    """Seeded regression: compiled peak bytes over the pinned budget
+    must fail A7; missing budget is itself a finding; a backend with no
+    memory stats skips."""
+    from tools.hloaudit.audit import check_peak_memory
+
+    mem = {"peak_bytes": 2048, "arg_bytes": 1024, "out_bytes": 512,
+           "temp_bytes": 768, "alias_bytes": 256}
+    assert check_peak_memory(mem, "v", budget=4096) == []
+    blown = check_peak_memory(mem, "v", budget=1024)
+    assert len(blown) == 1 and blown[0].rule == "A7"
+    assert "2048 > budget 1024" in blown[0].message
+    missing = check_peak_memory(mem, "v", budget=None)
+    assert len(missing) == 1 and "no pinned peak-memory" in missing[0].message
+    assert check_peak_memory(None, "v", budget=None) == []
+
+
+def test_live_donating_variants_actually_alias():
+    """The real A6 exemplars: the checked-in manifests of the donating
+    programs pin non-zero alias floors."""
+    for name in ("run_jit_donated", "fleet_step"):
+        p = os.path.join(
+            ROOT, "tools", "hloaudit", "manifests", f"{name}.json"
+        )
+        with open(p) as f:
+            m = json.load(f)
+        assert m["donated"], name
+        assert m["aliases"] >= 1 and m["min_aliases"] >= 1, name
+
+
+# ----------------------------------------------------------------------
+# golden artifacts
+# ----------------------------------------------------------------------
+
+def test_features_md_golden():
+    matrix = build_matrix(extract_sites(ROOT))
+    with open(os.path.join(ROOT, "FEATURES.md")) as f:
+        assert f.read() == render_markdown(matrix)
+
+
+def test_matrix_json_checked_in_and_valid():
+    matrix = build_matrix(extract_sites(ROOT))
+    with open(os.path.join(ROOT, "tools", "featmat", "matrix.json")) as f:
+        text = f.read()
+    assert text == matrix_json(matrix)
+    data = json.loads(text)
+    assert data["runners"] == list(RUNNERS)
+    # full feature x runner coverage, every cell exactly once
+    got = {(c["feature"], c["runner"]) for c in data["cells"]}
+    assert got == {(f, r) for f in FEATURES for r in RUNNERS}
+    assert len(data["cells"]) == len(got)
+    for c in data["cells"]:
+        assert c["verdict"] in ("accepted", "rejected", "untracked")
+        if c["verdict"] == "rejected":
+            assert c["sites"], c  # a rejection must have live gate sites
+            assert any(s["role"] == "definition" for s in c["sites"]), c
+    for p in data["compositions"]:
+        assert p["sites"], p
+
+
+# ----------------------------------------------------------------------
+# gate-driving rejection coverage (the rejected cells' ID assertions)
+# ----------------------------------------------------------------------
+
+# the bracketed literals below ARE the matrix's rejection coverage —
+# featmat greps tests/ for exactly these `[ID]` forms (gate 3)
+_TP_CASES = [
+    ("[TP-NOFOGS]", dict(n_fogs=0)),
+    ("[TP-CHAOS]", dict(chaos=True)),
+    ("[TP-POOL]", dict(fog_model=1)),  # FogModel.POOL
+    ("[TP-POLICY]", dict(policy=1)),  # Policy.ROUND_ROBIN: task-dependent
+    ("[TP-ARRIVALS]", dict(two_stage_arrivals=False)),
+    ("[TP-WINDOW]", dict(arrival_window=4)),
+    ("[TP-DYNTOPO]", dict(assume_static=False)),
+    ("[TP-ENERGY]", dict(energy_enabled=True)),
+    ("[TP-WIRED]", dict(wired_queue_enabled=True)),
+    ("[TP-SERIES]", dict(record_tick_series=True)),
+    ("[TP-HIER]", dict(n_brokers=2)),
+    ("[TP-JOURNEYS]", dict(telemetry=True, telemetry_journeys=4)),
+]
+
+
+@pytest.mark.parametrize("clause,overrides", _TP_CASES,
+                         ids=[c.strip("[]") for c, _ in _TP_CASES])
+def test_tp_gate_leads_with_its_clause_id(clause, overrides):
+    from fognetsimpp_tpu.core.engine import tp_reject_reason
+    from fognetsimpp_tpu.spec import FogModel, Policy
+
+    assert int(FogModel.POOL) == 1 and int(Policy.ROUND_ROBIN) == 1
+    spec = dataclasses.replace(_base_spec(), **overrides)
+    reason = tp_reject_reason(spec)
+    assert reason is not None and reason.startswith(clause)
+
+
+def test_tp_learn_clause_guards_behind_policy_gate(monkeypatch):
+    """[TP-LEARN] is the defensive belt behind [TP-POLICY] (learned
+    policies are not broker-dense); drive it by widening the dense
+    family so the learner clause is what fires."""
+    from fognetsimpp_tpu.core import engine
+    from fognetsimpp_tpu.spec import Policy
+
+    spec = dataclasses.replace(_base_spec(), policy=int(Policy.UCB))
+    assert engine.tp_reject_reason(spec).startswith("[TP-POLICY]")
+    monkeypatch.setattr(engine, "_broker_dense_ok", lambda s: True)
+    assert engine.tp_reject_reason(spec).startswith("[TP-LEARN]")
+
+
+def test_fleet_hier_gate_leads_with_its_clause_id():
+    from fognetsimpp_tpu.hier.federation import hier_reject_reason
+
+    spec = dataclasses.replace(_base_spec(), n_brokers=2)
+    assert hier_reject_reason(spec, "fleet").startswith("[FLEET-HIER]")
+    assert hier_reject_reason(_base_spec(), "fleet") is None
+
+
+_SPEC_CASES = [
+    ("[SPEC-STATIC-MAC]", dict(assume_static=True, mac_keyed=True)),
+    ("[SPEC-JOURNEYS-TELEM]",
+     dict(telemetry=False, telemetry_journeys=4)),
+    ("[SPEC-CHAOS-STATIC]", dict(chaos=True, assume_static=True)),
+    ("[SPEC-CHAOS-ENERGY]",
+     dict(chaos=True, assume_static=False, energy_enabled=True)),
+    ("[SPEC-HIER-POLICY]", dict(n_brokers=2, policy=1)),  # ROUND_ROBIN
+]
+
+
+@pytest.mark.parametrize("clause,overrides", _SPEC_CASES,
+                         ids=[c.strip("[]") for c, _ in _SPEC_CASES])
+def test_spec_validate_leads_with_its_clause_id(clause, overrides):
+    spec = dataclasses.replace(_base_spec(), **overrides)
+    with pytest.raises(ValueError) as e:
+        spec.validate()
+    assert clause in str(e.value)
+
+
+_SWEEP = ["--sweep", "policies=min_busy loads=0.05"]
+_CLI_ERROR_CASES = [
+    ("[CLI-SWEEP-TP]", ["--tp", "8", *_SWEEP]),
+    ("[CLI-SWEEP-HIER]", ["--brokers", "2", *_SWEEP]),
+    ("[CLI-SWEEP-SERIES]", ["--ticks", *_SWEEP]),
+    ("[CLI-SWEEP-TELEM]", ["--telemetry", *_SWEEP]),
+    ("[CLI-SWEEP-SERVE]", ["--hist", *_SWEEP]),
+    ("[CLI-CHECKIFY-SOLO]", ["--checkify", "--progress", "4"]),
+    ("[CLI-SERVE-SERIES]", ["--serve", "0", "--progress", "4"]),
+    ("[CLI-SERVE-FLEET]", ["--serve", "0", "--replicas", "8"]),
+    ("[CLI-FLEET-PROGRESS]", ["--replicas", "8", "--progress", "4"]),
+    ("[CLI-FLEET-TRAILS]", ["--replicas", "8", "--trails", "out.svg"]),
+    ("[CLI-PROGRESS-SERIES]", ["--progress", "4", "--ticks"]),
+]
+
+
+@pytest.mark.parametrize("clause,argv", _CLI_ERROR_CASES,
+                         ids=[c.strip("[]") for c, _ in _CLI_ERROR_CASES])
+def test_cli_guard_cites_its_clause_id(clause, argv, capsys):
+    from fognetsimpp_tpu.__main__ import main
+
+    args = ["--scenario", "smoke", "--set", "scenario.horizon=0.05",
+            *argv]
+    try:
+        rc = main(args)
+    except SystemExit as e:  # argparse ap.error() paths
+        rc = e.code
+    assert rc == 2
+    assert clause in capsys.readouterr().err

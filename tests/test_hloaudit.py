@@ -337,7 +337,7 @@ def test_tp_dryrun_compiles_with_only_declared_collectives():
     from tools.hloaudit.hlo import COLLECTIVE_OPS
     from tools.hloaudit.variants import _compile_tp
 
-    text, _ = _compile_tp()
+    text = _compile_tp().text
     mod = parse_hlo(text)
     seen = {
         (i.opcode[:-6] if i.opcode.endswith("-start") else i.opcode)
@@ -361,6 +361,7 @@ def test_budget_shape_audits_clean_against_manifest():
     from tools.hloaudit.__main__ import (
         audit_variant,
         load_manifest,
+        load_peak_budgets,
         measure_variant,
     )
     from tools.hloaudit.variants import variants
@@ -369,7 +370,9 @@ def test_budget_shape_audits_clean_against_manifest():
     measured = measure_variant(v)
     manifest = load_manifest(v.name)
     assert manifest is not None, "tick_fused manifest not checked in"
-    findings = audit_variant(measured, manifest)
+    peak = load_peak_budgets().get(v.name)
+    assert peak is not None, "tick_fused peak_bytes budget not pinned"
+    findings = audit_variant(measured, manifest, peak)
     assert findings == [], "\n".join(f.render() for f in findings)
     # the manifest's recorded counts are the live counts' caps
     assert measured["entry"]["ops"] <= manifest["max_ops"]

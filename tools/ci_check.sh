@@ -25,10 +25,19 @@ JAX_PLATFORMS=cpu python tools/op_budget.py --check > /dev/null
 
 echo "== hloaudit (compiled-artifact audit of every tick variant) =="
 # host transfers, f64 promotion chains, undeclared/degenerate
-# collectives, the f32 2^24 bound and golden audit manifests — over
-# fused/unfused x telemetry/hist x fleet x TP-dryrun compiles (the
+# collectives, the f32 2^24 bound, golden audit manifests, donation
+# aliasing (A6) and peak-buffer budgets (A7) — over fused/unfused x
+# telemetry/hist x fleet x TP-dryrun x accepted-cell compiles (the
 # 8-virtual-device CPU mesh is forced by the CLI itself)
 python -m tools.hloaudit --check > /dev/null
+
+echo "== featmat (feature-composition matrix consistency) =="
+# the gates' clause IDs vs the declared feature x runner matrix vs the
+# hloaudit variant registry vs the tests: a deleted/drifting rejection
+# clause, an untested rejection, an unevidenced acceptance, or a stale
+# FEATURES.md/matrix.json fails here (regen: python -m tools.featmat
+# --write)
+python -m tools.featmat --check > /dev/null
 
 echo "== bench trend (>10% regression gate over BENCH_r*/MULTICHIP_r*) =="
 python tools/bench_trend.py --check

@@ -1,0 +1,35 @@
+"""featmat: the feature-composition matrix auditor (ISSUE 16).
+
+The third static-analysis tier.  simlint (tools/simlint/) reads the
+SOURCE; hloaudit (tools/hloaudit/) reads the COMPILED artifacts; featmat
+reads the repo's *composition gates* — every ``tp_reject_reason`` /
+``hier_reject_reason`` / ``_check_fleet_spec`` / ``WorldSpec.validate``
+/ CLI guard-rail clause — and audits the feature × runner matrix they
+collectively imply:
+
+* **Extraction** (:mod:`.extract`): the gates' bracketed clause IDs
+  (``[TP-CHAOS]``, ``[FLEET-HIER]``, ``[SPEC-CHAOS-ENERGY]``,
+  ``[CLI-SWEEP-TP]``) are pulled out of the AST with file:line, split
+  into *definitions* (the site in the ID family's owning module) and
+  *citations* (a CLI one-liner re-keying on an engine gate's ID).
+* **The matrix** (:mod:`.matrix`): a declarative feature × runner table
+  plus a composition-pair table.  Every REJECTED cell names the clause
+  ID that enforces it; every ACCEPTED cell names its evidence — a
+  dedicated hloaudit variant (compiled + audited by
+  ``python -m tools.hloaudit --check``) or a pinned test literal.
+* **Consistency gates**: an extracted ID the matrix does not map, a
+  mapped ID whose gate site vanished (a deleted rejection clause!), two
+  definitions drifting for one cell, a rejected cell no test asserts,
+  or an accepted cell with no audit evidence — each IS a finding, and
+  ``python -m tools.featmat --check`` (tools/ci_check.sh) fails on any.
+
+``--write`` regenerates the two checked-in artifacts: the machine-
+readable ``tools/featmat/matrix.json`` and the human ``FEATURES.md``
+at the repo root; ``--check`` also fails when either is stale.
+"""
+from .extract import Site, extract_sites  # noqa: F401
+from .matrix import (  # noqa: F401
+    build_matrix,
+    consistency_findings,
+    render_markdown,
+)

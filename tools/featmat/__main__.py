@@ -1,0 +1,107 @@
+"""CLI for the feature-composition matrix auditor.
+
+  python -m tools.featmat             # print findings + cell summary
+  python -m tools.featmat --check     # exit 1 on findings/stale artifacts
+  python -m tools.featmat --write     # regenerate matrix.json + FEATURES.md
+  python -m tools.featmat --markdown  # FEATURES.md body on stdout
+
+Pure static analysis — no jax import, no compiles: extraction walks the
+gate files' ASTs, the consistency gates cross-reference the checked-in
+hloaudit manifests and the tests/ corpus as text.  The compile-side
+audit of every accepted cell is hloaudit's job (CI runs both).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+MATRIX_JSON = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "matrix.json"
+)
+FEATURES_MD = os.path.join(REPO_ROOT, "FEATURES.md")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.featmat",
+        description="feature-composition matrix auditor "
+        "(tools/featmat/__init__.py docstring)",
+    )
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any finding or stale artifact (CI)")
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate tools/featmat/matrix.json and "
+                    "FEATURES.md")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit the FEATURES.md body on stdout")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    from .extract import extract_sites
+    from .matrix import (
+        build_matrix, consistency_findings, matrix_json, render_markdown,
+    )
+
+    sites = extract_sites(args.root)
+    matrix = build_matrix(sites)
+    findings = consistency_findings(sites, args.root)
+
+    if args.write:
+        with open(MATRIX_JSON, "w") as f:
+            f.write(matrix_json(matrix))
+        with open(FEATURES_MD, "w") as f:
+            f.write(render_markdown(matrix))
+        print(f"wrote {MATRIX_JSON}", file=sys.stderr)
+        print(f"wrote {FEATURES_MD}", file=sys.stderr)
+    elif args.markdown:
+        print(render_markdown(matrix))
+    else:
+        counts = {"accepted": 0, "rejected": 0, "untracked": 0}
+        for c in matrix["cells"]:
+            counts[c["verdict"]] += 1
+        print(json.dumps({
+            "gate_sites": len(sites),
+            "clause_ids": len({s.id for s in sites}),
+            "cells": counts,
+            "compositions": len(matrix["compositions"]),
+        }))
+
+    # stale-artifact detection (also under --check after --write runs
+    # in the same CI job order: write is never run by CI)
+    if not args.write:
+        def stale(path: str, want: str) -> bool:
+            if not os.path.exists(path):
+                return True
+            with open(path) as f:
+                return f.read() != want
+        if stale(MATRIX_JSON, matrix_json(matrix)):
+            findings.append(
+                "stale artifact: tools/featmat/matrix.json does not "
+                "match the extracted matrix — regenerate with "
+                "`python -m tools.featmat --write` and commit"
+            )
+        if stale(FEATURES_MD, render_markdown(matrix)):
+            findings.append(
+                "stale artifact: FEATURES.md does not match the "
+                "extracted matrix — regenerate with `python -m "
+                "tools.featmat --write` and commit"
+            )
+
+    for f_ in findings:
+        print(f"featmat: {f_}", file=sys.stderr)
+    print(
+        f"featmat: {len({s.id for s in sites})} clause ID(s), "
+        + ("clean" if not findings else f"{len(findings)} finding(s)"),
+        file=sys.stderr,
+    )
+    return 1 if (args.check and findings) else 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
+    sys.exit(main())

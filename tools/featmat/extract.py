@@ -1,0 +1,159 @@
+"""AST extraction of composition-gate clause IDs (the featmat front-end).
+
+A *gate site* is any string constant in one of the ``GATE_FILES`` whose
+text carries a bracketed clause ID — ``[TP-CHAOS]``, ``[SPEC-STATIC-MAC]``,
+``[CLI-SWEEP-TP]`` — the stable machine-parseable keys the rejection
+prose leads with (core/engine.tp_reject_reason's docstring states the
+contract).  Docstrings are excluded: prose ABOUT an ID is not a gate.
+
+Two site roles:
+
+* **definition** — the site lives in the module that OWNS the ID's
+  family (``OWNER_OF``: ``TP-*`` → the engine, ``FLEET-*`` → the fleet
+  runner, ``SPEC-*`` → spec.py, ``CLI-*`` → the CLI).  Exactly one
+  definition per ID is the no-drift invariant matrix.py enforces.
+* **citation** — the same ID in any other gate file: a CLI one-liner
+  keying on an engine gate's cell (``[TP-SERIES]`` in __main__.py)
+  instead of re-wording it.  Citations are the anti-drift mechanism,
+  not drift.
+
+The one parameterized clause — ``hier/federation.hier_reject_reason``'s
+``f"[{runner.upper()}-HIER] ..."`` template, the shared message source
+for the TP and fleet hierarchy gates — cannot be read off a plain
+constant, so extraction synthesizes the concrete ``[TP-HIER]`` /
+``[FLEET-HIER]`` definitions at the CALL sites that pass the literal
+runner name.  Parsing reuses simlint's :class:`~tools.simlint.core.
+ModuleInfo` (AST + parent links + line texts): one parser family across
+all three analysis tiers.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Set
+
+from tools.simlint.core import ModuleInfo, dotted
+
+#: The composition-gate surfaces (repo-relative).  A new gate module
+#: must be added here or its clauses are invisible to the matrix — and
+#: the matrix's unmapped-ID gate fires the moment one of ITS IDs shows
+#: up anywhere else, so the list cannot rot silently.
+GATE_FILES = (
+    "fognetsimpp_tpu/spec.py",
+    "fognetsimpp_tpu/core/engine.py",
+    "fognetsimpp_tpu/hier/federation.py",
+    "fognetsimpp_tpu/parallel/fleet.py",
+    "fognetsimpp_tpu/__main__.py",
+)
+
+#: ID-family prefix -> the ONE module allowed to define its clauses.
+OWNER_OF = {
+    "TP": "fognetsimpp_tpu/core/engine.py",
+    "FLEET": "fognetsimpp_tpu/parallel/fleet.py",
+    "SPEC": "fognetsimpp_tpu/spec.py",
+    "CLI": "fognetsimpp_tpu/__main__.py",
+}
+
+_ID_RE = re.compile(r"\[((?:TP|FLEET|SPEC|CLI)-[A-Z0-9-]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    """One gate site: clause ID + where it lives + its role."""
+
+    id: str
+    relpath: str
+    line: int
+    role: str  # "definition" | "citation"
+    text: str  # the source line (trimmed), for rendering
+
+    def render(self) -> str:
+        return f"{self.relpath}:{self.line} [{self.id}] ({self.role})"
+
+
+def _docstring_constants(tree: ast.AST) -> Set[int]:
+    """``id()`` of every docstring Constant node (excluded from
+    extraction: prose about an ID is not a gate)."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef,
+             ast.ClassDef),
+        ):
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+def _role(clause_id: str, relpath: str) -> str:
+    prefix = clause_id.split("-", 1)[0]
+    owner = OWNER_OF.get(prefix)
+    return "definition" if owner == relpath else "citation"
+
+
+def extract_module(mod: ModuleInfo) -> List[Site]:
+    """All gate sites of one parsed gate file."""
+    sites: List[Site] = []
+    seen: Set[tuple] = set()
+    docstrings = _docstring_constants(mod.tree)
+
+    def add(clause_id: str, lineno: int, role: str) -> None:
+        key = (clause_id, lineno)
+        if key in seen:
+            return
+        seen.add(key)
+        sites.append(Site(
+            id=clause_id,
+            relpath=mod.relpath,
+            line=lineno,
+            role=role,
+            text=mod.line_text(lineno),
+        ))
+
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in docstrings
+        ):
+            for m in _ID_RE.finditer(node.value):
+                add(m.group(1), node.lineno, _role(m.group(1), mod.relpath))
+        elif isinstance(node, ast.Call):
+            # the hier template: hier_reject_reason(spec, "<runner>")
+            # defines [<RUNNER>-HIER] at the call site
+            name = dotted(node.func) or ""
+            if name.split(".")[-1] != "hier_reject_reason":
+                continue
+            if len(node.args) >= 2 and isinstance(
+                node.args[1], ast.Constant
+            ) and isinstance(node.args[1].value, str):
+                clause_id = f"{node.args[1].value.upper()}-HIER"
+                add(clause_id, node.lineno, _role(clause_id, mod.relpath))
+    return sites
+
+
+def extract_sites(root: str) -> List[Site]:
+    """Every gate site under repo root ``root`` (sorted by file, line)."""
+    sites: List[Site] = []
+    for rel in GATE_FILES:
+        full = os.path.join(root, rel)
+        with open(full, encoding="utf-8") as fh:
+            src = fh.read()
+        sites += extract_module(ModuleInfo(full, rel, src))
+    return sorted(sites, key=lambda s: (s.relpath, s.line, s.id))
+
+
+def sites_by_id(sites: List[Site]) -> Dict[str, List[Site]]:
+    out: Dict[str, List[Site]] = {}
+    for s in sites:
+        out.setdefault(s.id, []).append(s)
+    return out

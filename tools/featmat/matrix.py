@@ -1,0 +1,451 @@
+"""The declarative feature-composition matrix + consistency gates.
+
+Two tables:
+
+* :data:`CELLS` — the feature × runner matrix over the three engine
+  runners (``run`` = single-device run/run_jit/run_chunked, ``tp`` =
+  the shard_map'd TP tick, ``fleet`` = the replica-sharded fleet vmap).
+  Verdicts: ``accepted`` (must carry evidence — a dedicated hloaudit
+  variant or a pinned test literal), ``rejected`` (must carry the
+  clause ID whose gate enforces it), or ``untracked`` (no gate and no
+  pinned evidence yet: honest open coverage debt, rendered ``·`` and
+  listed in FEATURES.md, never silently dropped).
+* :data:`COMPOSITIONS` — the feature × feature / CLI-mode rejection
+  pairs (``[SPEC-*]`` spec-validation clauses and ``[CLI-*]`` guard
+  rails) that do not fit a runner column.
+
+The consistency gates (:func:`consistency_findings`) tie the tables to
+the extracted gate sites and to the other two analysis tiers:
+
+1. every extracted clause ID must be mapped (a cell clause or a
+   composition entry) — an unmapped ID is a gate the matrix has never
+   reviewed;
+2. every mapped ID must keep exactly ONE definition site in its owning
+   module — zero means the gate was DELETED while the matrix/CLI/tests
+   still claim it (the deleted-gate CI failure), two means drift;
+3. every rejected clause must be asserted by tests (the literal
+   ``[ID]`` under ``tests/``) — unasserted rejections rot into prose;
+4. every accepted cell's evidence must exist: ``variant:<name>`` needs
+   the checked-in hloaudit manifest (the variant is then compiled and
+   A1–A7-audited by ``python -m tools.hloaudit --check`` in CI), and
+   ``test:<literal>`` must appear under ``tests/``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .extract import OWNER_OF, Site, sites_by_id
+
+RUNNERS = ("run", "tp", "fleet")
+
+#: feature key -> one-line description (FEATURES.md row legend).
+FEATURES = {
+    "baseline": "dense-broker FIFO static two-stage world (the op-budget family)",
+    "telemetry": "carry-resident telemetry accumulators (+ latency histogram)",
+    "series": "per-tick series recording (record_tick_series)",
+    "window": "bounded K-window arrival regime (arrival_window)",
+    "dyntopo": "dynamic topology / liveness (assume_static off)",
+    "energy": "energy & lifecycle model (battery drain, shutdown/restart)",
+    "wired": "DropTail wired-queue backpressure",
+    "learn": "bandit learner broker policies (UCB/DUCB/EXP3)",
+    "pool": "POOL phase-sequential fog servers",
+    "sparse_policy": "non-dense broker policy family (sequential-pool scoring)",
+    "legacy_arrivals": "single-stage arrival front-end (two_stage_arrivals off)",
+    "no_fogs": "fog-free worlds (local-only execution)",
+    "chaos": "chaos fault-injection schedules",
+    "hier": "federated multi-broker hierarchy (n_brokers > 1)",
+    "journeys": "causal task-journey event rings",
+    "dynspec": "DynSpec-promoted numeric knobs (zero-recompile reconfig)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Cell:
+    feature: str
+    runner: str
+    verdict: str  # "accepted" | "rejected" | "untracked"
+    clause: Optional[str] = None  # rejected: the enforcing clause ID
+    evidence: Tuple[str, ...] = ()  # accepted: variant:<v> / test:<lit>
+
+
+def _a(f, r, *evidence) -> Cell:
+    return Cell(f, r, "accepted", evidence=tuple(evidence))
+
+
+def _r(f, r, clause) -> Cell:
+    return Cell(f, r, "rejected", clause=clause)
+
+
+def _u(f, r) -> Cell:
+    return Cell(f, r, "untracked")
+
+
+CELLS: Tuple[Cell, ...] = (
+    _a("baseline", "run", "variant:tick_fused"),
+    _a("baseline", "tp", "variant:tp_tick"),
+    _a("baseline", "fleet", "variant:fleet_step"),
+    _a("telemetry", "run", "variant:tick_telemetry", "variant:tick_hist"),
+    _a("telemetry", "tp", "variant:tp_tick_telemetry"),
+    _u("telemetry", "fleet"),
+    _a("series", "run", "variant:tick_series"),
+    _r("series", "tp", "TP-SERIES"),
+    _a("series", "fleet",
+       "test:test_fleet_series_chunked_matches_straight_recording"),
+    _a("window", "run", "variant:tick_window"),
+    _r("window", "tp", "TP-WINDOW"),
+    _u("window", "fleet"),
+    _a("dyntopo", "run", "test:assume_static=False"),
+    _r("dyntopo", "tp", "TP-DYNTOPO"),
+    _u("dyntopo", "fleet"),
+    _a("energy", "run", "variant:tick_energy"),
+    _r("energy", "tp", "TP-ENERGY"),
+    _u("energy", "fleet"),
+    _a("wired", "run", "variant:tick_wired"),
+    _r("wired", "tp", "TP-WIRED"),
+    _u("wired", "fleet"),
+    _a("learn", "run", "variant:tick_learn"),
+    _r("learn", "tp", "TP-LEARN"),
+    _u("learn", "fleet"),
+    _a("pool", "run", "variant:tick_pool"),
+    _r("pool", "tp", "TP-POOL"),
+    _u("pool", "fleet"),
+    _a("sparse_policy", "run", "test:test_policies_end_to_end"),
+    _r("sparse_policy", "tp", "TP-POLICY"),
+    _u("sparse_policy", "fleet"),
+    _a("legacy_arrivals", "run", "test:two_stage_arrivals=False"),
+    _r("legacy_arrivals", "tp", "TP-ARRIVALS"),
+    _u("legacy_arrivals", "fleet"),
+    _u("no_fogs", "run"),
+    _r("no_fogs", "tp", "TP-NOFOGS"),
+    _u("no_fogs", "fleet"),
+    _a("chaos", "run", "variant:tick_chaos"),
+    _r("chaos", "tp", "TP-CHAOS"),
+    _a("chaos", "fleet",
+       "test:test_fleet_chaos_per_replica_schedules_match_vmap"),
+    _a("hier", "run", "variant:tick_hier"),
+    _r("hier", "tp", "TP-HIER"),
+    _r("hier", "fleet", "FLEET-HIER"),
+    _a("journeys", "run", "variant:tick_journeys"),
+    _r("journeys", "tp", "TP-JOURNEYS"),
+    _a("journeys", "fleet", "test:test_fleet_vmap_carries_journey_rings"),
+    _a("dynspec", "run", "variant:tick_dyn"),
+    _u("dynspec", "tp"),
+    _u("dynspec", "fleet"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Composition:
+    """A rejected feature × feature / CLI-mode pair that no runner
+    column captures; ``clause`` is the enforcing ID."""
+
+    clause: str
+    a: str
+    b: str
+    note: str
+
+
+COMPOSITIONS: Tuple[Composition, ...] = (
+    Composition("SPEC-STATIC-MAC", "dyntopo-hoist", "mac80211",
+                "the CSMA/CA MAC resolves per-tick contention; the "
+                "static-association hoist would freeze it"),
+    Composition("SPEC-JOURNEYS-TELEM", "journeys", "telemetry-off",
+                "journey rings ride TelemetryState; journeys>0 needs "
+                "telemetry_every>0"),
+    Composition("SPEC-CHAOS-STATIC", "chaos", "dyntopo-hoist",
+                "chaos mutates fog liveness; assume_static would freeze "
+                "the association cache"),
+    Composition("SPEC-CHAOS-ENERGY", "chaos", "energy",
+                "both subsystems own fog liveness; composing their "
+                "writes is a follow-up"),
+    Composition("SPEC-HIER-POLICY", "hier", "sparse_policy",
+                "only the dense-broker policy family federates "
+                "(per-domain decide masks)"),
+    Composition("CLI-CHECKIFY-SOLO", "checkify", "fan-out/series",
+                "the checkify debug slow path is single-world only"),
+    Composition("CLI-TP-FLEET", "tp", "fleet",
+                "one parallel axis per run: TP shards one world, the "
+                "fleet fans out many"),
+    Composition("CLI-TPWINDOW", "tp-window-knob", "tp-off",
+                "--tp-window refines --tp; meaningless without it"),
+    Composition("CLI-SWEEP-TP", "sweep", "tp",
+                "sweeps own their replica fan-out"),
+    Composition("CLI-SWEEP-HIER", "sweep", "hier",
+                "sweeps own their replica fan-out; no hierarchy"),
+    Composition("CLI-SWEEP-CHAOS", "sweep", "chaos",
+                "chaos perturbs one world; sweeps grid many"),
+    Composition("CLI-SWEEP-SERIES", "sweep", "series",
+                "sweeps return counter grids, not series"),
+    Composition("CLI-SWEEP-TELEM", "sweep", "telemetry",
+                "sweeps return counter grids, not a final world"),
+    Composition("CLI-SWEEP-SERVE", "sweep", "serve",
+                "sweeps return counter grids, not a live world"),
+    Composition("CLI-SWEEP-FLEET", "sweep", "fleet",
+                "sweeps own their replica fan-out (reps=)"),
+    Composition("CLI-SWEEP-POLICY", "sweep", "policy-flag",
+                "the sweep grid owns the policy axis"),
+    Composition("CLI-CHAOS-KNOBS", "chaos-knobs", "chaos-off",
+                "chaos knobs refine a --chaos profile"),
+    Composition("CLI-HIERPOLICY", "hier-policy-knob", "hier-off",
+                "--hier-policy refines --brokers"),
+    Composition("CLI-SERVE-SERIES", "serve", "series",
+                "serving owns the chunking; no per-tick series flags"),
+    Composition("CLI-SERVE-FLEET", "serve", "fleet",
+                "serving is a single-world loop"),
+    Composition("CLI-FLEET-PROGRESS", "fleet", "progress",
+                "the fleet scan is one jitted program; no host ticks"),
+    Composition("CLI-FLEET-TRAILS", "fleet", "trails",
+                "per-replica trails would fetch the whole batch"),
+    Composition("CLI-PROGRESS-SERIES", "progress", "series",
+                "progress chunking and straight series recording "
+                "conflict"),
+)
+
+
+# ----------------------------------------------------------------------
+# matrix build + consistency gates
+# ----------------------------------------------------------------------
+
+def build_matrix(sites: List[Site]) -> dict:
+    """The canonical machine-readable matrix: cells + compositions,
+    each rejected entry annotated with its extracted gate sites."""
+    by_id = sites_by_id(sites)
+
+    def site_rows(clause: Optional[str]) -> List[dict]:
+        return [
+            {"file": s.relpath, "line": s.line, "role": s.role}
+            for s in by_id.get(clause, [])
+        ]
+
+    return {
+        "_comment": (
+            "generated by `python -m tools.featmat --write` — do not "
+            "edit; the feature x runner composition matrix extracted "
+            "from the repo's gate clauses (see tools/featmat/)"
+        ),
+        "runners": list(RUNNERS),
+        "features": dict(FEATURES),
+        "cells": [
+            {
+                "feature": c.feature,
+                "runner": c.runner,
+                "verdict": c.verdict,
+                **({"clause": c.clause} if c.clause else {}),
+                **({"evidence": list(c.evidence)} if c.evidence else {}),
+                **(
+                    {"sites": site_rows(c.clause)}
+                    if c.verdict == "rejected" else {}
+                ),
+            }
+            for c in CELLS
+        ],
+        "compositions": [
+            {
+                "clause": p.clause, "a": p.a, "b": p.b, "note": p.note,
+                "sites": site_rows(p.clause),
+            }
+            for p in COMPOSITIONS
+        ],
+    }
+
+
+def _tests_corpus(root: str) -> str:
+    """Concatenated source of every tests/*.py (rejection-coverage and
+    test-evidence lookups)."""
+    parts = []
+    tdir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tdir)):
+        if name.endswith(".py"):
+            with open(os.path.join(tdir, name), encoding="utf-8") as fh:
+                parts.append(fh.read())
+    return "\n".join(parts)
+
+
+def _manifest_exists(root: str, variant: str) -> bool:
+    return os.path.exists(os.path.join(
+        root, "tools", "hloaudit", "manifests", f"{variant}.json"
+    ))
+
+
+def consistency_findings(sites: List[Site], root: str) -> List[str]:
+    """The featmat CI gate: every inconsistency between the declarative
+    matrix, the extracted gate sites, the hloaudit variant registry and
+    the test suite, as rendered finding strings (empty = clean)."""
+    findings: List[str] = []
+    by_id = sites_by_id(sites)
+    mapped: Dict[str, str] = {}
+    for c in CELLS:
+        if c.clause:
+            mapped[c.clause] = f"cell {c.feature}x{c.runner}"
+    for p in COMPOSITIONS:
+        mapped.setdefault(p.clause, f"composition {p.a}x{p.b}")
+
+    # 1. every extracted ID is mapped
+    for clause_id in sorted(by_id):
+        if clause_id not in mapped:
+            s = by_id[clause_id][0]
+            findings.append(
+                f"unmapped gate: [{clause_id}] at {s.relpath}:{s.line} "
+                "is enforced in code but absent from the featmat matrix "
+                "— add the cell/composition entry (tools/featmat/"
+                "matrix.py) and regenerate with --write"
+            )
+
+    # 2. every mapped ID keeps exactly one definition in its owner file
+    for clause_id, where in sorted(mapped.items()):
+        defs = [
+            s for s in by_id.get(clause_id, []) if s.role == "definition"
+        ]
+        if not defs:
+            owner = OWNER_OF.get(clause_id.split("-", 1)[0], "?")
+            cites = by_id.get(clause_id, [])
+            extra = (
+                "; still cited at "
+                + ", ".join(f"{s.relpath}:{s.line}" for s in cites)
+                if cites else ""
+            )
+            findings.append(
+                f"deleted gate: [{clause_id}] ({where}) has no "
+                f"definition site left in {owner}{extra} — the matrix "
+                "claims a rejection no code enforces; restore the gate "
+                "or re-verdict the cell WITH audit coverage"
+            )
+        elif len(defs) > 1:
+            locs = ", ".join(f"{s.relpath}:{s.line}" for s in defs)
+            findings.append(
+                f"drifting gate: [{clause_id}] ({where}) is defined "
+                f"{len(defs)} times ({locs}) — one cell, one defining "
+                "clause; make the extra sites citations of the one "
+                "message source"
+            )
+
+    # 3. every rejected clause is asserted by tests
+    corpus = _tests_corpus(root)
+    for clause_id, where in sorted(mapped.items()):
+        if f"[{clause_id}]" not in corpus:
+            findings.append(
+                f"untested rejection: [{clause_id}] ({where}) is never "
+                "asserted under tests/ — add a test that drives the "
+                "gate and asserts the literal ID"
+            )
+
+    # 4. accepted-cell evidence exists
+    for c in CELLS:
+        if c.verdict != "accepted":
+            continue
+        if not c.evidence:
+            findings.append(
+                f"unevidenced acceptance: cell {c.feature}x{c.runner} "
+                "is accepted with no evidence — name an hloaudit "
+                "variant or a test literal"
+            )
+        for ev in c.evidence:
+            kind, _, val = ev.partition(":")
+            if kind == "variant" and not _manifest_exists(root, val):
+                findings.append(
+                    f"unaudited acceptance: cell {c.feature}x{c.runner} "
+                    f"claims hloaudit variant '{val}' but tools/"
+                    f"hloaudit/manifests/{val}.json does not exist — "
+                    "register the variant and `python -m tools.hloaudit "
+                    "--write`"
+                )
+            elif kind == "test" and val not in corpus:
+                findings.append(
+                    f"unevidenced acceptance: cell {c.feature}x"
+                    f"{c.runner} pins test literal '{val}' which "
+                    "appears nowhere under tests/"
+                )
+            elif kind not in ("variant", "test"):
+                findings.append(
+                    f"bad evidence kind '{kind}' on cell "
+                    f"{c.feature}x{c.runner} (want variant:/test:)"
+                )
+    return findings
+
+
+# ----------------------------------------------------------------------
+# rendering
+# ----------------------------------------------------------------------
+
+_MARK = {"accepted": "yes", "rejected": "no", "untracked": "·"}
+
+
+def render_markdown(matrix: dict) -> str:
+    """FEATURES.md body: the feature × runner table, the composition
+    table, and the untracked-cell debt list."""
+    cells = {
+        (c["feature"], c["runner"]): c for c in matrix["cells"]
+    }
+    lines = [
+        "# Feature-composition matrix",
+        "",
+        "Generated by `python -m tools.featmat --write` from the gate",
+        "clauses themselves (`tools/featmat/`); `--check` fails CI when",
+        "this file, the gates, the hloaudit variants or the tests drift",
+        "apart.  **yes** cells name their audit evidence (an hloaudit",
+        "variant compiled + A1–A7-checked in CI, or a pinned test);",
+        "**no** cells name the machine-parseable clause ID the rejection",
+        "leads with (assert THESE in tests, never the prose); `·` cells",
+        "are open coverage debt — no gate rejects them, no evidence",
+        "pins them.",
+        "",
+        "| feature | " + " | ".join(matrix["runners"]) + " |",
+        "|---|" + "---|" * len(matrix["runners"]),
+    ]
+    for feat in matrix["features"]:
+        row = [f"| {feat} "]
+        for runner in matrix["runners"]:
+            c = cells[(feat, runner)]
+            if c["verdict"] == "accepted":
+                ev = ", ".join(
+                    e.split(":", 1)[1] for e in c.get("evidence", ())
+                )
+                row.append(f"| yes ({ev}) ")
+            elif c["verdict"] == "rejected":
+                row.append(f"| no `[{c['clause']}]` ")
+            else:
+                row.append("| · ")
+        lines.append("".join(row) + "|")
+    lines += [
+        "",
+        "Feature legend:",
+        "",
+    ]
+    for feat, desc in matrix["features"].items():
+        lines.append(f"- **{feat}** — {desc}")
+    lines += [
+        "",
+        "## Rejected compositions (spec-validation + CLI guard rails)",
+        "",
+        "| clause | pair | why |",
+        "|---|---|---|",
+    ]
+    for p in matrix["compositions"]:
+        lines.append(
+            f"| `[{p['clause']}]` | {p['a']} × {p['b']} | {p['note']} |"
+        )
+    untracked = sorted(
+        (c["feature"], c["runner"]) for c in matrix["cells"]
+        if c["verdict"] == "untracked"
+    )
+    lines += [
+        "",
+        "## Open coverage debt (untracked cells)",
+        "",
+    ]
+    for feat, runner in untracked:
+        lines.append(f"- {feat} × {runner}")
+    lines += [
+        "",
+        "Machine-readable form: `tools/featmat/matrix.json` (same",
+        "`--write`).  Gate-site file:line detail lives there.",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def matrix_json(matrix: dict) -> str:
+    return json.dumps(matrix, indent=1) + "\n"

@@ -8,8 +8,14 @@
 
 Findings are fatal in CI exactly like simlint: `tools/ci_check.sh` runs
 ``--check`` over every variant, so a hidden host transfer, a surviving
-f64 promotion, an undeclared collective or a phase-attribution drift in
-ANY compiled tick variant fails the build before it reaches hardware.
+f64 promotion, an undeclared collective, a phase-attribution drift, a
+silently-declined donation (A6) or a peak-memory blowup (A7) in ANY
+compiled tick variant fails the build before it reaches hardware.
+
+``--write`` regenerates TWO artifacts: the per-variant manifests under
+``manifests/`` (op/fusion caps, phase set, alias floors) and the
+``"peak_bytes"`` table inside ``tools/op_budget.json`` (A7's budgets —
+read-modify-written so op_budget's own keys survive, and vice versa).
 """
 from __future__ import annotations
 
@@ -25,10 +31,38 @@ from .variants import ensure_devices
 MANIFEST_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "manifests"
 )
+#: A7's budgets live INSIDE the op-budget file (top-level "peak_bytes"
+#: table) — one pinned-numbers artifact for compiled-cost regressions.
+OP_BUDGET_JSON = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "op_budget.json",
+)
 
 
 def manifest_path(variant: str) -> str:
     return os.path.join(MANIFEST_DIR, f"{variant}.json")
+
+
+def load_peak_budgets() -> dict:
+    """The ``"peak_bytes"`` table of tools/op_budget.json ({} when the
+    file or table is absent)."""
+    if not os.path.exists(OP_BUDGET_JSON):
+        return {}
+    with open(OP_BUDGET_JSON) as f:
+        return json.load(f).get("peak_bytes", {})
+
+
+def write_peak_budgets(budgets: dict) -> None:
+    """Read-modify-write the budget file so ``tools/op_budget.py
+    --write``'s own keys survive regeneration (and vice versa)."""
+    data = {}
+    if os.path.exists(OP_BUDGET_JSON):
+        with open(OP_BUDGET_JSON) as f:
+            data = json.load(f)
+    data["peak_bytes"] = dict(sorted(budgets.items()))
+    with open(OP_BUDGET_JSON, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
 
 
 def load_manifest(variant: str) -> Optional[dict]:
@@ -45,13 +79,14 @@ def measure_variant(v) -> dict:
     from .hlo import COLLECTIVE_OPS, base_collective, parse_hlo
     from .variants import declared_for
 
-    text, spec = v.compile_fn()
-    mod = parse_hlo(text)
+    art = v.compile_fn()
+    mod = parse_hlo(art.text)
     counts = mod.entry_op_counts()
     collectives = sorted({
         base_collective(i.opcode) for i in mod.all_instructions()
         if base_collective(i.opcode) in COLLECTIVE_OPS
     })
+    n_aliases = len(mod.input_output_aliases)
     return {
         "variant": v.name,
         "description": v.description,
@@ -63,13 +98,23 @@ def measure_variant(v) -> dict:
         "max_fusions": math.ceil(counts["fusions"] * COUNT_SLACK),
         "phases": mod.phase_op_counts(),
         "collectives": collectives,
+        # A6: compiled donation contract — alias count with a FLOOR
+        # (aliases must not silently vanish; growing is fine)
+        "donated": sorted(v.donated),
+        "aliases": n_aliases,
+        "min_aliases": math.floor(n_aliases / COUNT_SLACK),
         "_module": mod,  # stripped before serialization
-        "_spec": spec,
+        "_spec": art.spec,
+        "_mem": art.mem,
         "_declared": declared_for(v),
     }
 
 
-def audit_variant(measured: dict, manifest: Optional[dict]) -> List:
+def audit_variant(
+    measured: dict,
+    manifest: Optional[dict],
+    peak_budget: Optional[int] = None,
+) -> List:
     from .audit import audit_module
 
     return audit_module(
@@ -79,6 +124,9 @@ def audit_variant(measured: dict, manifest: Optional[dict]) -> List:
         sharded=measured["sharded"],
         declared_collectives=measured["_declared"],
         manifest=manifest,
+        donated=measured["donated"],
+        mem=measured["_mem"],
+        peak_budget=peak_budget,
     )
 
 
@@ -137,6 +185,8 @@ def main(argv=None) -> int:
 
     findings = []
     rows = []
+    peaks = load_peak_budgets()
+    from .audit import COUNT_SLACK
     for v in vs:
         measured = measure_variant(v)
         rows.append(measured)
@@ -146,10 +196,21 @@ def main(argv=None) -> int:
                 json.dump(_serializable(measured), f, indent=1)
                 f.write("\n")
             print(f"wrote {manifest_path(v.name)}", file=sys.stderr)
+            if measured["_mem"] is not None:
+                peaks[v.name] = math.ceil(
+                    measured["_mem"]["peak_bytes"] * COUNT_SLACK
+                )
             continue
-        findings += audit_variant(measured, load_manifest(v.name))
+        findings += audit_variant(
+            measured, load_manifest(v.name), peaks.get(v.name)
+        )
 
     if args.write:
+        write_peak_budgets(peaks)
+        print(
+            f"wrote peak_bytes budgets for {len(peaks)} variant(s) into "
+            f"{OP_BUDGET_JSON}", file=sys.stderr,
+        )
         return 0
     if args.markdown:
         # table on stdout (for embedding); findings still fall through

@@ -29,6 +29,21 @@ failure mode the ROADMAP's TP-sharding promotion is most likely to ship:
   attributed PHASE SET exactly: a phase whose ``named_scope`` vanishes
   from the compiled artifact is a silent observability regression even
   when counts stay flat.
+* **A6 donation-alias** — every variant that declares donated operands
+  (``Variant.donated``, mirroring its ``donate_argnums``) must compile
+  to a module whose header actually carries ``input_output_alias``
+  entries, and at least the manifest's floor-slack count of them: a
+  donation XLA silently declined (a dtype/layout mismatch, a consumed
+  operand) doubles the steady-state carry footprint with ZERO source
+  diff and no warning.  The inverse drifts too: aliases on a variant
+  that declares no donation mean the registry lost track of a
+  ``donate_argnums`` site.
+* **A7 peak-memory budgets** — per-variant peak device-buffer budgets
+  (``compiled.memory_analysis()``: argument + output + temp − aliased
+  bytes) pinned in ``tools/op_budget.json``'s ``"peak_bytes"`` table
+  with the same ceil-slack/``--write`` discipline as op counts: a
+  fusion-boundary change that blows up temp buffers is invisible in op
+  counts and source alike, and on real accelerators it is an OOM.
 """
 from __future__ import annotations
 
@@ -240,6 +255,78 @@ def check_manifest(
     return out
 
 
+def check_donation_alias(
+    mod: HloModule,
+    variant: str,
+    donated: Sequence[int] = (),
+    manifest: Optional[dict] = None,
+) -> List[AuditFinding]:
+    """A6: declared donations must compile to live ``input_output_alias``
+    entries (and at least the manifest's floor-slack count of them);
+    aliases on a non-donating variant mean the registry lost a
+    ``donate_argnums`` site."""
+    n = len(mod.input_output_aliases)
+    out = []
+    if donated and n == 0:
+        out.append(AuditFinding(
+            "A6", variant,
+            f"donate_argnums={tuple(donated)} declared but the compiled "
+            "module carries NO input_output_alias entries: XLA silently "
+            "declined every donation (dtype/layout mismatch or a "
+            "consumed operand) — the steady-state carry is paying double "
+            "its footprint",
+        ))
+    if not donated and n > 0:
+        out.append(AuditFinding(
+            "A6", variant,
+            f"{n} input_output_alias entr{'y' if n == 1 else 'ies'} in a "
+            "variant that declares no donation: record the compile's "
+            "donate_argnums on the Variant (donated=...) so A6 guards it",
+        ))
+    if donated and manifest is not None:
+        floor = manifest.get("min_aliases")
+        if floor is not None and n < floor:
+            out.append(AuditFinding(
+                "A6", variant,
+                f"donated-buffer alias count regressed: {n} < manifest "
+                f"floor {floor} (recorded {manifest.get('aliases')}): "
+                "some carry leaves stopped aliasing — find the de-aliased "
+                "leaf before regenerating with --write",
+            ))
+    return out
+
+
+def check_peak_memory(
+    mem: Optional[dict], variant: str, budget: Optional[int]
+) -> List[AuditFinding]:
+    """A7: compiled peak device-buffer bytes within the pinned budget
+    (``tools/op_budget.json``'s ``"peak_bytes"`` table).  ``mem`` is the
+    ``CompiledArtifact.mem`` dict (None when the backend exposes no
+    ``memory_analysis()`` — then the rule skips)."""
+    if mem is None:
+        return []
+    if budget is None:
+        return [AuditFinding(
+            "A7", variant,
+            "no pinned peak-memory budget in tools/op_budget.json "
+            "(\"peak_bytes\" table) — regenerate with "
+            "`python -m tools.hloaudit --write` and commit it",
+        )]
+    peak = int(mem["peak_bytes"])
+    if peak > budget:
+        return [AuditFinding(
+            "A7", variant,
+            f"peak device-buffer bytes regressed: {peak} > budget "
+            f"{budget} (arg={mem.get('arg_bytes')} "
+            f"out={mem.get('out_bytes')} temp={mem.get('temp_bytes')} "
+            f"alias={mem.get('alias_bytes')}): a fusion-boundary or "
+            "carry-layout change grew live memory — on real accelerators "
+            "this is an OOM, not a slowdown; regenerate with --write "
+            "ONLY if the growth is justified and reviewed",
+        )]
+    return []
+
+
 def audit_module(
     mod: HloModule,
     variant: str,
@@ -248,6 +335,9 @@ def audit_module(
     declared_collectives: Optional[Dict[str, Set[str]]] = None,
     manifest: Optional[dict] = None,
     check_manifest_counts: bool = True,
+    donated: Sequence[int] = (),
+    mem: Optional[dict] = None,
+    peak_budget: Optional[int] = None,
 ) -> List[AuditFinding]:
     """Run the full rule set over one compiled variant."""
     out: List[AuditFinding] = []
@@ -258,6 +348,9 @@ def audit_module(
         out += check_exact_integer_bound(spec, variant)
     if check_manifest_counts:
         out += check_manifest(mod, variant, manifest)
+    out += check_donation_alias(mod, variant, donated, manifest)
+    if check_manifest_counts:
+        out += check_peak_memory(mem, variant, peak_budget)
     return out
 
 

@@ -13,6 +13,15 @@ seen) is::
 
     HloModule <name>, <attrs>
 
+where ``<attrs>`` may carry the module's donation contract::
+
+    input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {1}, ...) }
+
+mapping an OUTPUT index tuple to a (parameter number, parameter index
+tuple, kind) triple — the compiled form of ``jit(..., donate_argnums)``
+and the only place a donation that silently stopped aliasing is visible
+(hloaudit rule A6).
+
     %<computation> (<params>) -> <type> {
       [ROOT ]%<instr> = <type> <opcode>(<operands>), <attrs>,
           metadata={op_name="jit(f)/.../phase_spawn/mul" ...}
@@ -68,6 +77,12 @@ _OPNAME_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
 _TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
 _PHASE_RE = re.compile(r"phase_([A-Za-z0-9_]+)")
 _GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+# one alias entry inside the module-header input_output_alias={...}
+# attribute: `{<out idx>}: (<param>, {<param idx>}, <kind>)`
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{([\d,\s]*)\},\s*"
+    r"(may-alias|must-alias)\)"
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,10 +135,29 @@ class Computation:
     instructions: List[Instruction]
 
 
+@dataclasses.dataclass(frozen=True)
+class AliasEntry:
+    """One compiled donation: ENTRY output ``output_index`` reuses the
+    buffer of parameter ``param_number`` at ``param_index``."""
+
+    output_index: tuple
+    param_number: int
+    param_index: tuple
+    kind: str  # "may-alias" | "must-alias"
+
+
 @dataclasses.dataclass
 class HloModule:
     name: str
     computations: List[Computation]
+    input_output_aliases: List[AliasEntry] = dataclasses.field(
+        default_factory=list
+    )
+
+    def aliased_params(self) -> List[int]:
+        """Sorted distinct parameter numbers with at least one aliased
+        (donated-and-honoured) buffer."""
+        return sorted({e.param_number for e in self.input_output_aliases})
 
     @property
     def entry(self) -> Computation:
@@ -174,10 +208,48 @@ class HloModule:
         return dict(sorted(out.items()))
 
 
+def _parse_aliases(text: str) -> List[AliasEntry]:
+    """Extract the module header's ``input_output_alias={...}`` entries
+    ([] when the module declares no donation)."""
+    header = next(
+        (ln for ln in text.splitlines() if ln.startswith("HloModule")), ""
+    )
+    at = header.find("input_output_alias={")
+    if at < 0:
+        return []
+    # the attribute's value nests braces one level ({out idx} keys):
+    # scan to the matching close instead of trusting a regex span
+    depth = 0
+    start = header.index("{", at)
+    end = start
+    for end in range(start, len(header)):
+        if header[end] == "{":
+            depth += 1
+        elif header[end] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    body = header[start:end + 1]
+    return [
+        AliasEntry(
+            output_index=tuple(
+                int(t) for t in g[0].split(",") if t.strip()
+            ),
+            param_number=int(g[1]),
+            param_index=tuple(
+                int(t) for t in g[2].split(",") if t.strip()
+            ),
+            kind=g[3],
+        )
+        for g in _ALIAS_ENTRY_RE.findall(body)
+    ]
+
+
 def parse_hlo(text: str) -> HloModule:
     """Parse one optimized-HLO module's ``as_text()`` dump."""
     m = re.search(r"^HloModule\s+([\w.\-]+)", text, re.M)
-    mod = HloModule(m.group(1) if m else "?", [])
+    mod = HloModule(m.group(1) if m else "?", [],
+                    input_output_aliases=_parse_aliases(text))
     cur: Optional[Computation] = None
     for lineno, line in enumerate(text.splitlines(), 1):
         h = _COMP_RE.match(line)

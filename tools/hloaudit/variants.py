@@ -43,6 +43,11 @@ _TP_TICK = dict(n_users=16, n_fogs=4, horizon=0.02, send_interval=2.5e-3,
                 dt=1e-3, max_sends_per_user=8, start_time_max=0.01,
                 queue_capacity=8)
 _TP_TICK_TICKS = 2
+#: Small whole-run shape for the donating ``engine._run_jit`` variant
+#: (a handful of ticks: the donation layout, not the horizon, is what
+#: the A6 alias pin guards).
+_RUN_JIT = dict(n_users=16, n_fogs=4, horizon=0.01, send_interval=2.5e-3,
+                dt=1e-3, max_sends_per_user=8)
 
 
 def ensure_devices() -> None:
@@ -56,12 +61,56 @@ def ensure_devices() -> None:
 
 
 @dataclasses.dataclass(frozen=True)
+class CompiledArtifact:
+    """What one variant compile yields: the optimized-HLO text, the spec
+    it was built from (None for spec-free programs) and the compiled
+    executable's memory roll-up (None when the backend's
+    ``memory_analysis()`` is unavailable)."""
+
+    text: str
+    spec: object = None
+    mem: Optional[dict] = None
+
+
+def _artifact(compiled, spec=None) -> CompiledArtifact:
+    """Roll a ``.lower(...).compile()`` result into a CompiledArtifact.
+
+    ``peak_bytes`` is the A7 budget quantity: argument + output + temp
+    buffer bytes minus the aliased (donated-and-honoured) bytes that are
+    double-counted between arguments and outputs — the live-buffer
+    high-water mark the pinned budgets in ``tools/op_budget.json`` gate.
+    """
+    mem = None
+    try:
+        ma = compiled.memory_analysis()
+        mem = {
+            "arg_bytes": int(ma.argument_size_in_bytes),
+            "out_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+        }
+        mem["peak_bytes"] = (
+            mem["arg_bytes"] + mem["out_bytes"] + mem["temp_bytes"]
+            - mem["alias_bytes"]
+        )
+    except Exception:
+        mem = None  # backend without memory stats: A7 skips, A1-A6 run
+    return CompiledArtifact(compiled.as_text(), spec, mem)
+
+
+@dataclasses.dataclass(frozen=True)
 class Variant:
     name: str
     description: str
-    compile_fn: Callable[[], "tuple"]  # () -> (hlo_text, spec_or_None)
+    compile_fn: Callable[[], CompiledArtifact]
     sharded: bool = False
     declared_collectives: Optional[Dict[str, Set[str]]] = None
+    #: jit argument positions declared ``donate_argnums`` by the compiled
+    #: entry point (pytree args, not flat buffers).  Non-empty means rule
+    #: A6 requires the compiled module to carry ``input_output_alias``
+    #: entries — a donation that silently stopped aliasing is a memory
+    #: regression nothing else sees.
+    donated: tuple = ()
 
 
 #: The chaos-on tick overrides (ISSUE 12), shared by ``tick_chaos`` and
@@ -109,9 +158,9 @@ JOURNEY_OVERRIDES = dict(
 
 
 def _compile_tick(**build_overrides):
-    """Compile ONE tick of the op-budget pinned world; returns
-    (hlo_text, spec).  The same lower/compile path op_budget gates, so
-    the two tools can never audit different programs."""
+    """Compile ONE tick of the op-budget pinned world; returns a
+    :class:`CompiledArtifact`.  The same lower/compile path op_budget
+    gates, so the two tools can never audit different programs."""
     import jax
 
     from fognetsimpp_tpu.net.topology import associate
@@ -127,7 +176,7 @@ def _compile_tick(**build_overrides):
     compiled = jax.jit(
         lambda s: step(s, net, bounds, cache)
     ).lower(state).compile()
-    return compiled.as_text(), spec
+    return _artifact(compiled, spec)
 
 
 def _compile_tick_dyn():
@@ -157,7 +206,7 @@ def _compile_tick_dyn():
     compiled = jax.jit(
         lambda s, d: step(s, net, bounds, cache, dyn=d)
     ).lower(state, dyn).compile()
-    return compiled.as_text(), key_spec
+    return _artifact(compiled, key_spec)
 
 
 def _compile_fleet():
@@ -176,7 +225,7 @@ def _compile_fleet():
     compiled = _fleet_run.lower(
         spec, _FLEET_TICKS, batch, net, bounds
     ).compile()
-    return compiled.as_text(), spec
+    return _artifact(compiled, spec)
 
 
 def _compile_tp():
@@ -199,7 +248,7 @@ def _compile_tp():
         jnp.full((F,), 1000.0, jnp.float32),
         jnp.ones((F,), bool),
     ).compile()
-    return compiled.as_text(), None
+    return _artifact(compiled, None)
 
 
 def _compile_tp_tick(**build_overrides):
@@ -223,7 +272,41 @@ def _compile_tp_tick(**build_overrides):
         None, False, False,
     )
     compiled = go.lower(*parts, net_r, cache_r).compile()
-    return compiled.as_text(), spec
+    return _artifact(compiled, spec)
+
+
+def _compile_run_jit():
+    """Compile the DONATING whole-run program (``engine._run_jit``:
+    ``jit(static_argnums=0, donate_argnums=1)``) at a small smoke shape.
+
+    This is the A6 exemplar for the engine's whole-run donation family
+    (``_run_jit``/``_run_jit_dyn``/``run_chunked``'s chunk program all
+    share the donate-the-state layout): the compiled module must carry
+    ``input_output_alias`` entries for the donated WorldState buffers,
+    and the alias count is pinned in the manifest so a refactor that
+    silently breaks donation (a dtype change, an output that stops
+    being shape-compatible) fails A6 instead of doubling peak memory.
+    """
+    from fognetsimpp_tpu.core.engine import _run_jit
+    from fognetsimpp_tpu.scenarios import smoke
+
+    spec, state, net, bounds = smoke.build(**_RUN_JIT)
+    compiled = _run_jit.lower(spec, state, net, bounds).compile()
+    return _artifact(compiled, spec)
+
+
+def _compile_tick_pool():
+    from fognetsimpp_tpu.spec import FogModel
+
+    return _compile_tick(
+        fog_model=int(FogModel.POOL), derive_acks=False
+    )
+
+
+def _compile_tick_learn():
+    from fognetsimpp_tpu.spec import Policy
+
+    return _compile_tick(policy=int(Policy.UCB), derive_acks=False)
 
 
 def _fleet_declared() -> Dict[str, Set[str]]:
@@ -291,6 +374,64 @@ def variants() -> List[Variant]:
             "collective-free like every single-device tick",
             lambda: _compile_tick(**JOURNEY_OVERRIDES),
         ),
+        # ---- featmat cell variants (ISSUE 16) ------------------------
+        # every ACCEPTED cell of the feature-composition matrix
+        # (tools/featmat) maps to a dedicated variant; these cover the
+        # single-device cells no earlier variant compiled.  Deleting a
+        # rejection clause flips its cell to ACCEPTED, and featmat
+        # --check fails until the cell's variant lands here.
+        Variant(
+            "tick_energy",
+            "the op-budget tick with the energy/lifecycle model live "
+            "(per-message radio costs, battery drain, lifecycle "
+            "shutdown/restart mutating liveness — no static hoist)",
+            lambda: _compile_tick(
+                energy_enabled=True, derive_acks=False
+            ),
+        ),
+        Variant(
+            "tick_wired",
+            "the op-budget tick with DropTail wired-queue backpressure "
+            "live (per-link queues; derive_acks stays eager)",
+            lambda: _compile_tick(
+                wired_queue_enabled=True, derive_acks=False
+            ),
+        ),
+        Variant(
+            "tick_learn",
+            "the op-budget tick with a learned (UCB bandit) broker "
+            "policy live — learner state rides the carry, rewards "
+            "credit at ack time (eager acks)",
+            _compile_tick_learn,
+        ),
+        Variant(
+            "tick_pool",
+            "the op-budget tick on POOL (phase-sequential) fog servers "
+            "instead of FIFO — the sequential-pool service path",
+            _compile_tick_pool,
+        ),
+        Variant(
+            "tick_series",
+            "the op-budget tick with per-tick series recording on "
+            "(record_tick_series: the demo-scale vectors path)",
+            lambda: _compile_tick(record_tick_series=True),
+        ),
+        Variant(
+            "tick_window",
+            "the op-budget tick in the WINDOWED arrival regime "
+            "(arrival_window=16: the bounded candidate tail instead of "
+            "the fused no-window mode)",
+            lambda: _compile_tick(arrival_window=16),
+        ),
+        Variant(
+            "run_jit_donated",
+            "the donating whole-run program (engine._run_jit, "
+            "donate_argnums=1) at a small smoke shape — the A6 "
+            "donation-alias exemplar for the engine's donate-the-state "
+            "entry family",
+            _compile_run_jit,
+            donated=(1,),
+        ),
         Variant(
             "tick_dyn",
             "the same chaos-on tick with the promoted DynSpec operand "
@@ -302,10 +443,12 @@ def variants() -> List[Variant]:
         Variant(
             "fleet_step",
             "replica-sharded fleet scan on the 8-virtual-device mesh "
-            "(declared collectives: none — the zero-steady-state claim)",
+            "(declared collectives: none — the zero-steady-state claim; "
+            "donates the batch state: A6 pins the alias count)",
             _compile_fleet,
             sharded=True,
             declared_collectives=None,  # resolved lazily from fleet.py
+            donated=(2,),  # _fleet_run's donate_argnums
         ),
         Variant(
             "tp_dryrun",

@@ -112,7 +112,7 @@ def compile_chaos_counts() -> dict:
     from tools.hloaudit.variants import variants
 
     v = next(x for x in variants() if x.name == "tick_chaos")
-    text, _spec = v.compile_fn()
+    text = v.compile_fn().text
     return entry_op_counts(text)
 
 
@@ -126,7 +126,7 @@ def compile_hier_counts() -> dict:
     from tools.hloaudit.variants import variants
 
     v = next(x for x in variants() if x.name == "tick_hier")
-    text, _spec = v.compile_fn()
+    text = v.compile_fn().text
     return entry_op_counts(text)
 
 
@@ -141,7 +141,7 @@ def compile_journeys_counts() -> dict:
     from tools.hloaudit.variants import variants
 
     v = next(x for x in variants() if x.name == "tick_journeys")
-    text, _spec = v.compile_fn()
+    text = v.compile_fn().text
     return entry_op_counts(text)
 
 
@@ -155,7 +155,7 @@ def compile_dyn_counts() -> dict:
     from tools.hloaudit.variants import variants
 
     v = next(x for x in variants() if x.name == "tick_dyn")
-    text, _spec = v.compile_fn()
+    text = v.compile_fn().text
     return entry_op_counts(text)
 
 
@@ -184,11 +184,11 @@ def compile_tp_counts(telemetry: bool = False) -> dict:
     from tools.hloaudit.variants import _compile_tp_tick
 
     if telemetry:
-        text, _spec = _compile_tp_tick(
+        text = _compile_tp_tick(
             telemetry=True, telemetry_hist=True, derive_acks=False
-        )
+        ).text
     else:
-        text, _spec = _compile_tp_tick()
+        text = _compile_tp_tick().text
     mod = parse_hlo(text)
     counts = mod.entry_op_counts()
     colls: dict = {}
@@ -378,8 +378,16 @@ def main(argv=None) -> int:
     measured = measure()
     print(json.dumps(measured, indent=1))
     if args.write:
+        # read-modify-write: hloaudit --write owns the "peak_bytes"
+        # table inside this same file (A7 budgets) — preserve it
+        out = dict(measured)
+        if os.path.exists(args.budget):
+            with open(args.budget) as f:
+                prev = json.load(f)
+            if "peak_bytes" in prev:
+                out["peak_bytes"] = prev["peak_bytes"]
         with open(args.budget, "w") as f:
-            json.dump(measured, f, indent=1)
+            json.dump(out, f, indent=1)
             f.write("\n")
         print(f"wrote {args.budget}", file=sys.stderr)
         return 0

@@ -1,0 +1,14 @@
+"""R14 bad fixture: a derived-stream module (the ``_STREAM_FOLD``
+constant opts it into scope) that splits its key and folds in an
+anonymous literal — two findings."""
+import jax
+
+_STREAM_FOLD = 0x5EED
+
+
+def derive_streams(key):
+    # BAD: split lineage — substream order depends on consumer order
+    burst_key, phase_key = jax.random.split(key)
+    # BAD: anonymous fold literal — collides silently with any other 77
+    aux = jax.random.fold_in(burst_key, 77)
+    return phase_key, aux

@@ -1,0 +1,14 @@
+"""R14 good fixture: every substream is a named fold of its parent —
+pure lineage, host-replayable, no splits, no magic literals."""
+import jax
+
+_STREAM_FOLD = 0x5EED
+_PHASE_FOLD = 0x0B17
+
+
+def derive_streams(key, fog):
+    base = jax.random.fold_in(key, _STREAM_FOLD)
+    phase = jax.random.fold_in(base, _PHASE_FOLD)
+    # per-entity lanes fold the INDEX — a name, not an anonymous literal
+    per_fog = jax.random.fold_in(base, fog)
+    return phase, per_fog

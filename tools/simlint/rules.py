@@ -1,9 +1,10 @@
-"""The simlint rule catalogue (R1-R12).  See RULES.md for the narrative
+"""The simlint rule catalogue (R1-R14).  See RULES.md for the narrative
 version with offending/sanctioned snippets; docstrings here are the
 machine-adjacent summary."""
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .core import (
@@ -1016,6 +1017,86 @@ class DynOperandRule(Rule):
         return False
 
 
+#: Directory components whose modules are derived-stream territory for
+#: R14 (a module anywhere can also opt in by defining a ``*_FOLD``
+#: module constant — the named-lineage discipline's own marker).
+_DERIVED_STREAM_DIRS = {"chaos", "hier", "telemetry"}
+
+_FOLD_CONST_RE = re.compile(r"\A_?[A-Z][A-Z0-9_]*_FOLD\Z")
+
+_SPLIT_LEAVES = {"split"}
+_RANDOM_MODULES = {"jax.random", "jrandom", "jr", "random"}
+
+
+class KeyLineageRule(Rule):
+    """R14: PRNG key lineage in derived-stream modules — folded, never
+    split.  The chaos/hier/telemetry streams derive every substream as
+    ``fold_in(parent_key, <named constant or index>)``: a PURE function
+    of the parent, so host replay (``outage_timeline``), per-replica
+    re-keying and the journeys sampler all reconstruct identical draws
+    without threading consumed keys.  ``jax.random.split`` breaks that
+    contract — the Nth substream depends on every earlier consumer, so
+    inserting one draw silently re-seeds everything after it.  A bare
+    int literal in ``fold_in(key, 42)`` is the same bug one step
+    earlier: two anonymous literals collide and the streams correlate;
+    name the lane (``_X_FOLD = 0x...``) so collisions are greppable.
+    Scope: modules under chaos/, hier/, telemetry/, or any module that
+    defines a ``*_FOLD`` constant (the discipline's own marker); the
+    engine's ROOT key split (one-time fan-out at world build) is out of
+    scope by construction."""
+
+    id = "R14"
+    title = "derived-stream key split / anonymous fold literal"
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not self._in_scope(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func) or ""
+            head, _, leaf = name.rpartition(".")
+            if leaf in _SPLIT_LEAVES and head in _RANDOM_MODULES:
+                yield mod.finding(
+                    self.id, node,
+                    f"`{name}(...)` in a derived-stream module: split "
+                    "lineage makes substream N depend on every earlier "
+                    "consumer, so one inserted draw re-seeds all later "
+                    "ones; derive substreams as `fold_in(parent, "
+                    "_LANE_FOLD)` / `fold_in(parent, index)` instead",
+                )
+            elif leaf == "fold_in" and len(node.args) >= 2:
+                arg = node.args[1]
+                if (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, int)
+                    and not isinstance(arg.value, bool)
+                ):
+                    yield mod.finding(
+                        self.id, node,
+                        f"anonymous fold literal `fold_in(..., "
+                        f"{arg.value})`: two magic numbers collide "
+                        "silently and the streams correlate — name the "
+                        "lane with a module-level `_X_FOLD` constant",
+                    )
+
+    @staticmethod
+    def _in_scope(mod: ModuleInfo) -> bool:
+        dirs = set(mod.relpath.split("/")[:-1])
+        if dirs & _DERIVED_STREAM_DIRS:
+            return True
+        for stmt in mod.tree.body:
+            targets: List[ast.expr] = []
+            if isinstance(stmt, ast.Assign):
+                targets = list(stmt.targets)
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and _FOLD_CONST_RE.match(t.id):
+                    return True
+        return False
+
+
 def default_rules() -> Tuple[Rule, ...]:
     return (
         HostSyncRule(),
@@ -1031,4 +1112,5 @@ def default_rules() -> Tuple[Rule, ...]:
         ScanCallbackRule(),
         DonatedReuseRule(),
         DynOperandRule(),
+        KeyLineageRule(),
     )
