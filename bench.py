@@ -50,6 +50,12 @@ established <= 1.10 bar.  ``python bench.py --profile`` (or ``BENCH_PROFILE=<dir
 wraps the timed section in ``jax.profiler.trace`` (engine phases appear
 as named scopes) and appends a per-call dispatch-latency histogram plus
 the cold-compile time to the JSON line.
+
+Digital twin (ISSUE 17): ``python bench.py --twin`` (or
+``BENCH_TWIN=1``) measures the live-serving input/question doors —
+``ingest_rate`` (arrivals/s through feed → chunk-boundary injection)
+and ``whatif_latency_s`` (warm ``run_whatif`` grid wall; the warm asks
+must compile NOTHING, gated by tools/bench_trend.py --check).
 """
 from __future__ import annotations
 
@@ -1101,6 +1107,148 @@ def reconfig_measurement() -> dict:
     }
 
 
+def twin_measurement() -> dict:
+    """``bench.py --twin`` (ISSUE 17): the live-twin door latencies.
+
+    Two numbers off one live carry:
+
+    * ``ingest_rate`` — arrivals/s through the full input door (host
+      ``IngestQueue.feed`` → chunk-boundary drain → the compiled
+      draw-free injector), the rate bound on external traffic a live
+      session can absorb between chunks;
+    * ``whatif_latency_s`` — median warm wall of a
+      ``BENCH_TWIN_CELLS``-cell ``run_whatif`` grid
+      ``BENCH_TWIN_TICKS`` ticks ahead: the time-to-answer for "p95
+      under these K retunings, from current state".  The warm asks ride
+      the session's compiled fork program — ``whatif_compile_events``
+      must stay 0 (tools/bench_trend.py --check gates it).
+
+    Env knobs: BENCH_TWIN_USERS / BENCH_TWIN_FOGS / BENCH_TWIN_HORIZON /
+    BENCH_TWIN_INTERVAL / BENCH_TWIN_BATCH / BENCH_TWIN_ROUNDS /
+    BENCH_TWIN_CELLS / BENCH_TWIN_TICKS.
+    """
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu import compile_cache
+    from fognetsimpp_tpu.compile_cache import (
+        compile_stats,
+        enable_compile_cache,
+    )
+    from fognetsimpp_tpu.core.engine import run
+    from fognetsimpp_tpu.scenarios import smoke
+    from fognetsimpp_tpu.twin.ingest import IngestQueue, make_inject
+    from fognetsimpp_tpu.twin.whatif import run_whatif
+
+    enable_compile_cache()
+    backend = jax.default_backend()
+    n_users = _env_int("BENCH_TWIN_USERS", 256)
+    n_fogs = _env_int("BENCH_TWIN_FOGS", 8)
+    horizon = _env_float("BENCH_TWIN_HORIZON", 0.6)
+    interval = _env_float("BENCH_TWIN_INTERVAL", 0.005)
+    batch = _env_int("BENCH_TWIN_BATCH", 16)
+    rounds = _env_int("BENCH_TWIN_ROUNDS", 20)
+    cells = _env_int("BENCH_TWIN_CELLS", 8)
+    ticks = _env_int("BENCH_TWIN_TICKS", 200)
+
+    spec, state, net, bounds = smoke.build(
+        n_users=n_users,
+        n_fogs=n_fogs,
+        horizon=horizon,
+        send_interval=interval,
+        max_sends_per_user=int(horizon / interval) + 4,
+        telemetry=True,
+        telemetry_hist=True,
+        derive_acks=False,
+        ingest=True,
+        ingest_batch=batch,
+        # positive loss: the what-if grid stays on the carry's side of
+        # the 0-vs-positive trace gate (one shape bucket, one program)
+        uplink_loss_prob=0.01,
+    )
+    # the live carry: advance past the connect handshake so injected
+    # publishes actually land (the injector rejects unconnected users)
+    carry, _ = run(spec, state, net, bounds, n_ticks=300)
+    jax.block_until_ready(carry.t)
+
+    # --- ingest_rate: feed -> drain -> compiled injection -------------
+    queue = IngestQueue(capacity=max(batch * 8, 64))
+    inject = make_inject(spec, net, queue)
+    rng = np.random.default_rng(0)
+    st = carry
+    for u in rng.integers(0, n_users, size=batch):
+        queue.feed(int(u), 500.0)
+    st = inject(st, 0)  # warm the injector compile outside the timing
+    jax.block_until_ready(st.t)
+    fed = 0
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        for u in rng.integers(0, n_users, size=batch):
+            queue.feed(int(u), 500.0)
+            fed += 1
+        st = inject(st, r + 1)
+    jax.block_until_ready(st.t)
+    ingest_wall = time.perf_counter() - t0
+    ingest_rate = fed / ingest_wall if ingest_wall > 0 else 0.0
+    ingest_stats = queue.stats()
+
+    # --- whatif_latency_s: cold fork compile, then warm asks ----------
+    knobs = {
+        "uplink_loss_prob": [
+            round(0.01 + 0.01 * i, 4) for i in range(cells)
+        ]
+    }
+    t0 = time.perf_counter()
+    run_whatif(spec, carry, net, bounds, knobs, ticks)
+    whatif_cold = time.perf_counter() - t0
+    walls = []
+    compiles_delta = 0.0
+    for _ in range(3):
+        snap = compile_cache.snapshot()
+        t0 = time.perf_counter()
+        run_whatif(spec, carry, net, bounds, knobs, ticks)
+        walls.append(time.perf_counter() - t0)
+        compiles_delta += compile_cache.delta_since(snap)["compiles"]
+    whatif_latency = sorted(walls)[len(walls) // 2]
+
+    return {
+        "metric": "twin_ingest_arrivals_per_sec",
+        "value": round(ingest_rate, 1),
+        "unit": "arrivals/s (feed -> chunk-boundary injection)",
+        "backend": backend,
+        "policy": "min_busy",
+        "n_users": n_users,
+        "n_fogs": n_fogs,
+        "horizon_s": horizon,
+        "dt": 1e-3,
+        "ingest_rate": round(ingest_rate, 1),
+        "ingest_batch": batch,
+        "ingest_rounds": rounds,
+        "ingest_wall_s": round(ingest_wall, 4),
+        "ingest_injected": ingest_stats["injected"],
+        "ingest_rejected": ingest_stats["rejected"],
+        "whatif_latency_s": round(whatif_latency, 4),
+        "whatif_walls_s": [round(w, 4) for w in walls],
+        "whatif_cold_s": round(whatif_cold, 3),
+        "whatif_cells": cells,
+        "whatif_ticks": ticks,
+        "whatif_compile_events": compiles_delta,
+        "compile_cache": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in compile_stats().items()
+            if not isinstance(v, dict)
+        },
+        "determinism": "injection is draw-free; a session replayed "
+        "from its arrival log is bit-exact (tests/test_twin.py)",
+    }
+
+
+def twin_main() -> None:
+    """``python bench.py --twin`` (or ``BENCH_TWIN=1``): the live-twin
+    headline — ingest-door throughput + warm what-if grid latency."""
+    print(json.dumps(twin_measurement()))
+
+
 def reconfig_main() -> None:
     """``python bench.py --reconfig`` (or ``BENCH_RECONFIG=1``): the
     ISSUE 13 headline — cold compile vs zero-compile warm knob tweak."""
@@ -1153,5 +1301,7 @@ if __name__ == "__main__":
         hier_main()
     elif "--reconfig" in sys.argv or os.environ.get("BENCH_RECONFIG"):
         reconfig_main()
+    elif "--twin" in sys.argv or os.environ.get("BENCH_TWIN"):
+        twin_main()
     else:
         main()

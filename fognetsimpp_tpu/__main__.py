@@ -90,6 +90,39 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-chunk", type=int, metavar="N", default=1000,
                     help="ticks per serving chunk (default 1000): the "
                     "scrape/watchdog refresh granularity")
+    ap.add_argument("--ingest", type=int, nargs="?", const=1024,
+                    default=None, metavar="CAP",
+                    help="open the live ingestion door under --serve "
+                    "(twin/): POST /ingest + in-process arrivals land "
+                    "at chunk boundaries through the compiled "
+                    "injector; CAP bounds the drop-counted queue "
+                    "(default 1024); implies spec.ingest")
+    ap.add_argument("--ingest-batch", type=int, metavar="B", default=None,
+                    help="max arrivals injected per chunk boundary "
+                    "(spec.ingest_batch, default 64)")
+    ap.add_argument("--arrival-log", metavar="JSON", default=None,
+                    help="write the session's recorded arrival log on "
+                    "exit (the replayable input record)")
+    ap.add_argument("--replay-arrivals", metavar="JSON", default=None,
+                    help="re-inject a recorded arrival log instead of "
+                    "serving the live queue: the session reproduces "
+                    "the original chunk state hashes bit-exactly")
+    ap.add_argument("--whatif", metavar="GRID", default=None,
+                    help="answer a promoted-knob grid from the final "
+                    "carry, e.g. 'uplink_loss_prob=0.05,0.1 "
+                    "ticks=400': K retunings forked from current "
+                    "state, H ticks ahead, one vmapped program; one "
+                    "JSON line per run")
+    ap.add_argument("--tenants", type=int, metavar="N", default=None,
+                    help="multiplex N tenant sessions of the scenario "
+                    "(seeds seed..seed+N-1) behind one endpoint: "
+                    "round-robin chunks over the shared bucketed "
+                    "program, per-tenant /t/<label>/metrics|healthz|"
+                    "ingest|whatif routing; needs --serve")
+    ap.add_argument("--tenant-cap", type=int, metavar="M", default=None,
+                    help="front-door admission bound (default: N); "
+                    "admitting past it is the one-line [TWIN-CAP] "
+                    "rejection")
     ap.add_argument("--slo", type=float, metavar="MS", default=None,
                     help="task-latency SLO in milliseconds: breaches "
                     "derive from the streaming histogram (implies "
@@ -270,6 +303,61 @@ def main(argv=None) -> int:
         ap.error("[CLI-TPWINDOW] --tp-window sizes the TP arrival "
                  "exchange; it needs --tp N")
 
+    # ---- digital-twin guard rails (twin/): the CLI cites the gate
+    # module's [TWIN-*] clauses verbatim, never re-words them ----------
+    if args.ingest is not None or args.replay_arrivals is not None:
+        from .twin.gates import (
+            ingest_needs_serve_error,
+            ingest_reject_reason,
+        )
+
+        if args.tp is not None:
+            ap.error(ingest_reject_reason("tp"))
+        if args.replicas is not None or args.mesh is not None:
+            ap.error(ingest_reject_reason("fleet"))
+        if args.serve is None:
+            ap.error(ingest_needs_serve_error())
+        if args.ingest is not None and args.ingest < 1:
+            ap.error(f"--ingest queue capacity must be >= 1, got "
+                     f"{args.ingest}")
+    if args.whatif is not None:
+        from .dynspec import promote_default
+        from .twin.gates import whatif_reject_reason
+
+        reason = whatif_reject_reason(
+            tp=args.tp is not None,
+            fleet=args.replicas is not None or args.mesh is not None,
+            promote=promote_default(),
+        )
+        if reason:
+            ap.error(reason)
+        if args.sweep:
+            ap.error("[CLI-SWEEP-TWIN] --sweep builds every cell's "
+                     "world at t=0; --whatif forks a LIVE carry — they "
+                     "do not combine")
+    if args.tenants is not None:
+        from .twin.gates import front_reject_reason
+
+        if args.tenants < 1:
+            ap.error(f"--tenants must be >= 1, got {args.tenants}")
+        if args.tp is not None:
+            ap.error(front_reject_reason("tp"))
+        if args.replicas is not None or args.mesh is not None:
+            ap.error(front_reject_reason("fleet"))
+        if args.serve is None:
+            ap.error(front_reject_reason("solo"))
+        if args.whatif is not None:
+            ap.error("[CLI-TENANTS-WHATIF] per-tenant what-ifs ride "
+                     "the front door's /t/<label>/whatif routes; the "
+                     "--whatif one-shot applies to single-session runs")
+        if args.replay_arrivals is not None or args.arrival_log:
+            ap.error("[CLI-TENANTS-REPLAY] arrival logs are per "
+                     "session; record/replay a tenant through the "
+                     "single-session --serve --ingest path")
+    elif args.tenant_cap is not None:
+        ap.error("[CLI-TENANTCAP] --tenant-cap bounds front-door "
+                 "admission; it needs --tenants N")
+
     # ---- hierarchy guard rails (hier/) --------------------------------
     if args.brokers is not None:
         if args.brokers < 1:
@@ -415,6 +503,10 @@ def main(argv=None) -> int:
         pre.append("spec.record_tick_series = true")
     if args.trails:
         pre.append("spec.record_trails = true")
+    if args.ingest is not None or args.replay_arrivals is not None:
+        pre.append("spec.ingest = true")
+    if args.ingest_batch is not None:
+        pre.append(f"spec.ingest_batch = {args.ingest_batch}")
     if args.telemetry or args.serve is not None:
         pre.append("spec.telemetry = true")
     if args.hist or args.slo is not None:
@@ -602,6 +694,22 @@ def main(argv=None) -> int:
         # one status line per chunk, the Cmdenv-progress analog
         print(json.dumps(health), flush=True)
 
+    def _whatif_extra(spec_f, carry):
+        """The --whatif one-shot: answer the knob grid from the run's
+        final carry (the offline twin question; the live endpoint
+        answers the same grids mid-session).  Raises ValueError with
+        the one-line grid/knob errors."""
+        if args.whatif is None:
+            return {}
+        from .twin.whatif import _json_safe, parse_grid, run_whatif
+
+        knobs, wi_ticks = parse_grid(args.whatif)
+        return {
+            "whatif": _json_safe(
+                run_whatif(spec_f, carry, net, bounds, knobs, wi_ticks)
+            )
+        }
+
     def _finish_serve(spec_f, final, status, t0, prof, extra=None):
         """Shared --serve epilogue (single-device and --tp branches):
         summary dict, recording, trace/profile export, server shutdown,
@@ -755,10 +863,96 @@ def main(argv=None) -> int:
             ap.error("[CLI-SERVE-FLEET] --serve is a single-world loop; "
                      "fleet serving is a follow-up (run --replicas "
                      "without --serve)")
-        from .telemetry.live import serve_run
         from .telemetry.profile import profile_trace
 
+        if args.tenants is not None:
+            # ---- multi-tenant front door (twin/front.py, ISSUE 17) ----
+            from .twin.front import FrontDoor
+
+            t0 = time.perf_counter()
+            cap = (
+                args.tenant_cap if args.tenant_cap is not None
+                else args.tenants
+            )
+            door = FrontDoor(
+                capacity=cap, chunk_ticks=args.serve_chunk,
+                port=args.serve,
+            )
+            try:
+                for i in range(args.tenants):
+                    sp_i, st_i, net_i, b_i = build_from_config(
+                        cfg, seed=(args.seed or 0) + i
+                    )
+                    door.admit(
+                        f"t{i}", sp_i, st_i, net_i, b_i,
+                        ingest_capacity=args.ingest or 1024,
+                    )
+            except ValueError as e:
+                # duplicate label / telemetry-less spec / [TWIN-CAP]
+                # past the admission bound: one actionable line
+                door.close()
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            rounds = -(-spec.n_ticks // args.serve_chunk)
+            ticks = door.serve(rounds)
+            out = {
+                "scenario": cfg.lookup("scenario", "smoke"),
+                "tenants": args.tenants,
+                "tenant_cap": cap,
+                "port": door.server.port if door.server else None,
+                "rounds": rounds,
+                "ticks": ticks,
+                "published": {
+                    r["label"]: r["n_published"]
+                    for r in door.tenant_rows()
+                },
+                "wall_s": round(time.perf_counter() - t0, 3),
+            }
+            door.close()
+            print(json.dumps(out))
+            return 0
+
         t0 = time.perf_counter()
+        if args.ingest is not None or args.replay_arrivals is not None:
+            # ---- live-ingestion twin session (twin/ingest.py) ---------
+            from .twin.ingest import load_log, serve_ingest_run
+
+            replay = (
+                load_log(args.replay_arrivals)
+                if args.replay_arrivals else None
+            )
+            with profile_trace(args.profile) as prof:
+                final, status = serve_ingest_run(
+                    spec, state, net, bounds,
+                    capacity=args.ingest or 1024,
+                    chunk_ticks=args.serve_chunk,
+                    port=args.serve,
+                    replay_log=replay,
+                    slo_ms=args.slo,
+                    dump_dir=args.postmortem,
+                    on_chunk=_announce,
+                )
+            if args.arrival_log:
+                with open(args.arrival_log, "w") as f:
+                    json.dump(
+                        {
+                            "capacity": status["ingest"]["capacity"],
+                            "entries": status["arrival_log"],
+                        },
+                        f, indent=1,
+                    )
+            try:
+                wi = _whatif_extra(spec, final)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            return _finish_serve(
+                spec, final, status, t0, prof,
+                extra={"ingest": status["ingest"], **wi},
+            )
+
+        from .telemetry.live import serve_run
+
         with profile_trace(args.profile) as prof:
             final, status = serve_run(
                 spec, state, net, bounds,
@@ -768,7 +962,12 @@ def main(argv=None) -> int:
                 dump_dir=args.postmortem,
                 on_chunk=_announce,
             )
-        return _finish_serve(spec, final, status, t0, prof)
+        try:
+            wi = _whatif_extra(spec, final)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        return _finish_serve(spec, final, status, t0, prof, extra=wi)
 
     if args.replicas is not None or args.mesh is not None:
         # ---- replica-sharded fleet run (parallel/fleet.py) ------------
@@ -957,6 +1156,11 @@ def main(argv=None) -> int:
             out["slo_breaches"] = slo_breach_count(
                 spec, final, args.slo, summ=hist
             )
+    try:
+        out.update(_whatif_extra(spec, final))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     print(json.dumps(out))
     return 0
 

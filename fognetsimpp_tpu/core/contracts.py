@@ -141,6 +141,17 @@ def _contracts() -> Tuple[PhaseContract, ...]:
             when=lambda sp: sp.max_sends_per_tick > 1,
         ),
         PhaseContract(
+            # chunk-boundary arrival injection (twin/ingest, ISSUE 17):
+            # traced with its default all-padding batch — the contract
+            # covers the full write dataflow (the padded rows take the
+            # same masked-scatter path as real ones)
+            "_phase_inject",
+            lambda sp, s, n, c, b, t0, t1: E._phase_inject(
+                sp, s, n, c, b, t0, t1
+            )[:2],
+            when=lambda sp: sp.ingest,
+        ),
+        PhaseContract(
             "_phase_v2_release",
             lambda sp, s, n, c, b, t0, t1: E._phase_v2_release(
                 sp, s, n, c, b, t1, before_broker=True

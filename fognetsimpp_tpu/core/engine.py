@@ -765,6 +765,156 @@ def _phase_spawn(
     return state, buf
 
 
+def _phase_inject(
+    spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
+    buf: TickBuf, t0: jax.Array, t1: jax.Array,
+    batch: Optional[dict] = None,
+):
+    """Chunk-boundary arrival injection: the digital twin's input phase
+    (twin/ingest, ISSUE 17).
+
+    Lands one fixed-width batch of EXTERNAL publish requests — ``batch``
+    maps ``user`` (i32 ``(spec.ingest_batch,)``, -1 = padding row) and
+    ``mips`` (f32, MIPSRequired per request) — into the task table
+    through the same slot contract as :func:`_phase_spawn`: row *j*
+    targeting user *u* claims slot ``u * S + send_count[u] + rank``
+    where ``rank`` counts earlier batch rows for the same user, so a
+    batch may carry several requests per user and the claimed slots
+    stay distinct.  The publish is stamped at the CURRENT sim time
+    (``state.t``) and arrives at the broker through the established
+    K-window contract at ``state.t + delay(user, broker)``.
+
+    Deliberately draw-free: no PRNG key is split and no loss draw is
+    taken (the request already reached the service's front door; the
+    simulated user is a stand-in for an external client, so uplink loss
+    and user tx energy are not re-modelled), which is what makes a
+    recorded arrival log replay bit-exactly — injection is a pure
+    function of (state, batch).  Rows for dead/disconnected users or
+    users whose ``S`` send slots are exhausted are REJECTED, not
+    queued: the count comes back in ``extra["n_rejected"]`` and the
+    host-side queue (twin/ingest.IngestQueue) owns the drop policy.
+
+    This phase never runs inside the compiled tick — it is applied
+    between chunks by :func:`inject_arrivals` (run_chunked's ``inject``
+    hook), so the tick program stays host-transfer-free (hloaudit's
+    ``tick_ingest`` variant pins exactly that).
+    """
+    U, T, S = spec.n_users, spec.task_capacity, spec.max_sends_per_user
+    B = spec.ingest_batch
+    users, tasks = state.users, state.tasks
+    alive_u = state.nodes.alive[:U]
+    if batch is None:  # contract trace / gate-off: all-padding batch
+        uid = jnp.full((B,), -1, jnp.int32)
+        mips = jnp.zeros((B,), jnp.float32)
+    else:
+        uid = batch["user"].astype(jnp.int32)
+        mips = batch["mips"].astype(jnp.float32)
+    ok0 = (uid >= 0) & (uid < U)
+    ui = jnp.clip(uid, 0, max(U - 1, 0))
+    # rank of row j among earlier same-user rows: the (B, B) triangle is
+    # tiny (B = spec.ingest_batch), so this stays a vector compare, not
+    # a serializing scatter
+    same = (uid[:, None] == uid[None, :]) & ok0[:, None] & ok0[None, :]
+    rank = jnp.sum(jnp.tril(same, k=-1), axis=1).astype(jnp.int32)
+    slot_k = users.send_count[ui] + rank
+    ok = ok0 & alive_u[ui] & users.connected[ui] & (slot_k < S)
+
+    t_now = state.t
+    t_arrive = t_now + cache.d2b[:U][ui]
+    # out-of-bounds sentinel slot + mode="drop": rejected rows write
+    # nothing (the established .at[] drop idiom, no branching)
+    slot = jnp.where(ok, ui * S + jnp.clip(slot_k, 0, S - 1), T)
+    tasks = tasks.replace(
+        stage=tasks.stage.at[slot].set(_ST_PUB_INFLIGHT, mode="drop"),
+        mips_req=tasks.mips_req.at[slot].set(mips, mode="drop"),
+        t_create=tasks.t_create.at[slot].set(
+            jnp.broadcast_to(t_now, (B,)), mode="drop"
+        ),
+        t_at_broker=tasks.t_at_broker.at[slot].set(t_arrive, mode="drop"),
+    )
+    usafe = jnp.where(ok, ui, U)
+    users = users.replace(
+        send_count=users.send_count.at[usafe].add(1, mode="drop"),
+    )
+    n_inj = jnp.sum(ok.astype(jnp.int32))
+    metrics = state.metrics.replace(
+        n_published=state.metrics.n_published + n_inj
+    )
+    cnt_u = jnp.zeros((U,), jnp.int32).at[usafe].add(1, mode="drop")
+    buf = buf._replace(tx_u=buf.tx_u + cnt_u)
+    state = state.replace(users=users, tasks=tasks, metrics=metrics)
+    extra = {
+        "n_injected": n_inj,
+        "n_rejected": jnp.sum((ok0 & ~ok).astype(jnp.int32)),
+    }
+    return state, buf, extra
+
+
+# simlint: disable=R6 -- the boundary injector must NOT donate: the serve
+# callback path retains chunk-boundary states (flight recorder /
+# checkpoint streaming), and donating here would delete those buffers
+# behind the recorder's back
+@functools.partial(jax.jit, static_argnums=0)
+def _inject_jit(
+    spec: WorldSpec, state: WorldState, net: NetParams,
+    user: jax.Array, mips: jax.Array,
+):
+    cache = associate(
+        net, state.nodes.pos, state.nodes.alive, broker=spec.broker_index
+    )
+    zero_u = jnp.zeros((spec.n_users,), jnp.int32)
+    buf = TickBuf(
+        tx_u=zero_u, rx_u=zero_u,
+        tx_f=jnp.zeros((spec.n_fogs,), jnp.int32),
+        rx_f=jnp.zeros((spec.n_fogs,), jnp.int32),
+        tx_b=jnp.zeros((), jnp.int32), rx_b=jnp.zeros((), jnp.int32),
+    )
+    state, _buf, extra = _phase_inject(
+        spec, state, net, cache, buf,
+        jnp.float32(0.0), jnp.float32(0.0),
+        batch={"user": user, "mips": mips},
+    )
+    return state, extra["n_injected"], extra["n_rejected"]
+
+
+def inject_arrivals(
+    spec: WorldSpec, state: WorldState, net: NetParams,
+    user, mips,
+) -> Tuple[WorldState, int, int]:
+    """Host entry for the chunk-boundary injector (twin/ingest drain).
+
+    Pads ``user``/``mips`` (any length <= ``spec.ingest_batch``) to the
+    fixed batch width and applies :func:`_phase_inject` under one
+    compiled program per shape key — every boundary of a live session
+    reuses the same executable regardless of how many requests arrived.
+    Returns ``(state, n_injected, n_rejected)`` with the counts as
+    Python ints (the boundary is already a host sync point).
+    """
+    if not spec.ingest:
+        raise ValueError(
+            "inject_arrivals needs the ingestion gate: build the world "
+            "with spec.ingest=True (the injection phase is compiled "
+            "out otherwise)"
+        )
+    B = spec.ingest_batch
+    u = np.full((B,), -1, np.int32)
+    m = np.zeros((B,), np.float32)
+    n = len(user)
+    if n > B:
+        raise ValueError(
+            f"injection batch of {n} rows exceeds spec.ingest_batch="
+            f"{B}: drain at most ingest_batch rows per boundary"
+        )
+    # simlint: disable=R1 -- host boundary by design: the drain hands in
+    # plain Python/numpy rows (never traced values); padding happens
+    # before the jit entry
+    u[:n] = np.asarray(user, np.int32)
+    # simlint: disable=R1 -- same host boundary
+    m[:n] = np.asarray(mips, np.float32)
+    state, n_inj, n_rej = _inject_jit(spec, state, net, u, m)
+    return state, int(n_inj), int(n_rej)
+
+
 def _phase_spawn_multi(
     spec: WorldSpec, state: WorldState, net: NetParams, cache: LinkCache,
     buf: TickBuf, t0: jax.Array, t1: jax.Array,
@@ -4077,6 +4227,7 @@ def run_chunked(
     telemetry_stream: Optional[Callable[[dict, int], None]] = None,
     promote: Optional[bool] = None,
     reconfigure: Optional[Callable[[int], Optional[dict]]] = None,
+    inject: Optional[Callable[["WorldState", int], "WorldState"]] = None,
 ) -> WorldState:
     """Advance an arbitrarily long horizon in fixed-size scan chunks.
 
@@ -4123,6 +4274,18 @@ def run_chunked(
     shape-defining field (or flipping a trace gate, e.g. turning chaos
     bursts on for a world compiled without them) raises the one-line
     ``dynspec.apply_knobs`` error instead of silently recompiling.
+
+    ``inject`` (ISSUE 17, the digital-twin input door): called at every
+    INTERIOR chunk boundary as ``inject(state, ticks_done)`` and must
+    return the (possibly updated) state the next chunk consumes —
+    the twin/ingest drain hands queued external arrivals to
+    :func:`inject_arrivals` here, so injection lands between compiled
+    chunks and the tick program itself never hosts a transfer.  Runs
+    AFTER ``callback``/``telemetry_stream`` observe the chunk's own
+    result and after ``reconfigure`` (observability sees what the sim
+    produced; injection feeds what the next chunk starts from).
+    Requires ``spec.ingest`` when used with the twin drain (the phase
+    is compiled out otherwise).
     """
     if promote is None:
         promote = promote_default()
@@ -4208,6 +4371,8 @@ def run_chunked(
                 # program re-runs with the new operand values only
                 live_spec = apply_knobs(live_spec, knobs)
                 dyn = dyn_of(live_spec)
+        if inject is not None and done < total:
+            state = inject(state, done)
     return state
 
 

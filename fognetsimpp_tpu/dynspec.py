@@ -136,6 +136,8 @@ STATIC_REASONS: Dict[str, str] = {
     "the histogram compare ladder",
     "telemetry_hist_max_ms": "bucket edges are trace-time constants of "
     "the histogram compare ladder",
+    "ingest_batch": "static int — sizes the fixed-width injection batch "
+    "arrays the chunk-boundary injector is compiled for",
 }
 
 #: Gate classes: promoted fields whose VALUE also steers Python-level

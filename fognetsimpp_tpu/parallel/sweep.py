@@ -329,3 +329,85 @@ def sweep_dyn(
             "counters": {k: v[sl] for k, v in counters.items()},
         })
     return out
+
+
+def fork_state(state, n: int):
+    """Broadcast ONE live carry identically onto ``n`` replica rows —
+    the state-fork half of the what-if door (ISSUE 17).
+
+    Deliberately the opposite of :func:`~fognetsimpp_tpu.parallel.
+    replicas.replicate_state`: NO re-keying, NO chaos refold, NO
+    start-time resampling.  Every row starts as the bit-identical
+    forked carry (same PRNG key, same mid-run chaos schedule, same
+    in-flight tasks), so row *i*'s trajectory under cell *i*'s DynSpec
+    equals a direct single run of that retuned spec from this exact
+    state — the property the what-if rail asserts bit-for-bit.
+    """
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), state
+    )
+
+
+def sweep_dyn_from(
+    spec,
+    state,
+    net,
+    bounds,
+    knobs: Mapping[str, Sequence],
+    n_ticks: int,
+) -> tuple:
+    """Dynamic-knob grid forked from a LIVE carry, under ONE compile.
+
+    The missing half of :func:`sweep_dyn` (which builds each world at
+    t=0): here ``state`` is a mid-session chunk-boundary carry and
+    every grid cell answers "what do the next ``n_ticks`` ticks look
+    like under THIS retuning, starting from NOW".  Knobs must be
+    promoted (:data:`~fognetsimpp_tpu.dynspec.DYN_FIELDS`) and every
+    cell must stay in the live spec's shape bucket — crossing a trace
+    gate raises the one-line shape-key error up front, exactly the
+    ``sweep_dyn`` / ``apply_knobs`` discipline, because the fork's
+    whole point is answering from the ALREADY-COMPILED program.
+
+    Returns ``(grid, final_batch)``: the cell dicts in grid order and
+    the replica-batched final state (row *i* = cell *i*), which
+    :func:`fognetsimpp_tpu.twin.whatif.run_whatif` turns into per-cell
+    counter/quantile DELTAS against the fork point.  Warm calls on the
+    same shape bucket are zero compile events
+    (``run_replicated``'s jit cache serves every fork of the session).
+    """
+    from ..dynspec import DYN_FIELDS, dyn_of, shape_key
+
+    bad = sorted(set(knobs) - set(DYN_FIELDS))
+    if bad:
+        raise ValueError(
+            f"what-if grids promoted knobs only; {', '.join(bad)} "
+            "is shape-defining (see dynspec.DYN_FIELDS / the README "
+            "'one program, many worlds' table)"
+        )
+    names = sorted(knobs)
+    grid = [
+        dict(zip(names, vals))
+        for vals in itertools.product(*(knobs[k] for k in names))
+    ]
+    if not grid:
+        return [], None
+    cells = [
+        dataclasses.replace(spec, **cell).validate() for cell in grid
+    ]
+    key0 = shape_key(spec)
+    for cell, sp in zip(grid, cells):
+        if shape_key(sp) != key0:
+            raise ValueError(
+                f"what-if cell {cell} leaves the live session's shape "
+                "bucket (a knob crossed a trace gate, e.g. 0 vs "
+                "positive): such a retuning needs a recompile and "
+                "cannot be answered from the live program"
+            )
+    batch = fork_state(state, len(cells))
+    dyn_rows = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *(dyn_of(sp) for sp in cells)
+    )
+    final = run_replicated(
+        key0, batch, net, bounds, n_ticks=n_ticks, dyn_rows=dyn_rows
+    )
+    return grid, final

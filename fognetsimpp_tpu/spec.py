@@ -668,6 +668,22 @@ class WorldSpec:
     # jit; the single-device engine never reads it.
     tp_shards: int = 0
 
+    # --- digital-twin live ingestion (fognetsimpp_tpu.twin, ISSUE 17) --
+    # Master gate for queue-fed arrivals: with it on, the serve loop may
+    # drain an external ingestion queue into next-chunk arrival state at
+    # each chunk boundary via core/engine._phase_inject (contract-
+    # registered, chunk-boundary-only — the compiled tick itself never
+    # hosts a transfer, hloaudit's tick_ingest variant proves it).  Off
+    # (the default) the injection phase never runs and every run is
+    # bit-exact vs the pre-twin engine (tests/test_twin.py state-hash
+    # A/B) — the inert-LearnState gate discipline.
+    ingest: bool = False
+    # Fixed injection batch width: the per-boundary drain hands the
+    # compiled injector at most this many arrival rows (padded with
+    # user=-1 sentinels), so the injector's shape never depends on queue
+    # depth and one compiled program serves every boundary.
+    ingest_batch: int = 64
+
     # --- misc ----------------------------------------------------------
     bug_compat: BugCompat = BugCompat()
     record_tick_series: bool = False  # emit per-tick vectors from the scan
@@ -1040,6 +1056,19 @@ class WorldSpec:
             ):
                 raise ValueError(
                     "chaos_rtt_burst_mult must be > 0 when bursts are on"
+                )
+        # --- digital-twin ingestion (ValueError: user-reachable knobs) -
+        if self.ingest:
+            if self.ingest_batch < 1:
+                raise ValueError(
+                    f"ingest_batch sizes the fixed injection batch "
+                    f"(>= 1 row), got {self.ingest_batch}"
+                )
+            if self.ingest_batch > self.task_capacity:
+                raise ValueError(
+                    f"ingest_batch={self.ingest_batch} exceeds the task "
+                    f"capacity {self.task_capacity}: one boundary could "
+                    "never land that many publishes"
                 )
         # --- federated hierarchy (ValueError: user-reachable knobs) ----
         if self.n_brokers < 1:

@@ -52,8 +52,14 @@ from ..spec import WorldSpec
 #: crash-loss column with an ABSOLUTE floor next to its z-score,
 #: exactly the ``defer_rate`` discipline: steady crash losses from
 #: tick 0 have zero variance and must still page.
+#: ``ingest_depth`` (ISSUE 17) is the twin ingestion queue's occupancy
+#: FRACTION at the chunk boundary (depth / capacity, host-side — it
+#: rides serve_run's ``extra`` signal door, not the reservoir): a
+#: backing-up arrival queue is the twin's earliest overload page,
+#: firing before a single request is dropped.
 WATCH_SIGNALS = ("q_depth", "busy_frac", "drop_rate", "defer",
-                 "defer_rate", "fog_down", "crash_loss_rate")
+                 "defer_rate", "fog_down", "crash_loss_rate",
+                 "ingest_depth")
 
 
 class Ewma:
@@ -258,9 +264,15 @@ class Watchdog:
         return fired
 
     def update_from_rows(
-        self, rows: Dict[str, np.ndarray], ticks_done: int
+        self, rows: Dict[str, np.ndarray], ticks_done: int,
+        extra: Optional[Dict[str, float]] = None,
     ) -> List[Dict]:
+        """``extra`` merges host-side signals (the twin's
+        ``ingest_depth``) into the chunk's row-derived ones — they are
+        scored even when the chunk completed no reservoir row."""
         sig = self.signals_from_rows(rows)
+        if extra:
+            sig.update(extra)
         if not sig:
             return []
         return self.update(sig, ticks_done)
@@ -333,6 +345,18 @@ class FlightRecorder:
             "ring": self.ring,
             "compile_cache": compile_stats(),
         }
+        # the twin's ingest roll-up (ISSUE 17): the newest chunk entry
+        # carrying queue stats becomes the bundle's ingest_summary —
+        # pre-twin bundles simply lack the key (the .get-safe contract)
+        ing = next(
+            (
+                e["ingest"] for e in reversed(self._ring)
+                if isinstance(e, dict) and e.get("ingest")
+            ),
+            None,
+        )
+        if ing is not None:
+            manifest["ingest_summary"] = dict(ing)
         if watchdog is not None:
             manifest["watchdog"] = {
                 "anomalies": list(watchdog.anomalies),
@@ -404,16 +428,48 @@ class HealthServer:
     stdlib, matching the container constraint.  ``port=0`` binds an
     ephemeral port (read it back from ``.port``); content is swapped
     atomically under a lock by the serving loop.
+
+    ``set_handler`` installs an optional route hook (ISSUE 17, the
+    twin's extension door): called FIRST for every request as
+    ``hook(method, path, body)`` and may return ``(status, ctype,
+    body)`` to serve the request — ``POST /ingest``, ``GET /whatif``
+    and the front door's per-tenant ``/t/<label>/...`` routes live in
+    :mod:`fognetsimpp_tpu.twin` behind this hook, so the base server
+    stays twin-agnostic.  Returning ``None`` falls through to the
+    built-in GET routes (404 for anything else).
     """
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self._lock = threading.Lock()
         self._metrics = "# EOF\n"
         self._health: Dict = {"status": "starting"}
+        self._hook: Optional[Callable] = None
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            def _respond(self, status, ctype, body):
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(int(status))
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _hooked(self, method: str, body: bytes) -> bool:
+                with outer._lock:
+                    hook = outer._hook
+                if hook is None:
+                    return False
+                out = hook(method, self.path, body)
+                if out is None:
+                    return False
+                self._respond(*out)
+                return True
+
             def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self._hooked("GET", b""):
+                    return
                 if self.path.startswith("/metrics"):
                     with outer._lock:
                         body = outer._metrics.encode()
@@ -426,11 +482,14 @@ class HealthServer:
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._respond(200, ctype, body)
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                if self._hooked("POST", body):
+                    return
+                self.send_error(404)
 
             def log_message(self, *a):  # silence per-request stderr
                 pass
@@ -451,6 +510,11 @@ class HealthServer:
     def set_health(self, payload: Dict) -> None:
         with self._lock:
             self._health = payload
+
+    def set_handler(self, hook: Optional[Callable]) -> None:
+        """Install (or clear) the route hook — see the class docstring."""
+        with self._lock:
+            self._hook = hook
 
     def close(self) -> None:
         self._httpd.shutdown()
@@ -475,6 +539,8 @@ def serve_run(
     run_fn: Optional[Callable] = None,
     shard_hash_fn: Optional[Callable] = None,
     reconfigure: Optional[Callable[[int], Optional[Dict]]] = None,
+    inject: Optional[Callable] = None,
+    ingest=None,
 ):
     """The production serving loop over ``run_chunked``.
 
@@ -511,10 +577,26 @@ def serve_run(
     live twin between scrapes without ever paying the compile wall.
     Only the default ``run_chunked`` runner supports it (the TP chunk
     runner gates promotion off).
+
+    ``inject`` / ``ingest`` (ISSUE 17, the digital-twin input door):
+    ``inject`` is forwarded to ``run_chunked``'s chunk-boundary hook
+    (external arrivals land between chunks); ``ingest`` is the
+    IngestQueue-like stats provider — anything with a ``stats()``
+    returning the twin/ingest dict — whose depth/accepted/dropped/
+    latency counters ride the exposition (``fns_twin_ingest_*``), the
+    /healthz payload and the watchdog's ``ingest_depth`` signal.
+    :func:`fognetsimpp_tpu.twin.ingest.serve_ingest_run` wires both
+    plus the HTTP POST endpoint.  Like ``reconfigure``, both need the
+    default ``run_chunked`` runner.
     """
     if reconfigure is not None and run_fn is not None:
         raise ValueError(
             "reconfigure rides run_chunked's DynSpec operand; custom "
+            "run_fn runners (the TP chunk loop) do not take it"
+        )
+    if inject is not None and run_fn is not None:
+        raise ValueError(
+            "inject rides run_chunked's chunk-boundary hook; custom "
             "run_fn runners (the TP chunk loop) do not take it"
         )
     import jax
@@ -591,10 +673,23 @@ def serve_run(
             from ..hier.federation import hier_counters
 
             extra["hier"] = hier_counters(s)
+        ingest_stats = ingest.stats() if ingest is not None else None
+        if ingest_stats is not None:
+            # the ingest roll-up rides every chunk entry: a post-mortem
+            # of a live session sees WHEN the queue backed up
+            extra["ingest"] = dict(ingest_stats)
         recorder.note_chunk(
             ticks_done, rows=rows, state_hash=h, extra=extra or None,
         )
-        fired = watchdog.update_from_rows(rows, ticks_done)
+        ingest_sig = None
+        if ingest_stats is not None:
+            ingest_sig = {
+                "ingest_depth": ingest_stats["depth"]
+                / max(float(ingest_stats.get("capacity", 1)), 1.0)
+            }
+        fired = watchdog.update_from_rows(
+            rows, ticks_done, extra=ingest_sig
+        )
         if fired:
             _dump("anomaly", s, detail={"anomalies": fired})
         if bad:
@@ -629,6 +724,11 @@ def serve_run(
                 if slo_ms is not None
                 else {}
             ),
+            **(
+                {"ingest": ingest_stats}
+                if ingest_stats is not None
+                else {}
+            ),
         }
         if server is not None:
             if hist is not None:
@@ -642,6 +742,7 @@ def serve_run(
                 render_openmetrics(
                     spec, s,
                     hist=hist,
+                    ingest=ingest_stats,
                     attrs={
                         "live_chunks": progress["chunks"],
                         "live_ticks": int(ticks_done),
@@ -662,6 +763,7 @@ def serve_run(
             spec, state, net, bounds,
             chunk_ticks=chunk_ticks, callback=_chunk_cb,
             **({} if reconfigure is None else {"reconfigure": reconfigure}),
+            **({} if inject is None else {"inject": inject}),
         )
     except Exception as e:
         # crash flight-record: the ring up to the last good chunk plus

@@ -243,17 +243,52 @@ def _render_compile_stats(lines: List[str]) -> None:
         _sample(lines, family, reg.get(key, 0))
 
 
+def _render_twin_ingest(lines: List[str], st: Dict) -> None:
+    """Emit the twin ingestion-queue families (ISSUE 17).
+
+    ``st`` is :meth:`fognetsimpp_tpu.twin.ingest.IngestQueue.stats` —
+    the single host-side source the /healthz ``ingest`` section and the
+    watchdog's ``ingest_depth`` signal also read.  The lint
+    (tools/check_openmetrics.py) requires the family set complete: a
+    drop counter without its depth gauge reads as a bug.
+    """
+    for family, key, kind, help_text in (
+        ("twin_ingest_depth", "depth", "gauge",
+         "arrival-queue occupancy at the last chunk boundary"),
+        ("twin_ingest_capacity", "capacity", "gauge",
+         "arrival-queue bound (feeds past it are dropped)"),
+        ("twin_ingest_accepted_total", "accepted", "counter",
+         "arrivals accepted into the queue"),
+        ("twin_ingest_dropped_total", "dropped", "counter",
+         "arrivals dropped at the full queue"),
+        ("twin_ingest_injected_total", "injected", "counter",
+         "arrivals landed into simulation state at chunk boundaries"),
+        ("twin_ingest_rejected_total", "rejected", "counter",
+         "drained arrivals the injector refused (dead/disconnected "
+         "user or send slots exhausted)"),
+        ("twin_ingest_latency_seconds", "latency_s", "gauge",
+         "feed-to-injection wall latency of the last drained batch"),
+    ):
+        _family(lines, family, kind, help_text=help_text)
+        _sample(lines, family, st.get(key, 0))
+
+
 def render_openmetrics(
     spec: WorldSpec,
     final: WorldState,
     attrs: Optional[Dict] = None,
     hist: Optional[Dict] = None,
+    ingest: Optional[Dict] = None,
 ) -> str:
     """OpenMetrics text for one finished run (terminated by ``# EOF``).
 
     ``hist``: a :func:`telemetry.health.hist_summary` dict the caller
     already computed (the recorder and the live loop hold one); when
     omitted it is derived here — one extra device fetch per render.
+
+    ``ingest`` (ISSUE 17): the twin ingestion queue's ``stats()`` dict;
+    serve_run passes it on live-ingestion sessions so the
+    ``fns_twin_ingest_*`` families ride the same exposition.
     """
     from ..runtime.signals import summarize
     from .metrics import telemetry_summary
@@ -366,11 +401,59 @@ def render_openmetrics(
         hist = hist_summary(spec, final)
     if hist is not None:
         _render_latency_hist(lines, hist)
+    # twin ingestion-queue families (ISSUE 17): host-side queue stats,
+    # present only on live-ingestion serve sessions
+    if ingest is not None:
+        _render_twin_ingest(lines, ingest)
     _render_compile_stats(lines)
     for k, v in (attrs or {}).items():
         if isinstance(v, (int, float)) and math.isfinite(float(v)):
             _family(lines, f"run_{k}")
             _sample(lines, f"run_{k}", v)
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def render_twin_openmetrics(tenants: List[Dict]) -> str:
+    """The front door's AGGREGATE exposition (ISSUE 17): one document
+    over every admitted tenant, terminated by ``# EOF``.
+
+    ``tenants`` is ordered by tenant index; each entry is a flat dict
+    of per-tenant scalars (:meth:`fognetsimpp_tpu.twin.front.FrontDoor.
+    tenant_rows` builds it).  Families carry a ``tenant="i"`` label,
+    and the published ``fns_twin_tenants`` count is the linter's
+    cross-check anchor: every tenant-labeled family must cover exactly
+    ``tenant=0..N-1`` gap-free (the ``fns_tp_shards`` /
+    ``fns_hier_brokers`` discipline).  Per-tenant FULL expositions live
+    at the front door's ``/t/<label>/metrics`` routes; this document is
+    the fleet-wide scrape.
+    """
+    lines: List[str] = []
+    _family(
+        lines, "twin_tenants",
+        help_text="tenant sessions admitted behind the front door",
+    )
+    _sample(lines, "twin_tenants", len(tenants))
+    for family, key, kind, help_text in (
+        ("twin_tenant_ticks", "ticks", "gauge",
+         "simulated ticks each tenant session has completed"),
+        ("twin_tenant_chunks", "chunks", "gauge",
+         "serve chunks each tenant session has completed"),
+        ("twin_tenant_users", "n_users", "gauge",
+         "bucketed user population of each tenant world"),
+        ("twin_tenant_published_total", "n_published", "counter",
+         "tasks published in each tenant world"),
+        ("twin_tenant_completed_total", "n_completed", "counter",
+         "tasks completed in each tenant world"),
+        ("twin_tenant_ingest_depth", "ingest_depth", "gauge",
+         "arrival-queue occupancy of each tenant (0 when the tenant "
+         "has no ingestion queue)"),
+    ):
+        _family(lines, family, kind, help_text=help_text)
+        for i, t in enumerate(tenants):
+            _sample(
+                lines, family, t.get(key, 0), labels=f'{{tenant="{i}"}}'
+            )
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 

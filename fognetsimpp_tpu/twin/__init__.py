@@ -1,0 +1,30 @@
+"""Digital-twin serving subsystem (ISSUE 17): the front door that turns
+``--serve`` from a replayed batch job into a service.
+
+Three coupled capabilities, layered over ``run_chunked``/``serve_run``:
+
+* :mod:`~fognetsimpp_tpu.twin.ingest` — queue-fed arrivals: a bounded,
+  drop-counted host-side queue (HTTP ``POST /ingest`` + in-process
+  ``feed()``) drained at each chunk boundary into next-chunk arrival
+  state through the engine's contract-registered injection phase, with
+  every accepted batch appended to a recorded arrival log so any live
+  session replays bit-exactly from its inputs.
+* :mod:`~fognetsimpp_tpu.twin.whatif` — state-forked what-if grids:
+  fork the chunk-boundary carry onto a promoted-knob grid
+  (``sweep_dyn_from``) and answer "p95/energy/defer under these K
+  retunings, starting from current state, H ticks ahead" in one
+  vmapped compile — zero compile events warm.
+* :mod:`~fognetsimpp_tpu.twin.front` — multi-tenant front door: N
+  independent serve sessions multiplexed over the shared bucketed
+  program registry, with capacity-bounded admission, round-robin chunk
+  scheduling, per-tenant flight recorders and per-tenant
+  ``/metrics``-``/healthz``-``/whatif`` routing (the FogMQ shape,
+  arXiv:1610.00620: broker federation as a SERVICE, not a batch job).
+
+Composition limits carry stable ``[TWIN-*]`` clause IDs
+(:mod:`~fognetsimpp_tpu.twin.gates`, machine-checked by
+``tools/featmat``).
+"""
+from .front import FrontDoor  # noqa: F401
+from .ingest import IngestQueue, make_inject, serve_ingest_run  # noqa: F401
+from .whatif import run_whatif  # noqa: F401

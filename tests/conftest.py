@@ -70,6 +70,10 @@ _QUICK_FILES = {
     "test_contracts.py",
     "test_donation.py",
     "test_cli_errors.py",
+    # digital twin (ISSUE 17): ingestion determinism/replay, what-if
+    # fork bit-exactness + zero-warm-compile, front-door shared-program
+    # gates — small worlds, the twin's acceptance rails stay in tier-1
+    "test_twin.py",
     # learn/ bandit schedulers (ISSUE 2): unit + regret-harness gates on
     # small worlds — the in-loop-learning capability must stay inside the
     # edit loop, not drift behind the slow tier

@@ -390,3 +390,156 @@ def test_journeys_with_tp_is_clear_error(capsys):
     assert e.value.code == 2
     err = capsys.readouterr().err
     assert "[TP-JOURNEYS]" in err
+
+
+# ---- digital-twin guard rails (twin/, ISSUE 17) -----------------------
+# Every [TWIN-*]/[CLI-*TWIN*] rejection clause of the feature matrix is
+# asserted here by its literal ID (featmat consistency gate 3).
+
+
+def test_ingest_with_tp_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--ingest", "8", "--tp", "8"])
+    assert e.value.code == 2
+    assert "[TWIN-INGEST-TP]" in capsys.readouterr().err
+
+
+def test_ingest_with_replicas_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--ingest", "8", "--replicas", "8"])
+    assert e.value.code == 2
+    assert "[TWIN-INGEST-FLEET]" in capsys.readouterr().err
+
+
+def test_ingest_requires_serve(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--ingest", "8"])
+    assert e.value.code == 2
+    assert "[TWIN-INGEST-SERVE]" in capsys.readouterr().err
+
+
+def test_replay_arrivals_requires_serve(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--replay-arrivals", "log.json"])
+    assert e.value.code == 2
+    assert "[TWIN-INGEST-SERVE]" in capsys.readouterr().err
+
+
+def test_ingest_capacity_below_one_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--serve", "0", "--ingest", "0"])
+    assert e.value.code == 2
+    assert "capacity must be >= 1" in capsys.readouterr().err
+
+
+def test_whatif_with_tp_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke",
+              "--whatif", "uplink_loss_prob=0.1", "--tp", "8"])
+    assert e.value.code == 2
+    assert "[TWIN-WHATIF-TP]" in capsys.readouterr().err
+
+
+def test_whatif_with_replicas_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke",
+              "--whatif", "uplink_loss_prob=0.1", "--replicas", "8"])
+    assert e.value.code == 2
+    assert "[TWIN-WHATIF-FLEET]" in capsys.readouterr().err
+
+
+def test_whatif_on_static_spec_path_is_clear_error(monkeypatch, capsys):
+    monkeypatch.setenv("FNS_SPEC_PROMOTE", "0")
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--whatif", "uplink_loss_prob=0.1"])
+    assert e.value.code == 2
+    assert "[TWIN-WHATIF-STATIC]" in capsys.readouterr().err
+
+
+def test_whatif_conflicts_with_sweep(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--whatif", "uplink_loss_prob=0.1",
+              "--sweep", "policies=min_busy loads=0.05"])
+    assert e.value.code == 2
+    assert "[CLI-SWEEP-TWIN]" in capsys.readouterr().err
+
+
+def test_tenants_with_tp_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tenants", "2", "--tp", "8"])
+    assert e.value.code == 2
+    assert "[TWIN-FRONT-TP]" in capsys.readouterr().err
+
+
+def test_tenants_with_replicas_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tenants", "2", "--replicas", "8"])
+    assert e.value.code == 2
+    assert "[TWIN-FRONT-FLEET]" in capsys.readouterr().err
+
+
+def test_tenants_requires_serve(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tenants", "2"])
+    assert e.value.code == 2
+    assert "[TWIN-FRONT-SERVE]" in capsys.readouterr().err
+
+
+def test_tenants_below_one_is_clear_error(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tenants", "0", "--serve", "0"])
+    assert e.value.code == 2
+    assert "--tenants must be >= 1" in capsys.readouterr().err
+
+
+def test_tenants_conflicts_with_whatif_flag(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tenants", "2", "--serve", "0",
+              "--whatif", "uplink_loss_prob=0.1"])
+    assert e.value.code == 2
+    assert "[CLI-TENANTS-WHATIF]" in capsys.readouterr().err
+
+
+def test_tenants_conflicts_with_replay(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tenants", "2", "--serve", "0",
+              "--replay-arrivals", "log.json"])
+    assert e.value.code == 2
+    assert "[CLI-TENANTS-REPLAY]" in capsys.readouterr().err
+
+
+def test_tenant_cap_requires_tenants(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--scenario", "smoke", "--tenant-cap", "2"])
+    assert e.value.code == 2
+    assert "[CLI-TENANTCAP]" in capsys.readouterr().err
+
+
+def test_malformed_ingest_payload_is_one_line_400():
+    """Malformed POST /ingest bodies get the [TWIN-PAYLOAD] one-liner,
+    never a traceback (the queue parses before touching the device)."""
+    from fognetsimpp_tpu.twin.ingest import IngestQueue
+
+    q = IngestQueue(capacity=4)
+    for body in (b"not json", b'{"user": -1, "mips": 5.0}',
+                 b'{"rows": [[0, "fast"]]}', b'{"mips": 5.0}',
+                 b'{"user": true, "mips": 1.0}'):
+        status, doc = q.ingest_payload(body)
+        assert status == 400
+        assert "[TWIN-PAYLOAD]" in doc["error"]
+    assert q.depth == 0  # nothing malformed was queued
+
+
+def test_malformed_whatif_payload_is_one_line_400():
+    """Malformed POST /whatif bodies get the [TWIN-WHATIF-PAYLOAD]
+    one-liner before any device work (no carry needed to reject)."""
+    from fognetsimpp_tpu.twin.whatif import WhatIfDoor
+
+    door = WhatIfDoor(None, None, None)
+    for body in (b"not json", b"[]", b'{"knobs": []}',
+                 b'{"knobs": {"x": []}}',
+                 b'{"knobs": {"x": [1, "a"]}}',
+                 b'{"knobs": {"x": [1]}, "ticks": "soon"}'):
+        status, doc = door._post(body)
+        assert status == 400
+        assert "[TWIN-WHATIF-PAYLOAD]" in doc["error"]

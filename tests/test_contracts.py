@@ -47,6 +47,11 @@ def _worlds():
             horizon=0.4, telemetry=True, telemetry_journeys=4,
             telemetry_journey_ring=16,
         ),
+        # live-ingestion world (ISSUE 17: the chunk-boundary arrival
+        # injection phase — draw-free, gated on spec.ingest)
+        smoke.build(
+            horizon=0.4, telemetry=True, ingest=True, ingest_batch=8,
+        ),
     ]
 
 

@@ -129,6 +129,13 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     # journey-ring overhead (ISSUE 15): interleaved
                     # off/on A/B recorded by bench.py BENCH_JOURNEYS=1
                     "journey_overhead": parsed.get("journey_overhead"),
+                    # digital-twin doors (ISSUE 17, bench.py --twin):
+                    # pre-twin captures backfill None via .get
+                    "ingest_rate": parsed.get("ingest_rate"),
+                    "whatif_latency_s": parsed.get("whatif_latency_s"),
+                    "whatif_compile_events": parsed.get(
+                        "whatif_compile_events"
+                    ),
                     "parsed": parsed,
                 }
             )
@@ -195,6 +202,18 @@ def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
                     f"only {float(comp) / float(rc):.1f}x faster than "
                     f"the {float(comp):.1f}s cold compile (bar: "
                     f">= {RECONFIG_SPEEDUP_BAR:.0f}x)"
+                )
+        # warm what-if bar (ISSUE 17): every capture that measured a
+        # whatif_latency_s must have compiled NOTHING during the warm
+        # asks — the grid rides the live session's fork program
+        if r.get("whatif_latency_s") is not None:
+            wev = r.get("whatif_compile_events")
+            if wev:
+                problems.append(
+                    f"{r['file']}: {float(wev):.0f} compile event(s) "
+                    "during the warm what-if asks — the fork grid is "
+                    "recompiling instead of reusing the live session's "
+                    "program (compile_stats delta must be 0)"
                 )
     # lower-is-better ratchet on reconfig_s per shape
     for shape, traj in trajectories(rows).items():
@@ -276,6 +295,11 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                 rcs = (
                     f", reconfig {rc}s"
                     if r.get("reconfig_s") is not None
+                    else ""
+                )
+                rcs += (
+                    f", whatif {r['whatif_latency_s']:.3f}s"
+                    if r.get("whatif_latency_s") is not None
                     else ""
                 )
                 out.append(

@@ -29,6 +29,16 @@ health plane (r6):
   gap-free ``0..B-1`` cross-checked against the published
   ``fns_hier_brokers`` count — exactly the ISSUE 11 shard-label rule;
   previously a missing trailing broker series passed the lint.
+* the twin front-door families (ISSUE 17, ``fns_twin_tenant_*``)
+  carry the ``tenant`` label dimension on every sample, integer-valued
+  and gap-free ``0..N-1`` cross-checked against the published
+  ``fns_twin_tenants`` count — the shard/broker label rule replayed
+  for the multi-tenant aggregate exposition;
+* the twin ingestion family (``fns_twin_ingest_*``) is all-or-nothing:
+  once any of its gauges appears, the full set (depth, capacity,
+  accepted/dropped/injected/rejected totals, latency) must be present
+  — a partial ingest exposition means a dashboard silently loses the
+  drop or depth signal it alarms on.
 """
 import math
 import re
@@ -57,6 +67,22 @@ _HIER_BROKER_FAMILIES = frozenset(
         "fns_hier_fogs",
         "fns_hier_users",
         "fns_hier_load_mean",
+    )
+)
+
+
+#: The complete ingestion gauge family (twin/): the live exposition
+#: emits all of these or none — alarms ride depth vs capacity and the
+#: dropped counter, so a partial render is a silent hole.
+_TWIN_INGEST_FAMILIES = frozenset(
+    (
+        "fns_twin_ingest_depth",
+        "fns_twin_ingest_capacity",
+        "fns_twin_ingest_accepted_total",
+        "fns_twin_ingest_dropped_total",
+        "fns_twin_ingest_injected_total",
+        "fns_twin_ingest_rejected_total",
+        "fns_twin_ingest_latency_seconds",
     )
 )
 
@@ -187,6 +213,49 @@ def check_lines(lines, where: str) -> int:
                 f"{sorted(vals)}, expected 0..{max(want)}"
             )
             return 1
+    # twin front-door tenant-label contract (ISSUE 17): the
+    # shard/broker rule replayed for the per-tenant aggregate families
+    tenant_vals = {}  # family -> set of tenant ints
+    n_tenants = None  # the exposition's own fns_twin_tenants sample
+    for i, name, labels_text, v in samples:
+        if name == "fns_twin_tenants":
+            n_tenants = int(v)
+        fam = _family(name, types)
+        if not fam.startswith("fns_twin_tenant_"):
+            continue
+        labels = _parse_labels(labels_text)
+        if "tenant" not in labels:
+            print(f"{where}:{i}: {name} sample without a 'tenant' label")
+            return 1
+        tv = labels["tenant"]
+        if not tv.isdigit():
+            print(f"{where}:{i}: {name} has non-integer tenant={tv!r}")
+            return 1
+        tenant_vals.setdefault(fam, set()).add(int(tv))
+    for fam, vals in tenant_vals.items():
+        # cross-check against the published tenant count when present:
+        # a missing trailing tenant series (a truncated render loop)
+        # would otherwise pass — only fns_twin_tenants knows the true N
+        want = set(range(n_tenants if n_tenants else max(vals) + 1))
+        if vals != want:
+            print(
+                f"{where}: family {fam} has tenant gaps: saw "
+                f"{sorted(vals)}, expected 0..{max(want)}"
+            )
+            return 1
+    # twin ingestion-family completeness (ISSUE 17): all-or-nothing
+    ingest_present = {
+        _family(name, types)
+        for _i, name, _l, _v in samples
+        if _family(name, types) in _TWIN_INGEST_FAMILIES
+    }
+    if ingest_present and ingest_present != _TWIN_INGEST_FAMILIES:
+        missing = sorted(_TWIN_INGEST_FAMILIES - ingest_present)
+        print(
+            f"{where}: partial fns_twin_ingest_* exposition: missing "
+            f"{', '.join(missing)}"
+        )
+        return 1
     # histogram bucket contract
     hist_fams = {n for n, k in types.items() if k == "histogram"}
     for fam in hist_fams:

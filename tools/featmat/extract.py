@@ -45,6 +45,7 @@ GATE_FILES = (
     "fognetsimpp_tpu/core/engine.py",
     "fognetsimpp_tpu/hier/federation.py",
     "fognetsimpp_tpu/parallel/fleet.py",
+    "fognetsimpp_tpu/twin/gates.py",
     "fognetsimpp_tpu/__main__.py",
 )
 
@@ -53,10 +54,11 @@ OWNER_OF = {
     "TP": "fognetsimpp_tpu/core/engine.py",
     "FLEET": "fognetsimpp_tpu/parallel/fleet.py",
     "SPEC": "fognetsimpp_tpu/spec.py",
+    "TWIN": "fognetsimpp_tpu/twin/gates.py",
     "CLI": "fognetsimpp_tpu/__main__.py",
 }
 
-_ID_RE = re.compile(r"\[((?:TP|FLEET|SPEC|CLI)-[A-Z0-9-]+)\]")
+_ID_RE = re.compile(r"\[((?:TP|FLEET|SPEC|TWIN|CLI)-[A-Z0-9-]+)\]")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -59,6 +59,9 @@ FEATURES = {
     "hier": "federated multi-broker hierarchy (n_brokers > 1)",
     "journeys": "causal task-journey event rings",
     "dynspec": "DynSpec-promoted numeric knobs (zero-recompile reconfig)",
+    "ingest": "live arrival ingestion (queue-fed chunk-boundary injection)",
+    "whatif": "state-forked what-if grids (run_whatif from a live carry)",
+    "front": "multi-tenant serve front door (twin/front.FrontDoor)",
 }
 
 
@@ -134,6 +137,16 @@ CELLS: Tuple[Cell, ...] = (
     _a("dynspec", "run", "variant:tick_dyn"),
     _u("dynspec", "tp"),
     _u("dynspec", "fleet"),
+    _a("ingest", "run", "variant:tick_ingest",
+       "test:test_replay_from_arrival_log"),
+    _r("ingest", "tp", "TWIN-INGEST-TP"),
+    _r("ingest", "fleet", "TWIN-INGEST-FLEET"),
+    _a("whatif", "run", "test:test_whatif_fork_matches_cold_runs"),
+    _r("whatif", "tp", "TWIN-WHATIF-TP"),
+    _r("whatif", "fleet", "TWIN-WHATIF-FLEET"),
+    _a("front", "run", "test:test_front_door_shared_program"),
+    _r("front", "tp", "TWIN-FRONT-TP"),
+    _r("front", "fleet", "TWIN-FRONT-FLEET"),
 )
 
 
@@ -202,6 +215,38 @@ COMPOSITIONS: Tuple[Composition, ...] = (
     Composition("CLI-PROGRESS-SERIES", "progress", "series",
                 "progress chunking and straight series recording "
                 "conflict"),
+    Composition("TWIN-INGEST-SERVE", "ingest", "serve-off",
+                "live ingestion drains at the serving loop's chunk "
+                "boundaries; it needs --serve"),
+    Composition("TWIN-INGEST-OFF", "ingest-feed", "ingest-gate-off",
+                "injection is compiled out when spec.ingest is False "
+                "(the bit-exactness contract)"),
+    Composition("TWIN-WHATIF-STATIC", "whatif", "static-spec",
+                "what-if grids ride the promoted DynSpec operand; the "
+                "FNS_SPEC_PROMOTE=0 path would compile per cell"),
+    Composition("TWIN-PAYLOAD", "ingest-http", "malformed-payload",
+                "malformed ingest traffic gets a one-line 400, never "
+                "kills the live session"),
+    Composition("TWIN-WHATIF-PAYLOAD", "whatif-http", "malformed-payload",
+                "malformed what-if requests get a one-line 400 from "
+                "the door"),
+    Composition("TWIN-FRONT-SERVE", "front", "serve-off",
+                "--tenants multiplexes live sessions behind one HTTP "
+                "endpoint; it needs --serve"),
+    Composition("TWIN-CAP", "front", "over-admission",
+                "tenant admission past the capacity bound is a "
+                "one-line rejection, not a queue"),
+    Composition("CLI-SWEEP-TWIN", "sweep", "twin",
+                "sweeps build every cell's world from the grid; no "
+                "live twin surface"),
+    Composition("CLI-TENANTS-WHATIF", "tenants", "whatif-flag",
+                "per-tenant what-ifs ride POST /t/<label>/whatif, not "
+                "the one-shot flag"),
+    Composition("CLI-TENANTS-REPLAY", "tenants", "replay",
+                "arrival logs are per session; replay one tenant solo"),
+    Composition("CLI-TENANTCAP", "tenant-cap-knob", "tenants-off",
+                "--tenant-cap bounds front-door admission; it refines "
+                "--tenants"),
 )
 
 

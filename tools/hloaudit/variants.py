@@ -157,6 +157,20 @@ JOURNEY_OVERRIDES = dict(
 )
 
 
+#: The live-twin tick overrides (ISSUE 17): the ingestion gate ON over
+#: the telemetry+histogram serving shape.  Injection happens at HOST
+#: chunk boundaries (twin/ingest drains into engine.inject_arrivals),
+#: so the compiled tick itself must be bit-identical in structure to
+#: the ingest-off tick — auditing it proves the gate adds NO ops, no
+#: host transfers and no budget growth to the inner loop.
+INGEST_OVERRIDES = dict(
+    ingest=True,
+    telemetry=True,
+    telemetry_hist=True,
+    derive_acks=False,
+)
+
+
 def _compile_tick(**build_overrides):
     """Compile ONE tick of the op-budget pinned world; returns a
     :class:`CompiledArtifact`.  The same lower/compile path op_budget
@@ -422,6 +436,15 @@ def variants() -> List[Variant]:
             "(arrival_window=16: the bounded candidate tail instead of "
             "the fused no-window mode)",
             lambda: _compile_tick(arrival_window=16),
+        ),
+        Variant(
+            "tick_ingest",
+            "the telemetry+histogram serving tick with the live-"
+            "ingestion gate on (ISSUE 17: spec.ingest=True) — arrival "
+            "injection is a host-side chunk-boundary phase, so the "
+            "compiled tick must stay host-transfer-free and carry "
+            "ZERO extra ops versus the ingest-off serving tick",
+            lambda: _compile_tick(**INGEST_OVERRIDES),
         ),
         Variant(
             "run_jit_donated",

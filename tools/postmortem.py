@@ -163,6 +163,18 @@ def summarize(d: Dict) -> List[str]:
                     f"{e['name']}@{e['t']:.4f}" for e in tail
                 )
             )
+    ing = d.get("ingest_summary") or {}
+    if ing:
+        # twin ingestion roll-up (ISSUE 17): .get-safe like every other
+        # optional field — pre-twin bundles simply skip the line
+        out.append(
+            "ingest:      "
+            f"depth={ing.get('depth')}/{ing.get('capacity')} "
+            f"accepted={ing.get('accepted')} "
+            f"dropped={ing.get('dropped')} "
+            f"injected={ing.get('injected')} "
+            f"rejected={ing.get('rejected')}"
+        )
     cc = d.get("compile_cache") or {}
     if cc:
         out.append(
@@ -232,6 +244,17 @@ def diff(a: Dict, b: Dict) -> List[str]:
                     f"{first_div}: the divergence is in the replicated "
                     "fog/broker state"
                 )
+    for t in shared:
+        # twin ingestion (ISSUE 17): diverging injected counts mean the
+        # two sessions were FED differently — the input stream, not the
+        # engine, explains a downstream hash divergence
+        ia = (ra[t].get("ingest") or {}).get("injected")
+        ib = (rb[t].get("ingest") or {}).get("injected")
+        if ia is not None and ib is not None and ia != ib:
+            out.append(
+                f"tick {t}: injected arrivals differ ({ia} != {ib}) — "
+                "the sessions were fed different input streams"
+            )
     for t in shared:
         for field, va in (ra[t].get("rows") or {}).items():
             vb = (rb[t].get("rows") or {}).get(field)
