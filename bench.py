@@ -501,7 +501,11 @@ def tp_measurement(n_devices=None) -> dict:
     world of the ROADMAP's first open item).  Env knobs:
     BENCH_TP_USERS / BENCH_TP_FOGS / BENCH_TP_INTERVAL / BENCH_TP_DT /
     BENCH_TP_HORIZON / BENCH_TP_REPS / BENCH_TP_WINDOW (per-shard
-    exchange window; 0 = never-defer full window).
+    exchange window; 0 = never-defer full window) /
+    BENCH_TP_ARRIVAL_WINDOW (GLOBAL spec-level arrival window K > 0:
+    the ISSUE 18 windowed regime — distributed K-window selection over
+    the hop-pruned top-K exchange ring, per-hop payload K*5*4 bytes;
+    mutually exclusive with BENCH_TP_WINDOW).
 
     Assumes the devices already exist (callers own the
     ``xla_force_host_platform_device_count`` dance).
@@ -529,6 +533,9 @@ def tp_measurement(n_devices=None) -> dict:
     mspt = max(1, -(-int(round(dt * 1e6)) // int(round(interval * 1e6))))
 
     tp_telem_ab = os.environ.get("BENCH_TP_TELEMETRY", "") not in ("", "0")
+    # ISSUE 18 windowed regime: a GLOBAL spec-level arrival window K
+    # switches the exchange to the hop-pruned top-K merge ring
+    arrival_k = _env_int("BENCH_TP_ARRIVAL_WINDOW", 0)
 
     def build(telemetry=False):
         return smoke.build(
@@ -544,6 +551,7 @@ def tp_measurement(n_devices=None) -> dict:
             start_time_max=min(0.05, horizon / 4),
             derive_acks=True,
             telemetry=telemetry,
+            **({"arrival_window": arrival_k} if arrival_k > 0 else {}),
         )
 
     spec, state, net, bounds = build()
@@ -551,13 +559,29 @@ def tp_measurement(n_devices=None) -> dict:
     # per-shard exchange window: auto-size from the spec's own arrival
     # rate (the WorldSpec.auto_arrival_window discipline, per shard)
     win_env = _env_int("BENCH_TP_WINDOW", -1)
-    if win_env == 0:
+    if arrival_k > 0:
+        if win_env > 0:
+            raise SystemExit(
+                "BENCH_TP_ARRIVAL_WINDOW (windowed spec) and "
+                "BENCH_TP_WINDOW (no-window exchange tuning) are "
+                "mutually exclusive"
+            )
+        window = None  # the spec's own K-window bounds the exchange
+    elif win_env == 0:
         window = None  # full candidate list: never defers
     elif win_env > 0:
         window = win_env
     else:
         u_loc = n_users // D
         window = max(256, int(1.3 * u_loc * dt / max(interval, 1e-12)) + 64)
+    # per-hop exchange-ring payload (bytes): the windowed merge ring
+    # carries a packed (K, 5) i32 block; the no-window all-gather ring
+    # a packed (K_ex, 4) i32 block (K_ex defaults to shard capacity)
+    if arrival_k > 0:
+        payload_bytes = arrival_k * 5 * 4
+    else:
+        k_ex = window if window is not None else (n_users // D) * mspt
+        payload_bytes = k_ex * 4 * 4
 
     t0 = time.perf_counter()
     _, final = run_tp_sharded(
@@ -628,6 +652,8 @@ def tp_measurement(n_devices=None) -> dict:
         "dt": dt,
         "interval": interval,
         "exchange_window": window,
+        "tp_window": arrival_k if arrival_k > 0 else None,
+        "exchange_payload_bytes": payload_bytes,
         "decisions": decisions,
         "wall_s": round(wall, 4),
         "per_device_decisions_per_sec": round(decisions / wall / D, 1),
@@ -637,7 +663,8 @@ def tp_measurement(n_devices=None) -> dict:
             k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in compile_stats().items()
         },
-        "collectives_per_tick": "pinned in tools/op_budget.json tp_tick",
+        "collectives_per_tick": "pinned in tools/op_budget.json "
+        + ("tp_tick_window" if arrival_k > 0 else "tp_tick"),
         "equivalence": "state-hash == single-device engine; "
         "tests/test_tp.py",
     }

@@ -170,7 +170,12 @@ def main(argv=None) -> int:
                     "list, which never defers).  Bounded windows defer "
                     "overflow arrivals a tick (Metrics.n_deferred, the "
                     "fns_tp_exchange_* gauges, and — under --serve — "
-                    "the defer-rate watchdog make it observable)")
+                    "the defer-rate watchdog make it observable).  "
+                    "Applies to NO-WINDOW specs only: a spec with its "
+                    "own scenario.arrival_window already runs the "
+                    "distributed K-window selection (the hop-pruned "
+                    "top-K exchange ring, bit-exact vs single-device) "
+                    "and rejects --tp-window")
     ap.add_argument("--replicas", type=int, default=None, metavar="R",
                     help="Monte-Carlo fleet: advance R replica worlds "
                     "(per-replica PRNG streams) sharded over the device "

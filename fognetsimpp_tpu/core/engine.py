@@ -148,9 +148,11 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
 
     The TP tick covers the dense-broker production family — the same
     static family as the fused front-end (:func:`_broker_dense_ok` over
-    FIFO fogs with the two-stage arrival front-end) — in the no-window
-    regime, on a static topology.  Everything else keeps the GSPMD
-    fallback (:func:`fognetsimpp_tpu.parallel.taskshard.run_node_sharded`
+    FIFO fogs with the two-stage arrival front-end) — windowed or not
+    (a windowed spec runs the distributed K-window selection over the
+    exchange ring), on a static topology.  Everything else keeps the
+    GSPMD fallback
+    (:func:`fognetsimpp_tpu.parallel.taskshard.run_node_sharded`
     dispatches) or the single-device engine.
 
     Every clause leads with a stable machine-parseable ID (``[TP-*]``):
@@ -198,11 +200,6 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
         )
     if not spec.two_stage_arrivals:
         return "[TP-ARRIVALS] TP tick needs the two-stage arrival front-end"
-    if spec.window < spec.task_capacity:
-        return (
-            "[TP-WINDOW] TP tick runs the no-window candidate tail: needs "
-            "arrival_window=None (window >= task_capacity)"
-        )
     if not spec.assume_static:
         return (
             "[TP-DYNTOPO] TP tick hoists one association/delay cache for "

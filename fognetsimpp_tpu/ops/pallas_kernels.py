@@ -191,16 +191,36 @@ def fused_arrival_plan(
     return rank[0], cnt[0], tmin[0], first[0]
 
 
-def pallas_ring_applicable(ndim: int, n_shards: int) -> bool:
-    """Opt-in (FNS_PALLAS_RING=1) gate for the remote-DMA ring
-    all-gather used by the TP arrival exchange
-    (``parallel/taskshard.ring_all_gather``).  TPU backend only — the
-    portable default is the ``lax.ppermute`` ring; ``interpret=True``
-    runs the identical kernel on CPU (tests/test_tp.py asserts exact
-    equality with both the ppermute ring and a dense reference).
-    Takes the static rank (not the traced array) so the host-side gate
-    never touches traced values (simlint R2)."""
+def pallas_ring_applicable(
+    ndim: int, n_shards: int, merged: bool = False
+) -> bool:
+    """Opt-in (FNS_PALLAS_RING=1) gate for the remote-DMA ring kernel
+    used by the TP arrival exchange.  TPU backend only — the portable
+    default is the ``lax.ppermute`` ring; ``interpret=True`` runs the
+    identical kernel on CPU (tests/test_tp.py asserts exact equality
+    with both the ppermute ring and a dense reference).  Takes the
+    static rank (not the traced array) so the host-side gate never
+    touches traced values (simlint R2).
+
+    ``merged=True`` is the WINDOWED exchange
+    (``parallel/taskshard.ring_topk_merge``): each hop merges the
+    incoming K-slot window and truncates back to K, so the payload
+    stays ``(K, W)`` — NOT the ``(n*K, W)`` all-gather shape this
+    kernel produces.  The gate declines (with the opt-in note) rather
+    than let ``FNS_PALLAS_RING=1`` silently hand the merge path a
+    wrong-shaped gather; a merge-capable kernel (per-hop
+    :func:`ops.queues.topk_merge_sorted` stage between the remote
+    copies) is the follow-up that would flip this.
+    """
     if os.environ.get("FNS_PALLAS_RING", "0") != "1":
+        return False
+    if merged:
+        _optin_note(
+            "FNS_PALLAS_RING",
+            "the remote-DMA kernel all-gathers (n*K, W); the windowed "
+            "exchange needs a per-hop top-K merge to a (K, W) payload "
+            "— keeping the lax.ppermute merge ring",
+        )
         return False
     if n_shards < 2 or ndim != 2:
         return False
@@ -228,6 +248,13 @@ def ring_all_gather_pallas(
     (:func:`pallas_ring_applicable`): the XLA collective-permute path
     is the measured default until a chip session proves this kernel
     wins (the fused_arrival_plan discipline).
+
+    This kernel serves the NO-WINDOW exchange only
+    (``taskshard.ring_all_gather``): the output is the full ``(n*K,
+    C)`` gather.  The windowed exchange (``taskshard.ring_topk_merge``)
+    keeps a ``(K, C)`` payload by merging+truncating at every hop —
+    :func:`pallas_ring_applicable` declines ``merged=True`` until this
+    kernel grows that per-hop merge stage.
     """
     from jax.experimental.pallas import tpu as pltpu
 

@@ -74,6 +74,34 @@ def row_lexmin(keys: jax.Array) -> Tuple[jax.Array, jax.Array]:
     )
 
 
+def topk_merge_sorted(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Merge two K-row payload windows sorted ascending on the LAST column
+    and truncate back to the best K rows.
+
+    ``a`` and ``b`` are ``(K, W)`` i32 payload blocks whose last column is
+    the sort key (the TP exchange ring's global scan-order position).  The
+    merge is one binary-search rank per side (``searchsorted`` against the
+    OTHER side's sorted keys — O(K log K), no sort network and no O(K^2)
+    comparison matrix) + two drop-mode scatters.  Each output rank in
+    ``[0, K)`` is written exactly once: ``rank_a[i] = i + |{j : b[j] <
+    a[i]}|`` and ``rank_b[j] = j + |{i : a[i] <= b[j]}|`` partition the
+    merged order with ``a`` winning ties, so for globally-unique keys
+    (every valid candidate has a distinct scan position; padding rows are
+    bit-identical sentinels) the result is set-determined — independent of
+    which shard's window arrives as ``a`` vs ``b``, which is what makes the
+    hop-merged ring replicate bit-coherently on every shard.
+    """
+    K = a.shape[0]
+    av, bv = a[:, -1], b[:, -1]
+    k = jnp.arange(K, dtype=jnp.int32)
+    rank_a = k + jnp.searchsorted(bv, av, side="left").astype(jnp.int32)
+    rank_b = k + jnp.searchsorted(av, bv, side="right").astype(jnp.int32)
+    out = jnp.zeros_like(a)
+    out = out.at[rank_a].set(a, mode="drop")
+    out = out.at[rank_b].set(b, mode="drop")
+    return out
+
+
 def plan_arrivals(
     mask: jax.Array,  # (K,) bool — tasks arriving at a fog this tick
     fog: jax.Array,  # (K,) i32 — destination fog per task

@@ -36,7 +36,14 @@ Two implementations live here:
     bit-coherent without locks; task-table writes land only on the
     owning shard (drop-scatter on out-of-shard rows).  Saturated-fog
     tail-drops are decided shard-local (one ``psum`` for the per-fog
-    busy/count sums) and never occupy exchange slots;
+    busy/count sums) and never occupy exchange slots.  A WINDOWED spec
+    (``arrival_window=K < task_capacity``) switches the exchange to
+    distributed top-K selection (:func:`ring_topk_merge`): each shard
+    pre-selects its K best candidates in the engine's rotated global
+    scan order, every hop merges the incoming neighbor window and
+    truncates back to K, and the assembled window is bit-identical to
+    compacting the full global candidate list — per-hop payload is
+    O(K) packed slots instead of O(total candidates);
   - *counters*: ONE end-of-tick ``psum`` folds every shard-partial
     scalar (metrics deltas + broker message counters) into the
     replicated totals.
@@ -73,6 +80,7 @@ from ..core.engine import (
     TpCtx,
     _arrival_candidates,
     _compact,
+    _compact_lane_width,
     _finalize_derived_acks,
     _per_fog,
     _phase_adverts,
@@ -94,7 +102,13 @@ from ..core.engine import (
 )
 from ..net.mobility import MobilityBounds
 from ..net.topology import LinkCache, NetParams, associate
-from ..ops.queues import NO_TASK, batched_enqueue, batched_pop, plan_arrivals
+from ..ops.queues import (
+    NO_TASK,
+    batched_enqueue,
+    batched_pop,
+    plan_arrivals,
+    topk_merge_sorted,
+)
 from ..spec import WorldSpec
 from ..state import Metrics, NodeState, TaskState, UserState, WorldState
 from ..telemetry.health import latency_hist_delta
@@ -363,6 +377,41 @@ def ring_all_gather(x: jax.Array, axis_name: str, n_shards: int) -> jax.Array:
     return out
 
 
+def ring_topk_merge(win: jax.Array, axis_name: str, n_shards: int) -> jax.Array:
+    """Distributed top-K selection over the exchange ring.
+
+    ``win`` is this shard's ``(K, W)`` i32 payload window, sorted
+    ascending on its LAST column (the globally-unique scan-order
+    position key; padding rows are bit-identical max-key sentinels).
+    Each of the ``n-1`` ``lax.ppermute`` hops forwards the block
+    RECEIVED last hop (the original shard windows circulate — never the
+    accumulator, which would double-merge) and folds it into the running
+    window via :func:`ops.queues.topk_merge_sorted`, truncating back to
+    K rows — so the per-hop payload stays O(K) packed slots where
+    :func:`ring_all_gather` ships O(n*K).  After ``n-1`` hops every
+    shard has merged all ``n`` windows; unique keys make the merged
+    K-set order-independent, so the result replicates bit-coherently
+    without a final broadcast, and it equals the best-K prefix of
+    sorting the full gather (tests/test_tp.py A/Bs it against
+    ``ring_all_gather`` + sort).
+    """
+    if n_shards == 1:
+        return win
+    from ..ops.pallas_kernels import pallas_ring_applicable
+
+    # the remote-DMA ring kernel gathers; it has no per-hop merge stage,
+    # so FNS_PALLAS_RING=1 must visibly decline here rather than hand
+    # back an (n*K, W) block where the caller expects (K, W)
+    assert not pallas_ring_applicable(win.ndim, n_shards, merged=True)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    acc = win
+    blk = win
+    for _ in range(1, n_shards):
+        blk = jax.lax.ppermute(blk, axis_name, perm)
+        acc = topk_merge_sorted(acc, blk)
+    return acc
+
+
 def _bits(x: jax.Array) -> jax.Array:
     """f32 -> i32 bit pattern (pack floats into the one exchange array)."""
     return jax.lax.bitcast_convert_type(x, jnp.int32)
@@ -529,7 +578,7 @@ def _tp_completions(
 def _tp_fog_arrivals(
     spec: WorldSpec, tp: TpCtx, state: WorldState, cache: LinkCache,
     buf_p: TickBuf, buf_r: TickBuf, m_part: Metrics, m_rep: Metrics,
-    t1: jax.Array, k_exchange: int,
+    t1: jax.Array, k_exchange: int, window_k: Optional[int],
 ):
     """TP rendition of the two-stage fog-arrival megaphase.
 
@@ -546,6 +595,21 @@ def _tp_fog_arrivals(
     valid rows sit in global candidate order (shard-major blocks of
     ascending local order = ascending global order), so every relative
     tie-break matches the reference window exactly.
+
+    ``window_k`` (a WINDOWED spec: ``spec.window < task_capacity``)
+    replaces that full gather with distributed top-K selection: every
+    candidate gets the engine's rotated scan-order position as an
+    explicit integer key (``pos`` below — the rank ``_compact`` would
+    assign it in the GLOBAL candidate list, tick-keyed rotation
+    included), each shard ``lax.top_k``-selects its best ``K`` rows,
+    and :func:`ring_topk_merge` folds the ``n`` shard windows into the
+    globally-best K with an O(K) per-hop payload.  Position keys are
+    globally unique, so the merged window is bit-identical to the
+    reference's ``_compact`` over the full candidate list — same rows,
+    same order, same tie-breaks — and window overflow defers exactly
+    like the single-device K-window (``n_deferred``; seating is decided
+    by ``pos <=`` the merged window's max key, which needs no second
+    collective).
 
     Tail (replicated): the reference assignment/FIFO logic verbatim on
     the assembled window — identical on every shard, which is what
@@ -620,9 +684,97 @@ def _tp_fog_arrivals(
     )
     cand_v = cand_v & ~fast_drop
 
-    # ---- exchange-window compaction ------------------------------------
     m_part = m_part.replace(n_deferred=m_part.n_deferred + n_left)
     n_set = jnp.sum(cand_v.astype(i32))
+
+    if window_k is not None:
+        # ---- distributed K-window selection (windowed spec) ------------
+        # Every candidate's GLOBAL scan-order position under the
+        # engine's windowed compaction, as an explicit integer key:
+        # ``_compact(cand_v_global, K, UR_g, rot)`` scans blocks in
+        # rotated order (rot_b first) and each block's columns from the
+        # decorrelated origin c0, so the rank it would assign global
+        # candidate g is exactly ``pos`` below — elementwise over the
+        # local block, no global materialization.  rot reproduces
+        # ``engine._rot_and_defer`` (modulus = GLOBAL task capacity;
+        # state.tick is replicated, so every shard keys identically).
+        K_w = window_k
+        UR_g = tp.n_users_global * R
+        C_g = _compact_lane_width(UR_g)
+        B_g = -(-UR_g // C_g)
+        maxpos = B_g * C_g
+        rot = (
+            (state.tick.astype(jnp.uint32) * jnp.uint32(2654435761))
+            % jnp.uint32(T_g)
+        ).astype(i32)
+        rot_b = rot % B_g
+        c0 = (
+            (rot.astype(jnp.uint32) * jnp.uint32(7919)) % jnp.uint32(C_g)
+        ).astype(i32)
+        g = jnp.arange(UR, dtype=i32) + tp.u_off * R
+        pos = ((g // C_g - rot_b) % B_g) * C_g + ((g % C_g - c0) % C_g)
+        # local best-K in ascending pos: top_k on the flipped key (valid
+        # keys >= 1; invalid rows sink to -1 and become sentinels)
+        k_loc = min(K_w, UR)
+        vals, sel = jax.lax.top_k(jnp.where(cand_v, maxpos - pos, -1), k_loc)
+        valid_w = vals > 0
+        win = jnp.stack(
+            [
+                jnp.where(valid_w, cand_slot_g[sel], T_g),
+                jnp.where(valid_w, cand_f[sel], 0),
+                _bits(jnp.where(valid_w, cand_t[sel], jnp.inf)),
+                _bits(jnp.where(valid_w, cand_m[sel], 0.0)),
+                jnp.where(valid_w, pos[sel], maxpos),
+            ],
+            axis=1,
+        )  # (k_loc, 5) i32 — the O(K) per-hop ring payload
+        if k_loc < K_w:
+            sent = jnp.stack(
+                [
+                    jnp.int32(T_g),
+                    jnp.int32(0),
+                    _bits(jnp.float32(jnp.inf)),
+                    _bits(jnp.float32(0.0)),
+                    jnp.int32(maxpos),
+                ]
+            )
+            win = jnp.concatenate(
+                [win, jnp.broadcast_to(sent, (K_w - k_loc, 5))]
+            )
+        with jax.named_scope("phase_tp_exchange"):
+            full = ring_topk_merge(win, tp.axis_name, tp.n_shards)
+        # window-overflow deferral, the engine's _rot_and_defer contract:
+        # the merged window holds the K globally-smallest pos keys, so a
+        # local candidate seats iff its pos <= the window's max valid
+        # key — summed over shards this books exactly
+        # max(n_set_global - K, 0), with no extra collective
+        w_max = jnp.max(jnp.where(full[:, 0] < T_g, full[:, 4], -1))
+        seat_mask = cand_v & (pos <= w_max)
+        seated = jnp.sum(seat_mask.astype(i32))
+        n_defer_exg = n_set - seated
+        m_part = m_part.replace(
+            n_deferred=m_part.n_deferred + n_defer_exg
+        )
+        exg = None
+        if telem_on:
+            f32_ = jnp.float32
+            waiting = cand_v & ~seat_mask
+            age_t = jnp.max(jnp.where(waiting, t1 - cand_t, -jnp.inf))
+            age_ticks = jnp.maximum(age_t / spec.dt, 0.0).astype(f32_)
+            exg = ExgStats(
+                occ=n_set.astype(f32_) / K_w,
+                util=seated.astype(f32_) / K_w,
+                age=jnp.where(jnp.any(waiting), age_ticks, 0.0),
+                cand=n_cand.astype(f32_),
+                defer=n_defer_exg.astype(f32_),
+                seated=seated,
+            )
+        return _tp_arrivals_tail(
+            spec, tp, state, cache, buf_p, buf_r, m_part, m_rep, t1,
+            tasks, fogs, full, exg, n_fast, n_fast_f, fog_alive,
+        )
+
+    # ---- exchange-window compaction (no-window regime) ----------------
     n_defer_exg = jnp.maximum(n_set - k_exchange, 0)
     m_part = m_part.replace(n_deferred=m_part.n_deferred + n_defer_exg)
     if k_exchange >= UR:
@@ -680,6 +832,35 @@ def _tp_fog_arrivals(
 
     with jax.named_scope("phase_tp_exchange"):
         full = ring_all_gather(packed, tp.axis_name, tp.n_shards)
+    return _tp_arrivals_tail(
+        spec, tp, state, cache, buf_p, buf_r, m_part, m_rep, t1,
+        tasks, fogs, full, exg, n_fast, n_fast_f, fog_alive,
+    )
+
+
+def _tp_arrivals_tail(
+    spec: WorldSpec, tp: TpCtx, state: WorldState, cache: LinkCache,
+    buf_p: TickBuf, buf_r: TickBuf, m_part: Metrics, m_rep: Metrics,
+    t1: jax.Array, tasks, fogs, full: jax.Array,
+    exg: Optional[ExgStats], n_fast: jax.Array, n_fast_f: jax.Array,
+    fog_alive: jax.Array,
+):
+    """Reference assignment/FIFO tail on the assembled exchange window.
+
+    Shared by both exchange regimes — ``full`` is either the
+    :func:`ring_all_gather` concatenation (no-window) or the
+    :func:`ring_topk_merge` K-window (windowed; its extra ``pos``
+    column rides along unread).  Identical on every shard, which is
+    what keeps the replicated fog/queue state coherent; every use of a
+    window column is masked by ``valid``, so invalid-row payloads
+    (sentinels here, garbage gathers in the reference) can never leak
+    into state.
+    """
+    F = spec.n_fogs
+    U, S = spec.n_users, spec.max_sends_per_user
+    T_loc = spec.task_capacity
+    T_g = tp.n_users_global * S
+    i32 = jnp.int32
     idx = full[:, 0]  # global ids, sentinel T_g
     valid = idx < T_g
     fog_g = full[:, 1]
@@ -821,7 +1002,7 @@ def _zero_buf(U: int, F: int) -> TickBuf:
 
 def _tp_tick(
     spec: WorldSpec, tp: TpCtx, state: WorldState, net: NetParams,
-    cache: LinkCache, k_exchange: int,
+    cache: LinkCache, k_exchange: int, window_k: Optional[int] = None,
 ) -> WorldState:
     """One sharded tick over the LOCAL world view.
 
@@ -939,7 +1120,7 @@ def _tp_tick(
     with jax.named_scope("phase_fog_arrivals"):
         state, buf_p, buf_r, m_part, m_rep, exg = _tp_fog_arrivals(
             spec, tp, state, cache, buf_p, buf_r, m_part, m_rep, t1,
-            k_exchange,
+            k_exchange, window_k,
         )
     if telem_on:
         _book("fog_arrivals", a0, _act(m_part, m_rep))
@@ -1073,7 +1254,7 @@ def _tp_tick(
 @functools.lru_cache(maxsize=32)
 def _tp_program(
     spec: WorldSpec, n_ticks: int, mesh: Mesh, axis_name: str,
-    k_exchange: int, donate: bool,
+    k_exchange: int, donate: bool, window_k: Optional[int] = None,
 ):
     """Build (and cache) the jitted sharded-horizon program for ``spec``."""
     n = mesh.shape[axis_name]
@@ -1137,7 +1318,11 @@ def _tp_program(
         )
 
         def tick(st, _):
-            return _tp_tick(spec_l, tp, st, net_l, cache_l, k_exchange), None
+            return (
+                _tp_tick(spec_l, tp, st, net_l, cache_l, k_exchange,
+                         window_k),
+                None,
+            )
 
         final, _ = jax.lax.scan(tick, state_l, None, length=n_ticks)
         if spec.derive_acks:
@@ -1234,6 +1419,15 @@ def run_tp_sharded(
     never defers, bit-exact vs the single-device engine); smaller
     windows defer overflow arrivals a tick, visible in
     ``Metrics.n_deferred`` exactly like the engine's K-window.
+
+    A WINDOWED spec (``arrival_window=K < task_capacity``) instead runs
+    the distributed K-window selection: the exchange ring merges shard
+    windows hop by hop (O(K) payload — :func:`ring_topk_merge`) into
+    exactly the window the single-device windowed engine compacts, so
+    results stay bit-exact vs ``run()`` on the same spec
+    (tests/test_tp.py), overflow defers with the engine's tick-keyed
+    rotation fairness, and ``exchange_window`` must stay ``None`` (the
+    spec's own K already bounds the exchange; a ``ValueError`` says so).
 
     ``donate=True`` donates the (sharded) input state's buffers to the
     run — the memory discipline of ``run_jit`` (simlint R6); do not
@@ -1356,7 +1550,26 @@ def _tp_setup(
     U_loc = spec.n_users // n
     R = min(spec.arrival_cands, spec.max_sends_per_user)
     cap = U_loc * R
-    k_ex = cap if exchange_window is None else max(1, min(exchange_window, cap))
+    if spec.window < spec.task_capacity:
+        # windowed spec: the spec's OWN global K-window bounds the
+        # exchange (distributed top-K over the ring — _tp_fog_arrivals);
+        # an exchange_window on top would change which candidates even
+        # reach the merge and break the bit-exact window contract
+        if exchange_window is not None:
+            raise ValueError(
+                "exchange_window tunes the no-window exchange ring; a "
+                f"windowed spec (arrival_window={spec.arrival_window}) "
+                "already bounds the hop-pruned exchange to its global "
+                "K-window — drop exchange_window or the arrival window"
+            )
+        window_k = spec.window
+        k_ex = cap
+    else:
+        window_k = None
+        k_ex = (
+            cap if exchange_window is None
+            else max(1, min(exchange_window, cap))
+        )
     ticks = spec.n_ticks if n_ticks is None else n_ticks
 
     if stamp:
@@ -1412,7 +1625,7 @@ def _tp_setup(
         from ..core.engine import _dealias_for_donation
 
         sharded = _dealias_for_donation(sharded)
-    go = _tp_program(spec, ticks, mesh, axis_name, k_ex, donate)
+    go = _tp_program(spec, ticks, mesh, axis_name, k_ex, donate, window_k)
     return go, (sharded, rep), net_r, cache_r, spec
 
 

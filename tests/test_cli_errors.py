@@ -151,6 +151,34 @@ def test_tp_window_requires_tp(capsys):
     assert "[CLI-TPWINDOW]" in capsys.readouterr().err
 
 
+def test_tp_runs_windowed_specs(capsys):
+    """--tp × a WINDOWED spec is a SUCCESS path since ISSUE 18: the
+    distributed K-window selection runs the arrival window over the
+    hop-pruned exchange ring (the former [TP-WINDOW] rejection is
+    gone)."""
+    rc = main(["--scenario", "smoke", "--tp", "8",
+               "--set", "scenario.arrival_window=4",
+               "--set", "scenario.horizon=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert '"tp_shards": 8' in captured.out
+    assert "Traceback" not in captured.err
+
+
+def test_tp_window_flag_conflicts_with_windowed_spec(capsys):
+    """--tp-window tunes the NO-WINDOW exchange ring; on a spec that
+    already carries its own arrival window the combination is a
+    one-line error, not a traceback."""
+    rc = main(["--scenario", "smoke", "--tp", "8", "--tp-window", "2",
+               "--set", "scenario.arrival_window=4",
+               "--set", "scenario.horizon=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "error:" in captured.err
+    assert "exchange_window" in captured.err
+    assert "Traceback" not in captured.err
+
+
 # ---- chaos CLI surface (ISSUE 12) ------------------------------------
 
 def test_unknown_chaos_profile_is_clear_error(capsys):

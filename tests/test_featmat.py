@@ -239,7 +239,8 @@ _TP_CASES = [
     ("[TP-POOL]", dict(fog_model=1)),  # FogModel.POOL
     ("[TP-POLICY]", dict(policy=1)),  # Policy.ROUND_ROBIN: task-dependent
     ("[TP-ARRIVALS]", dict(two_stage_arrivals=False)),
-    ("[TP-WINDOW]", dict(arrival_window=4)),
+    # [TP-WINDOW] deleted in ISSUE 18: windowed specs run the
+    # distributed K-window selection (hop-pruned top-K exchange ring)
     ("[TP-DYNTOPO]", dict(assume_static=False)),
     ("[TP-ENERGY]", dict(energy_enabled=True)),
     ("[TP-WIRED]", dict(wired_queue_enabled=True)),

@@ -90,7 +90,9 @@ def test_tp_gate_is_pinned():
     assert not tp_ok(
         _build(policy=int(Policy.LOCAL_FIRST), broker_mips=2048.0)[0]
     )
-    assert not tp_ok(dataclasses.replace(on, arrival_window=8))
+    # windowed compaction is TP-admitted since the distributed K-window
+    # selection (the hop-pruned exchange ring) landed
+    assert tp_ok(dataclasses.replace(on, arrival_window=8))
     assert not tp_ok(dataclasses.replace(on, two_stage_arrivals=False))
     assert not tp_ok(dataclasses.replace(on, assume_static=False))
     # telemetry composes, including the streaming latency histogram
@@ -198,6 +200,131 @@ def test_exchange_window_defers_not_drops(node_mesh):
     assert int(np.asarray(got.metrics.n_completed)) == int(
         np.asarray(ref.metrics.n_completed)
     )
+
+
+# ----------------------------------------------------------------------
+# distributed K-window selection (ISSUE 18): windowed specs on the TP
+# path via the hop-pruned top-K exchange ring
+# ----------------------------------------------------------------------
+
+def test_tp_window_bitexact_vs_reference(node_mesh):
+    """Windowed specs (arrival_window=K < task_capacity) run the
+    distributed top-K exchange and stay bit-exact vs the single-device
+    windowed engine — state-hash A/B over the three policy-family
+    worlds, carry donated.  K=4 overflows on the SMALL worlds
+    (n_deferred_max > 0 in the reference), so the tick-keyed rotation
+    and the merged window's deferral accounting are both on the hook."""
+    for kw in WORLDS:
+        spec, state, net, bounds = _build(arrival_window=4, **kw)
+        ref, _ = run(spec, state, net, bounds)
+        spec2, got = _tp(spec, state, net, bounds, node_mesh)
+        assert spec2 == spec
+        assert _hash(ref) == _hash(got), kw
+        assert len(got.tasks.stage.sharding.device_set) == 8
+        assert int(np.asarray(got.metrics.n_scheduled)) > 0
+
+
+def test_tp_window_padding_inert(node_mesh):
+    """Padding composes with the windowed exchange: the padded window
+    geometry (spec.window recomputed at the padded capacity) matches
+    the single-device reference at the padded spec — which IS the
+    windowed MIN_BUSY world's spec, sharing its cached program."""
+    spec, state, net, bounds = _build(n_users=13, arrival_window=4)
+    spec_p, state_p, net_p = pad_users_to_multiple(spec, state, net, 8)
+    ref, _ = run(spec_p, state_p, net_p, bounds)
+    spec2, got = _tp(spec, state, net, bounds, node_mesh)
+    assert spec2 == spec_p
+    assert _hash(ref) == _hash(got)
+
+
+def test_tp_window_rejects_exchange_window(node_mesh):
+    """exchange_window tunes the no-window ring only: a windowed spec
+    already bounds the exchange to its own global K, so combining the
+    two is a clear one-line error (no silent double-windowing)."""
+    spec, state, net, bounds = _build(arrival_window=4)
+    with pytest.raises(ValueError, match="exchange_window"):
+        run_tp_sharded(
+            spec, state, net, bounds, node_mesh, exchange_window=2
+        )
+
+
+@pytest.mark.slow  # adds run_jit/run_chunked compiles + a half-horizon
+#   TP program on the windowed spec: full-suite tier
+def test_tp_window_bitexact_vs_jit_and_chunked(node_mesh):
+    """The windowed TP tick also matches the donated run_jit and the
+    chunked runner, and a chained pair of half-horizon windowed TP
+    calls matches one full-horizon run (the donated-carry gate)."""
+    spec, state, net, bounds = _build(arrival_window=4)
+    _, got = _tp(spec, state, net, bounds, node_mesh)
+    jit_ref = run_jit(spec, jax.tree.map(jnp.copy, state), net, bounds)
+    assert _hash(jit_ref) == _hash(got)
+    chunk_ref = run_chunked(
+        spec, jax.tree.map(jnp.copy, state), net, bounds,
+        chunk_ticks=spec.n_ticks // 2,
+    )
+    assert _hash(chunk_ref) == _hash(got)
+    n = spec.n_ticks
+    assert n % 2 == 0
+    _, half = _tp(spec, state, net, bounds, node_mesh, n_ticks=n // 2)
+    _, full = _tp(spec, half, net, bounds, node_mesh, n_ticks=n // 2)
+    assert _hash(full) == _hash(got)
+
+
+@pytest.mark.slow  # its own (spec, window) program: full-suite tier
+def test_tp_window_sustained_overflow_defers_not_drops(node_mesh):
+    """Sustained exchange overflow under the merged path (ISSUE 18
+    satellite): every user publishes at t=0 and the global window K=2
+    is far below the steady-state candidate count, so the merge ring
+    truncates every tick.  The drop-OLDEST/defer rotation fairness
+    contract must hold exactly as on one device: arrivals defer
+    (observable in n_deferred/n_deferred_max), nothing is lost, and the
+    final state still bit-matches the single-device windowed engine."""
+    spec, state, net, bounds = _build(
+        start_time_max=0.0, horizon=0.15, arrival_window=2
+    )
+    ref, _ = run(spec, state, net, bounds)
+    _, got = _tp(spec, state, net, bounds, node_mesh)
+    assert _hash(ref) == _hash(got)
+    assert int(np.asarray(got.metrics.n_deferred_max)) > 0
+    assert int(np.asarray(got.metrics.n_scheduled)) == int(
+        np.asarray(ref.metrics.n_scheduled)
+    )
+    assert int(np.asarray(got.metrics.n_completed)) == int(
+        np.asarray(ref.metrics.n_completed)
+    )
+
+
+def test_ring_topk_merge_matches_full_gather(node_mesh):
+    """ring_topk_merge == best-K prefix of sorting the full gather, on
+    every shard (replication coherence), for unique keys with sentinel
+    padding — the distributed-selection contract, unit-scale."""
+    from fognetsimpp_tpu.parallel.taskshard import ring_topk_merge
+
+    n, K, W = 8, 5, 3
+    rng = np.random.default_rng(7)
+    keys = rng.permutation(n * K * 3)[: n * K].astype(np.int32)
+    x = np.stack(
+        [np.arange(n * K, dtype=np.int32), rng.integers(0, 99, n * K,
+                                                        dtype=np.int32),
+         keys], axis=1,
+    )
+    # per-shard blocks arrive locally sorted ascending on the key col
+    blocks = [b[np.argsort(b[:, -1], kind="stable")]
+              for b in x.reshape(n, K, W)]
+    xs = jnp.asarray(np.concatenate(blocks, axis=0))
+    f = jax.jit(
+        shard_map(
+            lambda b: ring_topk_merge(b, "node", n),
+            mesh=node_mesh,
+            in_specs=P("node"),
+            out_specs=P("node"),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(f(xs)).reshape(n, K, W)
+    want = x[np.argsort(x[:, -1], kind="stable")][:K]
+    for s in range(n):
+        np.testing.assert_array_equal(got[s], want)
 
 
 # --tp --telemetry composition (per-shard phase attribution, exchange

@@ -335,6 +335,39 @@ def test_serve_tp_overflow_pages_and_postmortem_names_the_shard(
     assert "diverging shard(s)" in text and "3" in text
 
 
+def test_serve_tp_window_overflow_pages(node_mesh, tmp_path):
+    """The defer-rate watchdog floor pages under the WINDOWED exchange
+    too (ISSUE 18 satellite): a global arrival_window=2 with every user
+    publishing every tick keeps the hop-pruned merge ring truncating
+    from t=0, the deferral books into the same n_deferred /
+    exchange-plane gauges, and the floor fires exactly like the
+    exchange_window overflow world above."""
+    from fognetsimpp_tpu.telemetry.live import serve_tp_run
+
+    spec, state, net, bounds = _build(
+        send_interval=0.001, start_time_max=0.0, horizon=0.15,
+        telemetry=True, arrival_window=2,
+    )
+    spec2, final, status = serve_tp_run(
+        spec, state, net, bounds, node_mesh,
+        chunk_ticks=30,
+        port=0,
+        dump_dir=str(tmp_path / "pm"),
+    )
+    status["server"].close()
+    # sustained window overflow really deferred, observably
+    assert int(np.asarray(final.metrics.n_deferred_max)) > 0
+    ex = exchange_summary(spec2, final)
+    assert ex["defer_sum"].sum() > 0
+    assert ex["age_max_ticks"].max() > 0
+    # ...and the defer-rate floor paged (kind='floor')
+    fired = [
+        a for a in status["watchdog"].anomalies
+        if a["signal"] == "defer_rate"
+    ]
+    assert fired and any(a.get("kind") == "floor" for a in fired)
+
+
 def test_postmortem_tolerates_pre_issue6_bundles(tmp_path, capsys):
     """A minimal old-style bundle (no compile_cache, no watchdog, ring
     entries without hashes) summarizes without crashing."""

@@ -55,7 +55,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 SHAPE_FIELDS = (
     "metric", "backend", "n_users", "n_fogs", "dt", "arrival_window",
     "policy", "n_devices", "n_replicas", "tp_shards", "chaos",
-    "n_brokers",
+    "n_brokers", "tp_window",
 )
 
 #: Shape values a capture that predates the field is known to have run
@@ -81,6 +81,11 @@ SHAPE_DEFAULTS = {
     # federation rows (bench.py --hier records n_brokers) ratchet as
     # their own trajectories.
     "n_brokers": None,
+    # windowed TP (ISSUE 18: distributed K-window selection) — every
+    # prior TP capture ran the no-window exchange ring; backfill None
+    # so windowed rows (bench.py BENCH_TP_ARRIVAL_WINDOW records
+    # tp_window) ratchet as their own trajectories.
+    "tp_window": None,
 }
 
 
@@ -131,6 +136,11 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     "journey_overhead": parsed.get("journey_overhead"),
                     # digital-twin doors (ISSUE 17, bench.py --twin):
                     # pre-twin captures backfill None via .get
+                    # per-hop TP exchange-ring payload (ISSUE 18):
+                    # pre-windowed captures backfill None via .get
+                    "exchange_payload_bytes": parsed.get(
+                        "exchange_payload_bytes"
+                    ),
                     "ingest_rate": parsed.get("ingest_rate"),
                     "whatif_latency_s": parsed.get("whatif_latency_s"),
                     "whatif_compile_events": parsed.get(
@@ -147,7 +157,7 @@ def _shape_str(shape: Tuple) -> str:
     d = dict(shape)
     bits = [str(d.get("metric") or "?"), str(d.get("backend") or "?")]
     for k in ("n_users", "n_fogs", "dt", "arrival_window", "n_devices",
-              "tp_shards", "chaos", "n_brokers"):
+              "tp_shards", "chaos", "n_brokers", "tp_window"):
         if d.get(k) is not None:
             bits.append(f"{k}={d[k]}")
     return " ".join(bits)
@@ -215,6 +225,31 @@ def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
                     "recompiling instead of reusing the live session's "
                     "program (compile_stats delta must be 0)"
                 )
+    # per-hop exchange-payload ratchet (ISSUE 18): at a fixed shape the
+    # ring payload is a program property, not a measurement — the
+    # latest capture may never carry MORE bytes per hop than the best
+    # (lowest) prior round at the same shape (no tolerance)
+    for shape, traj in trajectories(rows).items():
+        seq = [
+            r for r in traj
+            if r.get("exchange_payload_bytes") is not None
+        ]
+        if len(seq) < 2:
+            continue
+        latest = seq[-1]
+        best_prior = min(
+            seq[:-1], key=lambda r: float(r["exchange_payload_bytes"])
+        )
+        if (float(latest["exchange_payload_bytes"])
+                > float(best_prior["exchange_payload_bytes"])):
+            problems.append(
+                f"{latest['file']}: per-hop exchange payload "
+                f"{float(latest['exchange_payload_bytes']):.0f} B grew "
+                f"vs best prior "
+                f"{float(best_prior['exchange_payload_bytes']):.0f} B "
+                f"({best_prior['file']}) at shape [{_shape_str(shape)}] "
+                "— the exchange ring widened at an unchanged shape"
+            )
     # lower-is-better ratchet on reconfig_s per shape
     for shape, traj in trajectories(rows).items():
         seq = [r for r in traj if r.get("reconfig_s") is not None]
@@ -300,6 +335,11 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                 rcs += (
                     f", whatif {r['whatif_latency_s']:.3f}s"
                     if r.get("whatif_latency_s") is not None
+                    else ""
+                )
+                rcs += (
+                    f", payload {int(r['exchange_payload_bytes']):,}B/hop"
+                    if r.get("exchange_payload_bytes") is not None
                     else ""
                 )
                 out.append(

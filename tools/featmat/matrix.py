@@ -98,7 +98,8 @@ CELLS: Tuple[Cell, ...] = (
     _a("series", "fleet",
        "test:test_fleet_series_chunked_matches_straight_recording"),
     _a("window", "run", "variant:tick_window"),
-    _r("window", "tp", "TP-WINDOW"),
+    _a("window", "tp", "variant:tp_tick_window",
+       "test:test_tp_window_bitexact_vs_reference"),
     _u("window", "fleet"),
     _a("dyntopo", "run", "test:assume_static=False"),
     _r("dyntopo", "tp", "TP-DYNTOPO"),

@@ -501,6 +501,16 @@ def variants() -> List[Variant]:
             sharded=True,
             declared_collectives=None,  # resolved lazily from taskshard.py
         ),
+        Variant(
+            "tp_tick_window",
+            "the TP sharded tick at a WINDOWED spec (ISSUE 18: "
+            "distributed K-window selection — per-shard top-K then the "
+            "hop-pruned lax.ppermute merge ring carries an O(K) packed "
+            "payload instead of the full candidate gather)",
+            lambda: _compile_tp_tick(arrival_window=4),
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from taskshard.py
+        ),
     ]
 
 
@@ -513,7 +523,7 @@ def declared_for(v: Variant) -> Optional[Dict[str, Set[str]]]:
         return _fleet_declared()
     if v.name == "tp_dryrun":
         return _tp_declared()
-    if v.name in ("tp_tick", "tp_tick_telemetry"):
+    if v.name in ("tp_tick", "tp_tick_telemetry", "tp_tick_window"):
         from fognetsimpp_tpu.parallel.taskshard import (
             DECLARED_COLLECTIVES as tp_tick_declared,
         )

@@ -33,6 +33,7 @@ import argparse
 import dataclasses
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -159,7 +160,27 @@ def compile_dyn_counts() -> dict:
     return entry_op_counts(text)
 
 
-def compile_tp_counts(telemetry: bool = False) -> dict:
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8}
+_SHAPE_RE = re.compile(r"(pred|bf16|[suf]\d+)\[([\d,]*)\]")
+
+
+def _result_bytes(result: str) -> int:
+    """Bytes of an HLO result type's (first) array shape — for an async
+    start's tuple result the first element is the payload buffer."""
+    m = _SHAPE_RE.search(result)
+    if not m:
+        return 0
+    dtype, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d.strip():
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def compile_tp_counts(telemetry: bool = False, window: bool = False) -> dict:
     """Compile the shard_map'd TP sharded tick and count its HLO ops +
     collectives (ISSUE 9).
 
@@ -175,6 +196,12 @@ def compile_tp_counts(telemetry: bool = False) -> dict:
     histogram i32 fold and the exchange/latency f32 fold — get their
     own exactly-pinned count, while the telemetry-OFF tick must keep
     the PR 8 count unchanged.
+
+    ``window=True`` compiles the ISSUE 18 WINDOWED tick
+    (``arrival_window=4``): the hop-pruned top-K merge ring.  Its
+    ``ppermute_payload_bytes`` pin is the O(K) proof — every
+    collective-permute hop must carry exactly the packed (K, 5) i32
+    window (K*5*4 bytes), never the full candidate gather.
     """
     from tools.hloaudit.hlo import (
         COLLECTIVE_OPS,
@@ -183,7 +210,9 @@ def compile_tp_counts(telemetry: bool = False) -> dict:
     )
     from tools.hloaudit.variants import _compile_tp_tick
 
-    if telemetry:
+    if window:
+        text = _compile_tp_tick(arrival_window=4).text
+    elif telemetry:
         text = _compile_tp_tick(
             telemetry=True, telemetry_hist=True, derive_acks=False
         ).text
@@ -192,15 +221,21 @@ def compile_tp_counts(telemetry: bool = False) -> dict:
     mod = parse_hlo(text)
     counts = mod.entry_op_counts()
     colls: dict = {}
+    payloads: set = set()
     for i in mod.all_instructions():
         op = base_collective(i.opcode)
         if op in COLLECTIVE_OPS and not i.opcode.endswith("-done"):
             colls[op] = colls.get(op, 0) + 1
+            if op == "collective-permute":
+                payloads.add(_result_bytes(i.result))
     return {
         "ops": counts["ops"],
         "fusions": counts["fusions"],
         "collectives": dict(sorted(colls.items())),
         "collective_count": sum(colls.values()),
+        # distinct per-hop collective-permute payload sizes (bytes);
+        # pinned EXACTLY by --check
+        "ppermute_payload_bytes": sorted(payloads),
     }
 
 
@@ -227,9 +262,10 @@ def measure(
     journey_counts = compile_journeys_counts() if journeys else None
     out_tp = {}
     if tp:
-        for key, telem in (("tp_tick", False),
-                           ("tp_tick_telemetry", True)):
-            t = compile_tp_counts(telemetry=telem)
+        for key, kw in (("tp_tick", {}),
+                        ("tp_tick_telemetry", dict(telemetry=True)),
+                        ("tp_tick_window", dict(window=True))):
+            t = compile_tp_counts(**kw)
             out_tp[key] = {
                 **t,
                 "max_ops": int(t["ops"] * COUNT_SLACK),
@@ -330,8 +366,9 @@ def check(measured: dict, budget: dict) -> list:
                     f"{vname} {k} regressed: {tc[k]} > "
                     f"budget {btc[cap_key]}"
                 )
-    # --- the TP sharded ticks (ISSUE 9; telemetry-on since ISSUE 11) ---
-    for key in ("tp_tick", "tp_tick_telemetry"):
+    # --- the TP sharded ticks (ISSUE 9; telemetry-on since ISSUE 11;
+    # windowed hop-pruned exchange since ISSUE 18) ---
+    for key in ("tp_tick", "tp_tick_telemetry", "tp_tick_window"):
         tp = measured.get(key)
         btp = budget.get(key)
         if tp is None:
@@ -355,6 +392,18 @@ def check(measured: dict, budget: dict) -> list:
                 f"{tp['collectives']} != pinned {btp['collectives']} "
                 "— a collective change must land with its "
                 "DECLARED_COLLECTIVES entry and a reviewed --write"
+            )
+        # exact payload pin: for tp_tick_window this is the O(K)
+        # proof — each ppermute hop carries the packed (K, 5) i32
+        # window, never the full candidate gather
+        bpay = btp.get("ppermute_payload_bytes")
+        if (bpay is not None
+                and tp.get("ppermute_payload_bytes") != bpay):
+            errs.append(
+                f"{key} per-hop ppermute payload drifted: "
+                f"{tp.get('ppermute_payload_bytes')} != pinned {bpay} "
+                "bytes — the exchange ring stopped carrying its "
+                "pinned per-hop payload"
             )
     return errs
 
