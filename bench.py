@@ -46,7 +46,10 @@ quotes.  ``BENCH_JOURNEYS=1`` (ISSUE 15) additionally runs an
 interleaved journeys-off/on A/B over telemetry-on twins of the bench
 world (``BENCH_JOURNEYS_N`` sampled tasks, default 16) and records the
 ``journey_overhead`` ratio tools/bench_trend.py gates at the
-established <= 1.10 bar.  ``python bench.py --profile`` (or ``BENCH_PROFILE=<dir>``)
+established <= 1.10 bar.  ``BENCH_TP_JOURNEYS=1`` (ISSUE 19) runs the
+same interleaved off/on A/B under ``--tp`` (``BENCH_TP_JOURNEYS_N``
+sampled tasks, default 16) and records ``tp_journey_overhead``, gated
+at the same bar.  ``python bench.py --profile`` (or ``BENCH_PROFILE=<dir>``)
 wraps the timed section in ``jax.profiler.trace`` (engine phases appear
 as named scopes) and appends a per-call dispatch-latency histogram plus
 the cold-compile time to the JSON line.
@@ -537,7 +540,7 @@ def tp_measurement(n_devices=None) -> dict:
     # switches the exchange to the hop-pruned top-K merge ring
     arrival_k = _env_int("BENCH_TP_ARRIVAL_WINDOW", 0)
 
-    def build(telemetry=False):
+    def build(telemetry=False, journeys=0):
         return smoke.build(
             n_users=n_users,
             n_fogs=n_fogs,
@@ -551,6 +554,7 @@ def tp_measurement(n_devices=None) -> dict:
             start_time_max=min(0.05, horizon / 4),
             derive_acks=True,
             telemetry=telemetry,
+            **({"telemetry_journeys": journeys} if journeys > 0 else {}),
             **({"arrival_window": arrival_k} if arrival_k > 0 else {}),
         )
 
@@ -638,10 +642,46 @@ def tp_measurement(n_devices=None) -> dict:
             "telemetry_ab_reps": n_ab,
         }
 
+    jour_fields = {}
+    if os.environ.get("BENCH_TP_JOURNEYS", "") not in ("", "0"):
+        # interleaved journeys off/on A/B over telemetry-on twins
+        # (ISSUE 19): the measured TP journey-ring overhead — the
+        # shard-local snapshot diff + ring scatter inside the sharded
+        # tick — quoted by BENCHMARKS.md and gated by
+        # tools/bench_trend.py (<= OVERHEAD_BAR, the BENCH_JOURNEYS
+        # methodology).  One untimed journeys-on run eats the compile.
+        J = _env_int("BENCH_TP_JOURNEYS_N", 16)
+        sp, st, nt, bd = build(telemetry=True, journeys=J)
+        run_tp_sharded(
+            sp, st, nt, bd, mesh, exchange_window=window, donate=True
+        )
+        n_ab = max(3, n_reps)
+        w_off, w_on = [], []
+        for _rep in range(n_ab):
+            for j, sink in ((0, w_off), (J, w_on)):
+                sp, st, nt, bd = build(telemetry=True, journeys=j)
+                t0 = time.perf_counter()
+                _, f = run_tp_sharded(
+                    sp, st, nt, bd, mesh, exchange_window=window,
+                    donate=True,
+                )
+                jax.block_until_ready(f.metrics.n_scheduled)
+                sink.append(time.perf_counter() - t0)
+        off_med = float(np.median(w_off))
+        on_med = float(np.median(w_on))
+        jour_fields = {
+            "tp_journey_overhead": round(on_med / max(off_med, 1e-9), 4),
+            "tp_journey_off_wall_s": round(off_med, 4),
+            "tp_journey_on_wall_s": round(on_med, 4),
+            "tp_journey_sampled": J,
+            "tp_journey_ab_reps": n_ab,
+        }
+
     return {
         "metric": "tp_task_offload_decisions_per_sec",
         "value": round(decisions / wall, 1),
         **telem_fields,
+        **jour_fields,
         "unit": "decisions/s",
         "backend": backend,
         "n_devices": D,

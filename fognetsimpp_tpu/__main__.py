@@ -439,11 +439,9 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 2
-        if args.tp is not None:
-            # the [TP-JOURNEYS] gate's cell, keyed on the gate's ID
-            ap.error("[TP-JOURNEYS] --journeys traces single-world "
-                     "event rings; the TP sharded tick does not carry "
-                     "them yet — run journey worlds without --tp")
+        # --journeys --tp composes since ISSUE 19: the sharded tick
+        # carries shard-local rings (parallel/taskshard.py) and the
+        # run path below stitches/decodes them like any journey run
 
     text = ""
     if args.config:

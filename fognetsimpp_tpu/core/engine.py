@@ -176,16 +176,9 @@ def tp_reject_reason(spec: WorldSpec) -> Optional[str]:
         # same subsystem-first ordering as chaos: ONE message source
         # (hier/federation.hier_reject_reason) shared with the fleet gate
         return hier_reject_reason(spec, "TP")
-    if spec.journey_active:
-        # journeys ride the single-device tap (the fleet vmap carries
-        # them; the sharded tick would need shard-local rings with a
-        # per-shard ownership fold — the chaos/hier follow-up pattern)
-        return (
-            "[TP-JOURNEYS] TP tick does not carry the task-journey event "
-            "rings yet (shard-local rings need a per-shard ownership "
-            "fold); run journey worlds on single-device run/run_jit/"
-            "run_chunked or the fleet runner"
-        )
+    # journeys (spec.journey_active) run INSIDE the sharded tick since
+    # ISSUE 19: shard-local rings over the owned row block, scalar drop
+    # census in the end-of-tick psum (parallel/taskshard.py)
     if spec.fog_model != int(FogModel.FIFO):
         return (
             "[TP-POOL] TP tick covers FIFO fogs only (POOL pools are "

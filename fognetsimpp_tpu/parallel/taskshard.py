@@ -67,6 +67,7 @@ task memory = T / n_devices, paying the arrival exchange per tick).
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Callable, NamedTuple, Optional, Tuple
@@ -112,6 +113,7 @@ from ..ops.queues import (
 from ..spec import WorldSpec
 from ..state import Metrics, NodeState, TaskState, UserState, WorldState
 from ..telemetry.health import latency_hist_delta
+from ..telemetry.journeys import journey_tick_tp
 from ..telemetry.metrics import (
     PHASE_INDEX,
     PHASES,
@@ -295,9 +297,9 @@ def pad_users_to_multiple(
     # stay UNUSED forever so the per-tick diff can never fire on them.
     # dynspec.bucket_spec relies on this — a bucketed journey world
     # keeps its original sample (tests/test_journeys.py pins it).  The
-    # TP runner itself still gates journeys off (tp_reject_reason):
-    # shard-local rings need a per-shard ownership fold, the chaos/hier
-    # follow-up pattern.
+    # TP runner tiles these J-sized leaves per shard (_tp_setup) and
+    # each shard diffs only its owned slots (journey_tick_tp), so the
+    # padded sample shards exactly like the unpadded one.
     _ = f32  # (dtype alias kept for symmetry with init_state)
     return spec2, state2, net2
 
@@ -1022,6 +1024,7 @@ def _tp_tick(
     U, F = spec.n_users, spec.n_fogs
     telem_on = spec.telemetry
     hist_on = spec.telemetry and spec.telemetry_hist
+    jour_on = spec.journey_active
 
     m_carry = state.metrics
     m_rep = _zero_metrics(m_carry)
@@ -1139,10 +1142,26 @@ def _tp_tick(
             telem=state.telem.replace(lat_seen=seen)
         )
 
+    # 7c. journey rings (spec.journey_active under TP, ISSUE 19):
+    # shard-local diff over the owned sampled slots with GLOBAL slot
+    # ids (journeys.journey_tick_tp); non-owned slots hold their
+    # previous snapshot, so their rings never advance.  Only the scalar
+    # drop-oldest census crosses shards — it rides the end-of-tick
+    # psum below; the rings themselves stay shard-local until
+    # run_tp_sharded stitches them by owner.
+    j_over = None
+    if jour_on:
+        with jax.named_scope("phase_journeys"):
+            telem_j, j_over = journey_tick_tp(
+                spec, state.telem, state.tasks, t1, tp.t_off
+            )
+        state = state.replace(telem=telem_j)
+
     # 8. THE end-of-tick combine: every shard-partial scalar in one psum
     part_vec = jnp.stack(
         [getattr(m_part, f) for f in _METRIC_FIELDS]
         + [buf_p.tx_b, buf_p.rx_b]
+        + ([j_over] if jour_on else [])
     )
     tot = jax.lax.psum(part_vec, tp.axis_name)
     delta = {
@@ -1160,6 +1179,15 @@ def _tp_tick(
     metrics = Metrics(**vals)
     tx_b = tot[len(_METRIC_FIELDS)] + buf_r.tx_b
     rx_b = tot[len(_METRIC_FIELDS) + 1] + buf_r.rx_b
+    if jour_on:
+        # the psum'd drop-oldest census is identical on every shard, so
+        # the replicated j_dropped scalar stays replicated
+        state = state.replace(
+            telem=state.telem.replace(
+                j_dropped=state.telem.j_dropped
+                + tot[len(_METRIC_FIELDS) + 2]
+            )
+        )
 
     # per-node message counters: user segment shard-local, the rest
     # replicated totals (identical on every shard by construction)
@@ -1263,8 +1291,9 @@ def _tp_program(
     T_loc = U_loc * S
     spec_l = dataclasses.replace(spec, n_users=U_loc)
     hist_on = spec.telemetry and spec.telemetry_hist
+    jour_on = spec.journey_active
 
-    def run_shard(users, tasks, nodes_u, lat_seen, rep, net, cache):
+    def run_shard(users, tasks, nodes_u, lat_seen, jour, rep, net, cache):
         shard = jax.lax.axis_index(axis_name)
         u_off = shard * U_loc
         tp = TpCtx(
@@ -1309,6 +1338,14 @@ def _tp_program(
             # tree (each task row has exactly one owner); the rest of
             # the telemetry state stays replicated
             telem_l = telem_l.replace(lat_seen=lat_seen)
+        if jour_on:
+            # each shard carries a FULL copy of the journey sample
+            # (global slot ids) in the sharded tree; only the owner's
+            # copy of a slot ever diffs (journeys.journey_tick_tp)
+            telem_l = telem_l.replace(
+                j_task=jour[0], j_prev=jour[1],
+                j_ring=jour[2], j_cursor=jour[3],
+            )
         state_l = WorldState(
             t=rep["t"], tick=rep["tick"], key=rep["key"],
             nodes=nodes_l, users=users, fogs=rep["fogs"],
@@ -1334,6 +1371,20 @@ def _tp_program(
             telem_out = telem_out.replace(
                 lat_seen=jnp.zeros((0,), jnp.int8)
             )
+        jour_out = None
+        if jour_on:
+            jour_out = (
+                telem_out.j_task, telem_out.j_prev,
+                telem_out.j_ring, telem_out.j_cursor,
+            )
+            telem_out = telem_out.replace(
+                j_task=jnp.zeros((0,), jnp.int32),
+                j_prev=jnp.zeros((0,) + telem_out.j_prev.shape[1:],
+                                 jnp.int32),
+                j_ring=jnp.zeros((0,) + telem_out.j_ring.shape[1:],
+                                 jnp.int32),
+                j_cursor=jnp.zeros((0,), jnp.int32),
+            )
         rep_out = {
             "t": final.t, "tick": final.tick, "key": final.key,
             "fogs": final.fogs, "broker": final.broker,
@@ -1343,27 +1394,38 @@ def _tp_program(
             "nodes_rest": jax.tree.map(lambda x: x[U_loc:], final.nodes),
         }
         nodes_u_out = jax.tree.map(lambda x: x[:U_loc], final.nodes)
-        return final.users, final.tasks, nodes_u_out, lat_seen_out, rep_out
+        return (final.users, final.tasks, nodes_u_out, lat_seen_out,
+                jour_out, rep_out)
 
-    # check_vma=False on both variants: outputs mix sharded task rows
+    # check_vma=False on every variant: outputs mix sharded task rows
     # and replicated fog/broker state; the fog-side replication
     # invariant is by construction (every shard runs the identical tail
-    # on the identical exchanged window), not statically provable
-    if hist_on:
-        def body(users, tasks, nodes_u, lat_seen, rep, net, cache):
-            return run_shard(users, tasks, nodes_u, lat_seen, rep, net,
-                             cache)
+    # on the identical exchanged window), not statically provable.
+    # The sharded positional args grow with the optional planes
+    # (lat_seen under telemetry_hist, the journey-leaf tuple under
+    # journey_active) — a plane that is OFF contributes no argument, so
+    # its variants trace to exactly the established program.
+    k_sh = 3 + int(hist_on) + int(jour_on)
 
-        in_specs = (P(axis_name),) * 4 + (P(), P(), P())
-        out_specs = (P(axis_name),) * 4 + (P(),)
-    else:
-        def body(users, tasks, nodes_u, rep, net, cache):
-            u, t, nu, _, r = run_shard(users, tasks, nodes_u, None, rep,
-                                       net, cache)
-            return u, t, nu, r
+    def body(*args):
+        users, tasks, nodes_u = args[:3]
+        rest = list(args[3:k_sh])
+        rep, net, cache = args[k_sh:]
+        lat_seen = rest.pop(0) if hist_on else None
+        jour = rest.pop(0) if jour_on else None
+        u, t, nu, ls, jo, r = run_shard(
+            users, tasks, nodes_u, lat_seen, jour, rep, net, cache
+        )
+        out = [u, t, nu]
+        if hist_on:
+            out.append(ls)
+        if jour_on:
+            out.append(jo)
+        out.append(r)
+        return tuple(out)
 
-        in_specs = (P(axis_name),) * 3 + (P(), P(), P())
-        out_specs = (P(axis_name),) * 3 + (P(),)
+    in_specs = (P(axis_name),) * k_sh + (P(), P(), P())
+    out_specs = (P(axis_name),) * k_sh + (P(),)
 
     shmapped = shard_map(
         body,
@@ -1386,6 +1448,50 @@ def _tp_program(
         return shmapped(*sharded, rep, net, cache)
 
     return go
+
+
+@contextlib.contextmanager
+def _donation_safe_compile(donate: bool):
+    """Bypass the persistent compilation cache while compiling a
+    DONATED TP program.
+
+    jaxlib 0.4.36's CPU executable serialization drops the
+    input-output donation aliasing on the way back in: a TP program
+    DESERIALIZED from ``jax_compilation_cache_dir`` silently corrupts
+    its donated carry when re-invoked (whole-state nondeterministic
+    divergence — reproduced on the chunked runner, where chunk N+1
+    consumes chunk N's donated output; a cold-compiled executable of
+    the same program is bit-exact).  Donated TP programs therefore
+    always compile fresh: the in-memory jit cache still dedups within
+    the process, only the cross-process executable reuse is given up.
+    Non-donated programs keep the persistent cache — they never alias.
+
+    Toggling ``jax_compilation_cache_dir`` alone is NOT enough: jax
+    memoizes the is-the-cache-usable decision once per process
+    (``compilation_cache.is_cache_used``) and initializes the
+    module-global cache object at most once, so a mid-process config
+    flip is silently ignored.  ``reset_cache()`` is the documented way
+    to drop that memoized state — we reset on entry (so the compile
+    under the guard re-evaluates the now-None dir) and again on exit
+    (so later non-donated compiles re-attach the restored dir).
+    """
+    cache_dir = jax.config.jax_compilation_cache_dir
+    if not donate or not cache_dir:
+        yield
+        return
+    try:
+        from jax._src import compilation_cache as _cc
+        _reset = _cc.reset_cache
+    except Exception:  # future-jax drift: fail open, keep the cache
+        yield
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset()
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _reset()
 
 
 def run_tp_sharded(
@@ -1445,13 +1551,28 @@ def run_tp_sharded(
         spec, state, net, mesh, n_ticks, axis_name, exchange_window,
         donate, pad, stamp,
     )
-    out = go(*parts, net_r, cache_r)
+    with _donation_safe_compile(donate):
+        out = go(*parts, net_r, cache_r)
+    users, tasks, nodes_u_f, rep = out[0], out[1], out[2], out[-1]
+    telem = rep["telem"]
+    i = 3
     if spec.telemetry and spec.telemetry_hist:
-        users, tasks, nodes_u_f, lat_seen, rep = out
-        telem = rep["telem"].replace(lat_seen=lat_seen)
-    else:
-        users, tasks, nodes_u_f, rep = out
-        telem = rep["telem"]
+        telem = telem.replace(lat_seen=out[i])
+        i += 1
+    if spec.journey_active:
+        # stitch the per-shard ring copies by owner: shard s's block is
+        # authoritative exactly for the slots whose global task row
+        # falls in its [s*T_loc, (s+1)*T_loc) range — everyone else's
+        # copy of that slot never advanced (journeys.journey_tick_tp)
+        jt, jp, jr, jc = out[i]
+        n_sh = mesh.shape[axis_name]  # _tp_setup required the mesh
+        J = jt.shape[0] // n_sh  # leaf-derived: padding may grow the
+        t_loc = spec.task_capacity // n_sh  # spec's clamped slot count
+        ids = jt[:J]  # the replicated sample: identical in every block
+        idx = (ids // t_loc) * J + jnp.arange(J, dtype=ids.dtype)
+        telem = telem.replace(
+            j_task=ids, j_prev=jp[idx], j_ring=jr[idx], j_cursor=jc[idx]
+        )
     nodes = jax.tree.map(
         lambda a, b: jnp.concatenate([a, b], axis=0),
         nodes_u_f, rep["nodes_rest"],
@@ -1603,8 +1724,29 @@ def _tp_setup(
         # the per-task exactly-once flag rides the sharded tree; the
         # replicated telemetry copy carries a zero-row stand-in
         sharded.append(rows(state.telem.lat_seen))
-        telem_rep = state.telem.replace(
+        telem_rep = telem_rep.replace(
             lat_seen=jnp.zeros((0,), jnp.int8)
+        )
+    if spec.journey_active:
+        # journey leaves ride the sharded tree TILED n× — every shard
+        # gets a full copy of the sample (global slot ids), diffs only
+        # its owned slots, and run_tp_sharded stitches the blocks back
+        # by owner.  O(n·J·R) rows total: the sample is tiny by design
+        # (J ≤ telemetry_journeys), so the tiling never dominates.
+        tl = state.telem
+
+        def tile(x):
+            return jnp.tile(x, (n,) + (1,) * (x.ndim - 1))
+
+        sharded.append(tuple(
+            rows(tile(x))
+            for x in (tl.j_task, tl.j_prev, tl.j_ring, tl.j_cursor)
+        ))
+        telem_rep = telem_rep.replace(
+            j_task=jnp.zeros((0,), jnp.int32),
+            j_prev=jnp.zeros((0,) + tl.j_prev.shape[1:], jnp.int32),
+            j_ring=jnp.zeros((0,) + tl.j_ring.shape[1:], jnp.int32),
+            j_cursor=jnp.zeros((0,), jnp.int32),
         )
     sharded = tuple(sharded)
     rep = replicated(

@@ -29,7 +29,13 @@ This module is the journey plane that fixes it:
   sampled task's packed row against the previous tick's snapshot
   (``j_prev``) and appends one packed ``(t_bits, code, a, b)`` row per
   lifecycle edge — spawn, chaos re-offload, broker→broker migration
-  hop, broker decide, fog enqueue, service start and every terminal.
+  hop, broker decide, per-tick matured-but-unseated defer (the
+  K-window / exchange-ring wait, ISSUE 19), fog enqueue, service start
+  and every terminal.  Under TP the same diff runs shard-local inside
+  the sharded tick (:func:`journey_tick_tp`): each shard owns the
+  sampled slots falling in its row block, rings stitch back in global
+  slot order, and only the scalar drop census rides the end-of-tick
+  psum.
   Event times are the EXACT event-time columns of the task table
   (f32 bit patterns via ``bitcast_convert_type``), not tick-quantised;
   the per-tick diff only controls when an edge is *observed*, exactly
@@ -105,6 +111,9 @@ class JourneyEvent(enum.IntEnum):
     CRASH_LOST = 13    # terminal: LOSE-mode crash, a=crashed fog
     RETRY_EXHAUST = 14  # terminal: a=crashed fog, b=retry count
     HOP_EXHAUSTED = 15  # terminal: a=broker, b=hop count
+    DEFER = 16         # matured but unseated this tick (K-window / per-
+    #                    user-cap / exchange-ring overflow): a=broker
+    #                    (publish wait, b=0) or fog (arrival wait, b=1)
 
 
 EVENT_NAMES: Dict[int, str] = {
@@ -251,9 +260,21 @@ def journey_edges(xp, prev, cur, users, sends, t1_bits):
     ``jnp``, the host replay passes ``numpy`` — so device and host can
     never drift (the bit-match test's backbone).  ``prev``/``cur`` are
     ``(J, len(J_COLS))`` i32; returns five ``(J, E)`` arrays
-    ``(valid, code, t_bits, a, b)`` with the E=8 candidate slots in
-    canonical causal order: spawn, re-offload, migrate, decide, local,
-    enqueue, service start, terminal.
+    ``(valid, code, t_bits, a, b)`` with the E=9 candidate slots in
+    canonical causal order: spawn, re-offload, migrate, decide, defer,
+    local, enqueue, service start, terminal.
+
+    The DEFER edge is the exchange-plane mark (ISSUE 19): a task still
+    waiting at end of tick — matured (``t_at_broker <= t1`` while
+    ``PUB_INFLIGHT``, ``t_at_fog <= t1`` while ``TASK_INFLIGHT``) but
+    unseated by the K-window / per-user cap / exchange ring — books one
+    DEFER per waiting tick, stamped at the observing tick's end (the
+    crash-edge convention).  A pure function of the end-of-tick
+    snapshot, so the single-device windowed engine and the TP exchange
+    ring book it identically by construction (their end-of-tick states
+    bit-match).  The i32 bit compare is exact: every time column is a
+    non-negative f32 whose bit pattern preserves order, and the +inf
+    sentinel's bits exceed every finite ``t1``.
     """
     i32 = np.int32
     st_p, st_c = prev[:, 0], cur[:, 0]
@@ -280,6 +301,13 @@ def journey_edges(xp, prev, cur, users, sends, t1_bits):
     )
     enq = (tq != prev[:, 8]) & (tq != inf)
     svc = (ts != prev[:, 9]) & (ts != inf)
+    # matured-but-unseated at end of tick: still waiting for a broker
+    # seat (PUB_INFLIGHT past t_at_broker) or a fog arrival seat
+    # (TASK_INFLIGHT past t_at_fog).  Bit-pattern <= is the engine's
+    # own maturity predicate (non-negative f32s order by their bits)
+    defer_b = (st_c == st(Stage.PUB_INFLIGHT)) & (tb <= t1_bits)
+    defer_f = (st_c == st(Stage.TASK_INFLIGHT)) & (tf <= t1_bits)
+    defer = defer_b | defer_f
     changed = st_c != st_p
     was_on_fog = (
         (st_p == st(Stage.TASK_INFLIGHT))
@@ -344,23 +372,37 @@ def journey_edges(xp, prev, cur, users, sends, t1_bits):
         [(is_hopx, cur[:, 3]), (is_retryx, rty_c)], zero
     )
 
+    # defer operands: the lane the task is waiting at — (broker, b=0)
+    # for the publish wait, (fog, b=1) for the arrival wait — stamped
+    # at the observing tick's end like the crash edges
+    t1_full = xp.full_like(st_c, t1_bits)
+    defer_a = xp.where(defer_f, fog_c, brk_c)
+    defer_bb = xp.where(defer_f, xp.full_like(st_c, i32(1)), zero)
+
     stack = lambda cols: xp.stack(cols, axis=1)  # noqa: E731
-    valid = stack([spawn, reoff, mig, decide, local, enq, svc, term])
+    valid = stack(
+        [spawn, reoff, mig, decide, defer, local, enq, svc, term]
+    )
     code = stack(
         [
             xp.full_like(st_c, i32(int(ev.SPAWN))),
             xp.full_like(st_c, i32(int(ev.REOFFLOAD))),
             xp.full_like(st_c, i32(int(ev.MIGRATE))),
             xp.full_like(st_c, i32(int(ev.DECIDE))),
+            xp.full_like(st_c, i32(int(ev.DEFER))),
             xp.full_like(st_c, i32(int(ev.LOCAL_RUN))),
             xp.full_like(st_c, i32(int(ev.ENQUEUE))),
             xp.full_like(st_c, i32(int(ev.SVC_START))),
             term_code,
         ]
     )
-    t_bits = stack([tc, tb, tb, tb, tb, tq, ts, term_t])
-    a = stack([users, fog_p, brk_p, fog_c, neg1, fog_c, fog_c, term_a])
-    b = stack([sends, rty_c, brk_c, brk_c, zero, zero, zero, term_b])
+    t_bits = stack([tc, tb, tb, tb, t1_full, tb, tq, ts, term_t])
+    a = stack(
+        [users, fog_p, brk_p, fog_c, defer_a, neg1, fog_c, fog_c, term_a]
+    )
+    b = stack(
+        [sends, rty_c, brk_c, brk_c, defer_bb, zero, zero, zero, term_b]
+    )
     return valid, code, t_bits, a, b
 
 
@@ -377,15 +419,29 @@ def journey_tick(
     ``mode="drop"``); the cursor wraps for drop-oldest overflow, with
     overwrites counted in ``j_dropped``.
     """
-    J, R = spec.journey_slots, spec.journey_ring
-    i32 = jnp.int32
     S = spec.max_sends_per_user
     ids = telem.j_task
     cur = snapshot_rows(spec, tasks, chaos, hier, ids)
-    t1_bits = jax.lax.bitcast_convert_type(t1.astype(jnp.float32), i32)
+    t1_bits = jax.lax.bitcast_convert_type(
+        t1.astype(jnp.float32), jnp.int32
+    )
     valid, code, t_bits, a, b = journey_edges(
         jnp, telem.j_prev, cur, ids // S, ids % S, t1_bits
     )
+    telem, over = _append_edges(telem, cur, valid, code, t_bits, a, b)
+    return telem.replace(j_dropped=telem.j_dropped + over)
+
+
+def _append_edges(telem, cur, valid, code, t_bits, a, b):
+    """Append one tick's edge candidates to the rings (shared by the
+    single-device and TP taps).  Returns ``(telem', over)`` with
+    ``j_prev``/``j_ring``/``j_cursor`` advanced and ``over`` the tick's
+    drop-oldest overwrite count — the caller owns ``j_dropped`` (the
+    TP tap psums ``over`` across shards before folding it in).  Sizes
+    come from the leaves, not the spec: the TP tap runs under a LOCAL
+    spec whose ``task_capacity`` may undercut the global slot count."""
+    J, R = telem.j_task.shape[0], telem.j_ring.shape[1]
+    i32 = jnp.int32
     vi = valid.astype(i32)
     # per-slot append positions: cursor + in-tick offset, ring-wrapped
     off = jnp.cumsum(vi, axis=1) - 1
@@ -399,12 +455,59 @@ def journey_tick(
     over = jnp.sum(
         jnp.maximum(cursor - R, 0) - jnp.maximum(telem.j_cursor - R, 0)
     )
-    return telem.replace(
-        j_prev=cur,
-        j_ring=ring,
-        j_cursor=cursor,
-        j_dropped=telem.j_dropped + over,
+    return (
+        telem.replace(j_prev=cur, j_ring=ring, j_cursor=cursor),
+        over,
     )
+
+
+def journey_tick_tp(
+    spec_local: WorldSpec, telem, tasks, t1: jax.Array, t_off
+):
+    """The shard-local TP tap (ISSUE 19): one :func:`journey_tick` over
+    the LOCAL task view inside the shard_map'd tick.
+
+    Task rows are row-sharded and never change owners, so each sampled
+    slot is OWNED by exactly one shard: ``telem.j_task`` carries the
+    GLOBAL slot ids (the same replicated sample on every shard's local
+    journey leaves), each shard diffs only the slots whose rows fall in
+    its ``[t_off, t_off + task_capacity_local)`` block and holds every
+    other slot's ``j_prev`` fixed, with the edge candidates explicitly
+    masked to owned rows (level-triggered DEFER would otherwise re-fire
+    on a frozen mid-flight snapshot).  Slot ids stay global end to end —
+    the ``(user, send)`` operands and the decode gather are the
+    single-device ones — and the diff itself is the SAME
+    :func:`journey_edges` rule set, so the stitched per-owner rings
+    bit-match the single-device tap (tests/test_tp_journeys.py).
+
+    Returns ``(telem', over)``: ``over`` is this shard's drop-oldest
+    count for the end-of-tick psum — the replicated ``j_dropped``
+    scalar is NOT touched here (each shard adding its own count would
+    break the replication invariant).
+    """
+    S = spec_local.max_sends_per_user
+    T_loc = spec_local.task_capacity
+    ids = telem.j_task  # GLOBAL slot ids
+    loc = ids - t_off
+    owned = (loc >= 0) & (loc < T_loc)
+    safe = jnp.clip(loc, 0, T_loc - 1)
+    cur = snapshot_rows(spec_local, tasks, None, None, safe)
+    cur = jnp.where(owned[:, None], cur, telem.j_prev)
+    t1_bits = jax.lax.bitcast_convert_type(
+        t1.astype(jnp.float32), jnp.int32
+    )
+    valid, code, t_bits, a, b = journey_edges(
+        jnp, telem.j_prev, cur, ids // S, ids % S, t1_bits
+    )
+    # ownership mask: DEFER is LEVEL-triggered (an in-flight matured
+    # row re-fires every tick without a state change), so cur == prev
+    # alone does not silence non-owned copies once a chunk boundary
+    # re-tiles a mid-flight snapshot onto every shard — without the
+    # mask each non-owner would book phantom defers into its (later
+    # discarded) ring copy and leak their overflow into the psum'd
+    # drop census
+    valid = valid & owned[:, None]
+    return _append_edges(telem, cur, valid, code, t_bits, a, b)
 
 
 # ----------------------------------------------------------------------
@@ -546,24 +649,49 @@ def journey_summary(spec: WorldSpec, final) -> Optional[Dict]:
     }
 
 
-def snapshot_rings(final) -> Optional[Dict]:
+def journey_owner_shards(spec: WorldSpec, ids) -> Optional[List[int]]:
+    """Owning TP shard of each sampled GLOBAL slot id, or ``None`` on
+    an unsharded world view.
+
+    Tasks are row-sharded in contiguous blocks that never change
+    owners, so ownership is arithmetic on the stamped spec:
+    ``shard = slot_id // (task_capacity / tp_shards)``.  Used by the
+    Perfetto per-shard journey lanes, the flight-recorder snapshot and
+    (via the bundle's ``shard`` list) ``tools/postmortem.py --task``.
+    """
+    n = getattr(spec, "tp_shards", 0)
+    if n <= 1:
+        return None
+    t_loc = spec.task_capacity // n
+    return [int(i) // t_loc for i in np.asarray(ids, np.int64)]
+
+
+def snapshot_rings(final, spec: Optional[WorldSpec] = None) -> Optional[Dict]:
     """JSON-safe raw ring snapshot for flight-recorder bundles.
 
     Raw ``(t_bits, code, a, b)`` rows (plain ints) plus cursors — the
     bundle stays loadable by :func:`rings_from_snapshot` without the
     spec, so ``tools/postmortem.py`` can decode a crash dump from the
     manifest alone (pre-journey bundles simply lack the key: the
-    ``.get``-safe contract).
+    ``.get``-safe contract).  When ``spec`` is a stamped TP world view
+    (``spec.tp_shards > 1``) the snapshot also records each sampled
+    slot's owning shard so ``postmortem.py --task`` can name it
+    stdlib-only; pre-TP bundles simply lack the ``shard`` list.
     """
     t = getattr(final, "telem", None)
     if t is None or t.j_task.shape[0] == 0:
         return None
-    return {
+    snap = {
         "task": [int(x) for x in np.asarray(t.j_task)],
         "cursor": [int(x) for x in np.asarray(t.j_cursor)],
         "dropped": int(np.asarray(t.j_dropped)),
         "ring": np.asarray(t.j_ring, np.int64).tolist(),
     }
+    if spec is not None:
+        owners = journey_owner_shards(spec, t.j_task)
+        if owners is not None:
+            snap["shard"] = owners
+    return snap
 
 
 def rings_from_snapshot(snap: Dict) -> List[Dict]:

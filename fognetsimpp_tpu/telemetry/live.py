@@ -383,7 +383,7 @@ class FlightRecorder:
             # (the .get-safe contract)
             from .journeys import snapshot_rings
 
-            rings = snapshot_rings(final)
+            rings = snapshot_rings(final, spec)
             if rings is not None:
                 manifest["journeys"] = {
                     "sampled": len(rings["task"]),

@@ -367,11 +367,18 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
     from the device-resident rings, so restamped columns cannot erase
     the intermediate history.  Empty on journey-off runs: every
     existing trace stays byte-identical.
+
+    On a TP-stamped world (``spec.tp_shards > 1``, ISSUE 19) each
+    sampled task's chain renders in its OWNING shard's process
+    (``journeys-shard{k}``, one pid per shard above ``pid``) — the
+    per-shard lanes of the sharded journey plane; unsharded runs keep
+    the single byte-identical "journeys" process.
     """
     from .journeys import (
         BROKER_SIDE_EVENTS,
         JourneyEvent,
         decode_rings,
+        journey_owner_shards,
     )
 
     if not spec.journey_active:
@@ -379,6 +386,9 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
     decoded = decode_rings(spec, final)
     if not decoded:
         return []
+    owners = journey_owner_shards(
+        spec, [d["task"] for d in decoded]
+    )
     B = max(1, spec.n_brokers)
     F = spec.n_fogs
     ub = (
@@ -387,13 +397,15 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
         else None
     )
     mig = int(JourneyEvent.MIGRATE)
+    dfr = int(JourneyEvent.DEFER)
     events: List[Dict] = []
     used_tids = set()
-    for d in decoded:
+    for d_i, d in enumerate(decoded):
         evs = d["events"]
         if not evs:
             continue
         task = d["task"]
+        pid_d = pid if owners is None else pid + owners[d_i]
         cur_b = (
             int(ub[d["user"]])
             if ub is not None and d["user"] < len(ub)
@@ -403,7 +415,15 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
         ts_all = [e["t"] * 1e6 for e in evs]  # seconds -> trace us
         for i, e in enumerate(evs):
             code = e["code"]
-            if code in BROKER_SIDE_EVENTS:
+            if code == dfr and e["b"] == 0:
+                # broker-side wait (matured publish not yet decided):
+                # the slice sits on the broker the task waits at
+                tid = min(max(e["a"], 0), B - 1)
+            elif code == dfr:
+                # fog-side wait (matured arrival not yet seated —
+                # K-window / exchange overflow): the target fog's lane
+                tid = B + min(max(e["a"], 0), max(F - 1, 0))
+            elif code in BROKER_SIDE_EVENTS:
                 if code == mig:
                     # the hop slice sits on the SRC lane; later events
                     # land on the destination broker's lane
@@ -417,7 +437,7 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
                 tid = min(max(int(tid), 0), B - 1)
             else:
                 tid = B + min(max(e["a"], 0), max(F - 1, 0))
-            used_tids.add(int(tid))
+            used_tids.add((int(pid_d), int(tid)))
             ts = ts_all[i]
             dur = (
                 max(ts_all[i + 1] - ts, 0.0) if i + 1 < len(evs) else 0.0
@@ -427,7 +447,7 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
                 {
                     "name": e["name"],
                     "ph": "X",
-                    "pid": int(pid),
+                    "pid": int(pid_d),
                     "tid": int(tid),
                     "ts": float(ts),
                     "dur": float(dur),
@@ -446,7 +466,7 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
                 "name": f"journey{task}",
                 "ph": ph,
                 "id": int(flow_id),
-                "pid": int(pid),
+                "pid": int(pid_d),
                 "tid": int(tid),
                 "ts": float(ts),
                 "cat": "journey",
@@ -456,28 +476,35 @@ def _journey_events(spec: WorldSpec, final, pid: int) -> List[Dict]:
             events.append(flow)
     if not events:
         return []
-    for b in range(B):
-        if b in used_tids:
-            events.append(
-                {
-                    "name": "thread_name", "ph": "M", "pid": int(pid),
-                    "tid": b, "args": {"name": f"broker{b}"},
-                }
-            )
-    for f in range(F):
-        if B + f in used_tids:
-            events.append(
-                {
-                    "name": "thread_name", "ph": "M", "pid": int(pid),
-                    "tid": B + f, "args": {"name": f"fog{f}"},
-                }
-            )
-    events.append(
-        {
-            "name": "process_name", "ph": "M", "pid": int(pid),
-            "args": {"name": "journeys"},
-        }
-    )
+    pids = sorted({p for p, _ in used_tids})
+    for p in pids:
+        for b in range(B):
+            if (p, b) in used_tids:
+                events.append(
+                    {
+                        "name": "thread_name", "ph": "M", "pid": int(p),
+                        "tid": b, "args": {"name": f"broker{b}"},
+                    }
+                )
+        for f in range(F):
+            if (p, B + f) in used_tids:
+                events.append(
+                    {
+                        "name": "thread_name", "ph": "M", "pid": int(p),
+                        "tid": B + f, "args": {"name": f"fog{f}"},
+                    }
+                )
+        events.append(
+            {
+                "name": "process_name", "ph": "M", "pid": int(p),
+                "args": {
+                    "name": (
+                        "journeys" if owners is None
+                        else f"journeys-shard{p - pid}"
+                    )
+                },
+            }
+        )
     return events
 
 

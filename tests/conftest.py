@@ -127,6 +127,13 @@ _QUICK_FILES = {
     # the Perfetto flow-chain acceptance world and the drop-oldest
     # accounting — the inert-subsystem discipline of chaos/hier above
     "test_journeys.py",
+    # TP journeys (ISSUE 19): the stitched-ring A/B vs the
+    # single-device tap on the windowed defer-heavy world, the
+    # per-shard Perfetto lanes, the owning-shard postmortem column and
+    # the census-label/bench-gate units — one TP compile shared
+    # module-wide; the regime sweep, host replay and CLI smoke carry
+    # their own slow marks (the test_tp.py tier discipline)
+    "test_tp_journeys.py",
 }
 
 

@@ -409,15 +409,16 @@ def test_journeys_above_task_capacity_is_clear_error(capsys):
     assert "Traceback" not in captured.err
 
 
-def test_journeys_with_tp_is_clear_error(capsys):
-    import pytest as _pytest
-
-    with _pytest.raises(SystemExit) as e:
-        main(["--scenario", "smoke", "--telemetry", "--journeys", "4",
-              "--tp", "8"])
-    assert e.value.code == 2
-    err = capsys.readouterr().err
-    assert "[TP-JOURNEYS]" in err
+def test_journeys_compose_with_tp(capsys):
+    """--journeys × --tp is a SUCCESS path since ISSUE 19: the journey
+    rings shard with the task axis and the decoded chains bit-match the
+    single-device tap (the former [TP-JOURNEYS] rejection is gone)."""
+    rc = main(["--scenario", "smoke", "--telemetry", "--journeys", "4",
+               "--tp", "8", "--set", "scenario.horizon=0.05"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert '"tp_shards": 8' in captured.out
+    assert "Traceback" not in captured.err
 
 
 # ---- digital-twin guard rails (twin/, ISSUE 17) -----------------------

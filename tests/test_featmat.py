@@ -246,7 +246,8 @@ _TP_CASES = [
     ("[TP-WIRED]", dict(wired_queue_enabled=True)),
     ("[TP-SERIES]", dict(record_tick_series=True)),
     ("[TP-HIER]", dict(n_brokers=2)),
-    ("[TP-JOURNEYS]", dict(telemetry=True, telemetry_journeys=4)),
+    # [TP-JOURNEYS] deleted in ISSUE 19: journey rings run shard-local
+    # inside the sharded tick (tests/test_tp_journeys.py)
 ]
 
 

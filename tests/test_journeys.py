@@ -241,15 +241,17 @@ def test_phase_contract_registered_and_shapes():
     check_telemetry_contract(spec0, state0)
 
 
-def test_sharded_runner_rejects_journeys_with_one_line():
+def test_sharded_runner_admits_journeys():
+    # the [TP-JOURNEYS] clause is deleted (ISSUE 19): a TP-admissible
+    # journey spec passes the gate; tests/test_tp_journeys.py proves
+    # the sharded rings bit-match the single-device tap
     from fognetsimpp_tpu.core.engine import tp_reject_reason
 
     spec, *_ = _build(
         telemetry=True, telemetry_journeys=4, assume_static=True,
         derive_acks=True,
     )
-    reason = tp_reject_reason(spec)
-    assert reason is not None and "journey" in reason
+    assert tp_reject_reason(spec) is None
 
 
 def test_spec_validation_one_liners():

@@ -1,0 +1,460 @@
+"""TP journeys — shard-local event rings under the sharded tick
+(ISSUE 19).
+
+The journey plane runs INSIDE the shard_map'd TP tick: each shard
+diffs only its OWNED task slots (global slot ids via the TpCtx offset)
+into shard-local rings, only the scalar drop census joins the
+end-of-tick psum, and the stitcher reassembles the rings in global
+slot order.  The gates:
+
+* the decoded TP chains bit-match the single-device tap on a windowed
+  defer-heavy world — every journey leaf, the drop census and the
+  stage roll-up, with the simulation state itself bit-exact;
+* the same chains bit-match a deterministic numpy HOST REPLAY of the
+  single-device schedule (the shared ``journey_edges`` rule set, third
+  backend);
+* Perfetto renders per-shard ``journeys-shard{k}`` lanes with the
+  DEFER slices on the waiting entity's lane, chains still connected;
+* flight-recorder bundles carry the owning-shard column and
+  ``postmortem.py --task`` names the shard (pre-TP bundles stay
+  .get-safe);
+* the ``fns_journey_tasks{stage=...}`` census label obeys the
+  known-stage/no-duplicate lint and ``tp_journey_overhead`` rides the
+  bench trend gate.
+
+Compile budget: the quick tier compiles ONE TP program (the windowed
+defer-heavy A/B, shared module-wide); the regime x entry sweep, the
+host replay and the CLI composition ride the slow tier.
+"""
+import hashlib
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import run
+from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+from fognetsimpp_tpu.parallel import (
+    make_mesh,
+    run_tp_chunked,
+    run_tp_sharded,
+)
+from fognetsimpp_tpu.scenarios import smoke
+from fognetsimpp_tpu.telemetry import journeys as jn
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "tools")
+)
+
+SMALL = dict(
+    n_users=16, n_fogs=3, send_interval=0.01, horizon=0.2,
+    start_time_max=0.05,
+)
+
+#: The acceptance world: arrival_window=1 with a hot send cadence keeps
+#: the K-window selection truncating from early on, so matured sends
+#: WAIT — the DEFER edge fires on both broker- and fog-side lanes and
+#: the rings carry a windowed schedule no restamped column could
+#: reconstruct.
+DEFER_HEAVY = dict(
+    telemetry=True, telemetry_journeys=8, telemetry_journey_ring=32,
+    arrival_window=1, send_interval=0.005,
+)
+
+_JOURNEY_LEAVES = ("j_task", "j_prev", "j_ring", "j_cursor", "j_dropped")
+
+
+def _hash(state, skip=()) -> str:
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        if any(s in jax.tree_util.keystr(path) for s in skip):
+            continue
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+def _tp(spec, state, net, bounds, mesh, **kw):
+    kw.setdefault("donate", True)
+    return run_tp_sharded(
+        spec, jax.tree.map(jnp.copy, state), net, bounds, mesh, **kw
+    )
+
+
+@pytest.fixture(scope="module")
+def node_mesh():
+    assert len(jax.devices()) == 8, "conftest must provision 8 devices"
+    return make_mesh(8, axis_name="node")
+
+
+@pytest.fixture(scope="module")
+def ab(node_mesh):
+    """The shared quick-tier A/B: single-device reference and TP run of
+    the windowed defer-heavy world (ONE TP compile for the module)."""
+    spec, state, net, bounds = _build(**DEFER_HEAVY)
+    ref, _ = run(spec, state, net, bounds)
+    spec2, got = _tp(spec, state, net, bounds, node_mesh)
+    return spec, ref, spec2, got
+
+
+# ----------------------------------------------------------------------
+# the determinism oracle: TP chains == single-device tap
+# ----------------------------------------------------------------------
+
+def test_tp_journey_chains_bit_match_single_device(ab):
+    """THE acceptance A/B (featmat evidence for journeys x tp): every
+    journey leaf of the stitched TP state — sample ids, packed prev
+    rows, rings, cursors AND the psum-folded drop census — bit-matches
+    the single-device tap on the windowed defer-heavy world; the
+    decoded chains agree event-for-event with DEFER present; the
+    simulation state itself is bit-exact."""
+    spec, ref, spec2, got = ab
+    assert spec2.tp_shards == 8
+    for name in _JOURNEY_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.telem, name)),
+            np.asarray(getattr(got.telem, name)),
+            err_msg=name,
+        )
+    assert _hash(ref, skip=("telem",)) == _hash(got, skip=("telem",))
+
+    dec_ref = jn.decode_rings(spec, ref)
+    dec_tp = jn.decode_rings(spec2, got)
+    assert dec_ref == dec_tp
+    n_events = sum(d["events_total"] for d in dec_tp)
+    assert n_events > 0
+    # the windowed world really deferred, and the tap recorded it
+    defers = [
+        e for d in dec_tp for e in d["events"] if e["name"] == "defer"
+    ]
+    assert defers, "defer-heavy world recorded no DEFER edges"
+    # the K-window truncation defers on the fog side (b=1: matured
+    # arrival not yet seated), booked at the observing tick's time
+    assert {e["b"] for e in defers} <= {0, 1}
+    assert any(e["b"] == 1 for e in defers)
+    # the census roll-up (the ONLY journey quantity that crossed the
+    # psum is j_dropped; the stage counts come from the stitched rings)
+    s_ref = jn.journey_summary(spec, ref)
+    s_tp = jn.journey_summary(spec2, got)
+    assert s_ref is not None and s_tp is not None
+    assert s_ref["sampled"] == s_tp["sampled"] == 8
+    assert s_ref["events_total"] == s_tp["events_total"] == n_events
+    assert s_ref["terminal"] == s_tp["terminal"]
+
+
+@pytest.mark.slow  # extra compiles: full-suite tier
+def test_tp_journeys_across_regimes_and_entries(node_mesh):
+    """Windowed and NO-window regimes x run/run_jit/run_chunked: the
+    journey leaves are entry-independent and TP bit-matches each;
+    run_tp_chunked == one-shot TP bit-for-bit (re-tiling the journey
+    tuple at a chunk boundary must not invent events — the level-
+    triggered DEFER regression); a minimum-depth ring forces
+    drop-oldest overflow THROUGH the psum census."""
+    regimes = [
+        dict(DEFER_HEAVY),                                # windowed
+        dict(DEFER_HEAVY, telemetry_journey_ring=8),      # + overflow
+        dict(telemetry=True, telemetry_journeys=8,
+             telemetry_journey_ring=16),                  # no window
+    ]
+    for kw in regimes:
+        spec, state, net, bounds = _build(**kw)
+        ref, _ = run(spec, state, net, bounds)
+        jit_ref = run_jit(
+            spec, jax.tree.map(jnp.copy, state), net, bounds
+        )
+        chunk_ref = run_chunked(
+            spec, jax.tree.map(jnp.copy, state), net, bounds,
+            chunk_ticks=spec.n_ticks // 2,
+        )
+        assert _hash(ref) == _hash(jit_ref) == _hash(chunk_ref), kw
+        spec2, got = _tp(spec, state, net, bounds, node_mesh)
+        for name in _JOURNEY_LEAVES:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref.telem, name)),
+                np.asarray(getattr(got.telem, name)),
+                err_msg=f"{name} {kw}",
+            )
+        assert _hash(ref, skip=("telem",)) == _hash(
+            got, skip=("telem",)
+        ), kw
+        assert jn.decode_rings(spec, ref) == jn.decode_rings(
+            spec2, got
+        ), kw
+        if kw.get("telemetry_journey_ring") == 8:
+            assert int(np.asarray(got.telem.j_dropped)) > 0, kw
+        # chunked TP == one-shot TP, journey rings included
+        spec3, got_c = run_tp_chunked(
+            spec, jax.tree.map(jnp.copy, state), net, bounds,
+            node_mesh, chunk_ticks=spec.n_ticks // 4,
+        )
+        assert spec3 == spec2
+        assert _hash(got_c) == _hash(got), kw
+
+
+@pytest.mark.slow  # eager per-tick stepping: full-suite tier
+def test_tp_chains_bit_match_host_replay(node_mesh, ab):
+    """The third backend: re-derive every tick's edges on HOST with the
+    shared journey_edges rule set over numpy snapshots of the
+    single-device schedule, and require the TP-decoded rings to match
+    the replay event-for-event, drop-oldest tail included — the
+    sharded tap provably records the schedule the engine executed."""
+    from fognetsimpp_tpu.core.engine import make_step
+    from fognetsimpp_tpu.net.mobility import default_bounds
+
+    spec, _, spec2, got = ab
+    _, state, net, _ = _build(**DEFER_HEAVY)
+    step = make_step(spec)
+    jstep = jax.jit(lambda s: step(s, net, default_bounds()))
+    ids = np.asarray(state.telem.j_task)
+
+    def snap(s):
+        return np.asarray(
+            jn.snapshot_rows(
+                spec, s.tasks, s.chaos, s.hier, jnp.asarray(ids)
+            )
+        )
+
+    expected = [[] for _ in ids]
+    prev = snap(state)
+    s = state
+    for i in range(spec.n_ticks):
+        s = jstep(s)
+        cur = snap(s)
+        t1 = np.float32(np.float32(i + 1) * np.float32(spec.dt))
+        for j, evs in enumerate(
+            jn.replay_tick(spec, prev, cur, ids, float(t1))
+        ):
+            expected[j].extend(evs)
+        prev = cur
+    decoded = jn.decode_rings(spec2, got)
+    R = spec.journey_ring
+    n_events = 0
+    for j, d in enumerate(decoded):
+        exp = expected[j]
+        n_events += len(exp)
+        assert d["events_total"] == len(exp), (j, d, exp)
+        want = exp[-R:] if len(exp) > R else exp
+        assert d["events"] == want, (j, d["events"], want)
+    assert n_events > 0
+    assert any("defer" in {e["name"] for e in c} for c in expected)
+
+
+# ----------------------------------------------------------------------
+# Perfetto: per-shard journey lanes
+# ----------------------------------------------------------------------
+
+def test_tp_perfetto_renders_per_shard_journey_lanes(ab, tmp_path):
+    """On the TP-stamped world each sampled task's chain renders in its
+    OWNING shard's ``journeys-shard{k}`` process; chains stay connected
+    (one s ... f per flow id, every flow bound to a slice) and the
+    DEFER slices land on the waiting entity's lane."""
+    from fognetsimpp_tpu.telemetry.timeline import export_trace
+
+    spec, _, spec2, got = ab
+    p = export_trace(spec2, got, str(tmp_path / "tp_journeys.json"))
+    trace = json.loads(open(p).read())
+    events = trace["traceEvents"]
+    shard_pids = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and e.get("args", {}).get("name", "").startswith(
+            "journeys-shard"
+        )
+    }
+    assert shard_pids, "no per-shard journey process rendered"
+    # the sample really spans more than one owning shard
+    assert len(shard_pids) > 1, shard_pids
+    owners = jn.journey_owner_shards(
+        spec2, [d["task"] for d in jn.decode_rings(spec2, got)]
+    )
+    assert set(shard_pids.values()) == {
+        f"journeys-shard{k}" for k in set(owners)
+    }
+    jev = [e for e in events if e.get("cat") == "journey"]
+    assert all(e["pid"] in shard_pids for e in jev)
+    # defer slices present, and chains connected within each process
+    assert [e for e in jev if e.get("ph") == "X" and e["name"] == "defer"]
+    slices = {(e["pid"], e["tid"], e["ts"]) for e in jev if e["ph"] == "X"}
+    by_id: dict = {}
+    for e in jev:
+        if e["ph"] in ("s", "t", "f"):
+            by_id.setdefault(e["id"], []).append(e)
+            assert (e["pid"], e["tid"], e["ts"]) in slices
+    assert by_id, "no flow chains rendered"
+    for fid, chain in by_id.items():
+        # traceEvents are ts-sorted and a restamped terminal can carry
+        # an earlier timestamp than the tick-time defer slices, so the
+        # chain is checked by phase COUNTS: exactly one s, one f, the
+        # rest t, all inside the owning shard's process
+        phases = sorted(e["ph"] for e in chain)
+        assert phases.count("s") == 1 and phases.count("f") == 1, (
+            fid, phases,
+        )
+        assert set(phases) <= {"s", "t", "f"}, (fid, phases)
+        assert len({e["pid"] for e in chain}) == 1, fid
+
+
+# ----------------------------------------------------------------------
+# flight recorder + postmortem: the owning-shard column
+# ----------------------------------------------------------------------
+
+def test_tp_bundle_postmortem_names_owning_shard(ab, tmp_path, capsys):
+    """A flight-recorder bundle dumped from the TP run carries the
+    owning-shard column; ``postmortem.py --task`` prints it in the
+    chain header.  A pre-TP bundle (no ``shard`` key) and a
+    pre-journey bundle (no ``journeys`` at all) stay .get-safe."""
+    import postmortem
+
+    from fognetsimpp_tpu.telemetry.live import FlightRecorder
+
+    spec, _, spec2, got = ab
+    rec = FlightRecorder(capacity=4)
+    rec.note_chunk(100, rows={"t": np.asarray([0.1])})
+    manifest = rec.dump(
+        str(tmp_path), "anomaly", spec=spec2, final=got
+    )
+    d = json.load(open(manifest))
+    rings = d["journeys"]["rings"]
+    assert len(rings["shard"]) == len(rings["task"])
+    t_loc = spec2.task_capacity // spec2.tp_shards
+    assert rings["shard"] == [t // t_loc for t in rings["task"]]
+    task_id = rings["task"][0]
+    assert postmortem.main(["--task", str(task_id), manifest]) == 0
+    out = capsys.readouterr().out
+    assert f"task {task_id}" in out
+    assert f"owned by shard {rings['shard'][0]}" in out
+
+    # pre-TP bundle: same rings, shard column stripped
+    old = dict(d)
+    old["journeys"] = dict(d["journeys"])
+    old["journeys"]["rings"] = {
+        k: v for k, v in rings.items() if k != "shard"
+    }
+    pre_tp = tmp_path / "pre_tp.json"
+    pre_tp.write_text(json.dumps(old))
+    assert postmortem.main(["--task", str(task_id), str(pre_tp)]) == 0
+    out2 = capsys.readouterr().out
+    assert f"task {task_id}" in out2 and "owned by shard" not in out2
+
+    # pre-journey bundle still summarizes
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"reason": "nan", "ring": []}))
+    assert postmortem.main([str(legacy)]) == 0
+
+
+# ----------------------------------------------------------------------
+# host-side exposition units (no TP compile)
+# ----------------------------------------------------------------------
+
+def test_openmetrics_journey_stage_rules():
+    """The census-label contract on fns_journey_tasks: a missing stage
+    label, an unknown stage name and a duplicated stage are findings;
+    the known-stage census passes."""
+    import check_openmetrics as com
+
+    head = (
+        "# HELP fns_journey_tasks j\n"
+        "# TYPE fns_journey_tasks gauge\n"
+    )
+    good = (
+        head
+        + 'fns_journey_tasks{stage="done"} 5\n'
+        + 'fns_journey_tasks{stage="in_flight"} 2\n'
+        + 'fns_journey_tasks{stage="unspawned"} 1\n# EOF\n'
+    )
+    assert com.check_text(good, "g") == 0
+    assert com.check_text(
+        head + "fns_journey_tasks 5\n# EOF\n", "no-label"
+    ) == 1
+    # an event name that is NOT a census stage (defer is an edge, not
+    # a terminal) must be rejected — key drift away from dashboards
+    assert com.check_text(
+        head + 'fns_journey_tasks{stage="defer"} 5\n# EOF\n',
+        "unknown",
+    ) == 1
+    assert com.check_text(
+        head
+        + 'fns_journey_tasks{stage="done",broker="0"} 5\n'
+        + 'fns_journey_tasks{stage="done",broker="1"} 6\n# EOF\n',
+        "dup",
+    ) == 1
+
+
+def test_bench_trend_tp_journey_gate(tmp_path):
+    """A capture recording tp_journey_overhead above the 1.10 bar fails
+    --check; at/below passes; the text table carries the column."""
+    import bench_trend
+
+    def cap(path, overhead):
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "parsed": {
+                        "metric": "m", "value": 100.0, "backend": "cpu",
+                        "n_users": 8, "tp_journey_overhead": overhead,
+                    }
+                },
+                f,
+            )
+
+    cap(tmp_path / "BENCH_r01.json", 1.08)
+    rows = bench_trend.load_rounds(str(tmp_path))
+    assert bench_trend.check(rows) == []
+    assert "tp-journeys x1.080" in bench_trend.table(rows)
+    cap(tmp_path / "BENCH_r02.json", 1.27)
+    rows = bench_trend.load_rounds(str(tmp_path))
+    problems = bench_trend.check(rows)
+    assert len(problems) == 1
+    assert "TP-journey-rings-on" in problems[0]
+
+
+# ----------------------------------------------------------------------
+# CLI composition
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow  # in-process CLI: its own TP program
+def test_cli_tp_journeys_records_and_traces(tmp_path, capsys):
+    """--journeys --tp N end to end: runs sharded, decodes the stitched
+    rings into .sca.json, and the Perfetto export carries the
+    per-shard journey lanes — the previously rejected composition."""
+    from fognetsimpp_tpu.__main__ import main
+
+    trace = tmp_path / "t.json"
+    rc = main([
+        "--scenario", "smoke", "--telemetry", "--journeys", "8",
+        "--tp", "8",
+        "--set", "scenario.n_users=16",
+        "--set", "scenario.n_fogs=3",
+        "--set", "scenario.send_interval=0.005",
+        "--set", "scenario.horizon=0.2",
+        "--set", "scenario.arrival_window=1",
+        "--out", str(tmp_path), "--trace-out", str(trace),
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    summary = json.loads(captured.out.strip().splitlines()[-1])
+    assert summary["tp_shards"] == 8
+    sca = json.load(open(tmp_path / "General-0.sca.json"))
+    assert sca["journeys"]["sampled"] == 8
+    assert sca["journeys"]["events_total"] > 0
+    t = json.loads(trace.read_text())
+    assert [
+        e for e in t["traceEvents"] if e.get("cat") == "journey"
+    ]
+    assert any(
+        e.get("ph") == "M" and e.get("name") == "process_name"
+        and e.get("args", {}).get("name", "").startswith(
+            "journeys-shard"
+        )
+        for e in t["traceEvents"]
+    )

@@ -134,6 +134,11 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     # journey-ring overhead (ISSUE 15): interleaved
                     # off/on A/B recorded by bench.py BENCH_JOURNEYS=1
                     "journey_overhead": parsed.get("journey_overhead"),
+                    # TP journey-ring overhead (ISSUE 19): the same A/B
+                    # under bench.py --tp with BENCH_TP_JOURNEYS=1
+                    "tp_journey_overhead": parsed.get(
+                        "tp_journey_overhead"
+                    ),
                     # digital-twin doors (ISSUE 17, bench.py --twin):
                     # pre-twin captures backfill None via .get
                     # per-hop TP exchange-ring payload (ISSUE 18):
@@ -181,6 +186,7 @@ def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
         for field, what in (
             ("telemetry_overhead", "telemetry-on"),
             ("journey_overhead", "journey-rings-on"),
+            ("tp_journey_overhead", "TP-journey-rings-on"),
         ):
             oh = r.get(field)
             if oh is not None and float(oh) > OVERHEAD_BAR:
@@ -325,6 +331,11 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                 oh += (
                     f", journeys x{r['journey_overhead']:.3f}"
                     if r.get("journey_overhead") is not None
+                    else ""
+                )
+                oh += (
+                    f", tp-journeys x{r['tp_journey_overhead']:.3f}"
+                    if r.get("tp_journey_overhead") is not None
                     else ""
                 )
                 rcs = (

@@ -38,7 +38,13 @@ health plane (r6):
   once any of its gauges appears, the full set (depth, capacity,
   accepted/dropped/injected/rejected totals, latency) must be present
   — a partial ingest exposition means a dashboard silently loses the
-  drop or depth signal it alarms on.
+  drop or depth signal it alarms on;
+* the journey census (``fns_journey_tasks``) carries the ``stage``
+  label dimension on every sample, drawn from the KNOWN census stages
+  (the terminal journey event names plus ``in_flight``/``unspawned``)
+  with no stage emitted twice — an unknown or duplicated stage is a
+  census key drifting away from the dashboards that match on it (the
+  broker/shard label-rule pattern).
 """
 import math
 import re
@@ -83,6 +89,29 @@ _TWIN_INGEST_FAMILIES = frozenset(
         "fns_twin_ingest_injected_total",
         "fns_twin_ingest_rejected_total",
         "fns_twin_ingest_latency_seconds",
+    )
+)
+
+
+#: The journey census stages (telemetry/openmetrics._render_journeys):
+#: the TERMINAL journey event names plus the two non-terminal census
+#: buckets.  Hardcoded so the linter stays stdlib-only (importing
+#: journeys pulls in jax) — extend together with JourneyEvent's
+#: terminal set.  Non-terminal events (spawn, decide, defer, ...) are
+#: NEVER census stages: a ring whose last event is one of those counts
+#: as in_flight.
+_JOURNEY_STAGES = frozenset(
+    (
+        "done",
+        "no_resource",
+        "rejected",
+        "dropped",
+        "lost",
+        "crash_lost",
+        "retry_exhaust",
+        "hop_exhausted",
+        "in_flight",
+        "unspawned",
     )
 )
 
@@ -243,6 +272,29 @@ def check_lines(lines, where: str) -> int:
                 f"{sorted(vals)}, expected 0..{max(want)}"
             )
             return 1
+    # journey census stage-label contract (ISSUE 19): every
+    # fns_journey_tasks sample names a KNOWN stage exactly once —
+    # series uniqueness alone would let a drifted/extra-labeled stage
+    # double-count the census
+    stage_seen = set()
+    for i, name, labels_text, v in samples:
+        if _family(name, types) != "fns_journey_tasks":
+            continue
+        labels = _parse_labels(labels_text)
+        if "stage" not in labels:
+            print(f"{where}:{i}: {name} sample without a 'stage' label")
+            return 1
+        sv = labels["stage"]
+        if sv not in _JOURNEY_STAGES:
+            print(
+                f"{where}:{i}: {name} has unknown stage={sv!r} "
+                f"(known: {', '.join(sorted(_JOURNEY_STAGES))})"
+            )
+            return 1
+        if sv in stage_seen:
+            print(f"{where}:{i}: {name} repeats stage={sv!r}")
+            return 1
+        stage_seen.add(sv)
     # twin ingestion-family completeness (ISSUE 17): all-or-nothing
     ingest_present = {
         _family(name, types)

@@ -92,7 +92,8 @@ CELLS: Tuple[Cell, ...] = (
     _a("baseline", "fleet", "variant:fleet_step"),
     _a("telemetry", "run", "variant:tick_telemetry", "variant:tick_hist"),
     _a("telemetry", "tp", "variant:tp_tick_telemetry"),
-    _u("telemetry", "fleet"),
+    _a("telemetry", "fleet",
+       "test:test_fleet_carries_telemetry_identically_to_vmap"),
     _a("series", "run", "variant:tick_series"),
     _r("series", "tp", "TP-SERIES"),
     _a("series", "fleet",
@@ -133,7 +134,8 @@ CELLS: Tuple[Cell, ...] = (
     _r("hier", "tp", "TP-HIER"),
     _r("hier", "fleet", "FLEET-HIER"),
     _a("journeys", "run", "variant:tick_journeys"),
-    _r("journeys", "tp", "TP-JOURNEYS"),
+    _a("journeys", "tp", "variant:tp_tick_journeys",
+       "test:test_tp_journey_chains_bit_match_single_device"),
     _a("journeys", "fleet", "test:test_fleet_vmap_carries_journey_rings"),
     _a("dynspec", "run", "variant:tick_dyn"),
     _u("dynspec", "tp"),

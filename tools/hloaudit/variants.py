@@ -511,6 +511,21 @@ def variants() -> List[Variant]:
             sharded=True,
             declared_collectives=None,  # resolved lazily from taskshard.py
         ),
+        Variant(
+            "tp_tick_journeys",
+            "the WINDOWED TP sharded tick with the journey rings live "
+            "(ISSUE 19: shard-local snapshot diff over the owned "
+            "sampled slots + the drop-oldest census riding the "
+            "end-of-tick psum) — the journey tap must add NO "
+            "collective beyond taskshard.DECLARED_COLLECTIVES",
+            lambda: _compile_tp_tick(
+                telemetry=True, telemetry_journeys=8,
+                telemetry_journey_ring=16, arrival_window=4,
+                derive_acks=False,
+            ),
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from taskshard.py
+        ),
     ]
 
 
@@ -523,7 +538,10 @@ def declared_for(v: Variant) -> Optional[Dict[str, Set[str]]]:
         return _fleet_declared()
     if v.name == "tp_dryrun":
         return _tp_declared()
-    if v.name in ("tp_tick", "tp_tick_telemetry", "tp_tick_window"):
+    if v.name in (
+        "tp_tick", "tp_tick_telemetry", "tp_tick_window",
+        "tp_tick_journeys",
+    ):
         from fognetsimpp_tpu.parallel.taskshard import (
             DECLARED_COLLECTIVES as tp_tick_declared,
         )

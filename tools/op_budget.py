@@ -180,7 +180,9 @@ def _result_bytes(result: str) -> int:
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 
-def compile_tp_counts(telemetry: bool = False, window: bool = False) -> dict:
+def compile_tp_counts(
+    telemetry: bool = False, window: bool = False, journeys: bool = False
+) -> dict:
     """Compile the shard_map'd TP sharded tick and count its HLO ops +
     collectives (ISSUE 9).
 
@@ -202,6 +204,11 @@ def compile_tp_counts(telemetry: bool = False, window: bool = False) -> dict:
     ``ppermute_payload_bytes`` pin is the O(K) proof — every
     collective-permute hop must carry exactly the packed (K, 5) i32
     window (K*5*4 bytes), never the full candidate gather.
+
+    ``journeys=True`` compiles the ISSUE 19 windowed journey-on tick:
+    the shard-local ring tap must add ZERO collectives (its only
+    cross-shard scalar rides the established end-of-tick psum), so the
+    pinned collective count equals the windowed telemetry tick's.
     """
     from tools.hloaudit.hlo import (
         COLLECTIVE_OPS,
@@ -210,7 +217,13 @@ def compile_tp_counts(telemetry: bool = False, window: bool = False) -> dict:
     )
     from tools.hloaudit.variants import _compile_tp_tick
 
-    if window:
+    if journeys:
+        text = _compile_tp_tick(
+            telemetry=True, telemetry_journeys=8,
+            telemetry_journey_ring=16, arrival_window=4,
+            derive_acks=False,
+        ).text
+    elif window:
         text = _compile_tp_tick(arrival_window=4).text
     elif telemetry:
         text = _compile_tp_tick(
@@ -264,7 +277,8 @@ def measure(
     if tp:
         for key, kw in (("tp_tick", {}),
                         ("tp_tick_telemetry", dict(telemetry=True)),
-                        ("tp_tick_window", dict(window=True))):
+                        ("tp_tick_window", dict(window=True)),
+                        ("tp_tick_journeys", dict(journeys=True))):
             t = compile_tp_counts(**kw)
             out_tp[key] = {
                 **t,
@@ -367,8 +381,10 @@ def check(measured: dict, budget: dict) -> list:
                     f"budget {btc[cap_key]}"
                 )
     # --- the TP sharded ticks (ISSUE 9; telemetry-on since ISSUE 11;
-    # windowed hop-pruned exchange since ISSUE 18) ---
-    for key in ("tp_tick", "tp_tick_telemetry", "tp_tick_window"):
+    # windowed hop-pruned exchange since ISSUE 18; journey rings since
+    # ISSUE 19) ---
+    for key in ("tp_tick", "tp_tick_telemetry", "tp_tick_window",
+                "tp_tick_journeys"):
         tp = measured.get(key)
         btp = budget.get(key)
         if tp is None:

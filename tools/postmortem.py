@@ -36,6 +36,7 @@ except Exception:
         5: "local_run", 6: "enqueue", 7: "svc_start", 8: "done",
         9: "no_resource", 10: "rejected", 11: "dropped", 12: "lost",
         13: "crash_lost", 14: "retry_exhaust", 15: "hop_exhausted",
+        16: "defer",
     }
 
 
@@ -52,6 +53,9 @@ def _decode_journey(snap: Dict, task_id: Optional[int] = None) -> List[Dict]:
     tasks = snap.get("task") or []
     cursor = snap.get("cursor") or []
     ring = snap.get("ring") or []
+    # owning-shard column: written by TP bundles since ISSUE 19;
+    # pre-TP bundles simply lack the key (the .get-safe contract)
+    shard = snap.get("shard") or []
     for j, task in enumerate(tasks):
         if task_id is not None and int(task) != int(task_id):
             continue
@@ -62,6 +66,7 @@ def _decode_journey(snap: Dict, task_id: Optional[int] = None) -> List[Dict]:
         out.append(
             {
                 "task": int(task),
+                "shard": int(shard[j]) if j < len(shard) else None,
                 "events_total": n,
                 "dropped": max(0, n - R) if R else n,
                 "events": [
@@ -306,10 +311,14 @@ def main(argv=None) -> int:
                 rc = 1
                 continue
             chain = chains[0]
+            own = (
+                f", owned by shard {chain['shard']}"
+                if chain.get("shard") is not None else ""
+            )
             print(
                 f"== {p}: task {chain['task']} "
                 f"({chain['events_total']} event(s), "
-                f"{chain['dropped']} dropped) =="
+                f"{chain['dropped']} dropped{own}) =="
             )
             for e in chain["events"]:
                 print(
