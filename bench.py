@@ -1174,6 +1174,176 @@ def reconfig_measurement() -> dict:
     }
 
 
+def sharded_reconfig_measurement(mode: str, n_devices=None) -> dict:
+    """Warm re-configuration on the SHARDED runners (ISSUE 20): cold
+    compile vs warm knob tweak on the promoted TP tick / fleet scan.
+
+    ``python bench.py --reconfig --tp`` (``mode="tp"``) pays the cold
+    shard_map TP compile ONCE through the promoted
+    :func:`~fognetsimpp_tpu.parallel.taskshard.run_tp_sharded` (shape
+    key static, mesh-replicated DynSpec operand), then re-configures
+    promoted knobs (uplink loss, send-stop time) and re-runs the SAME
+    cached program — ``--reconfig --fleet`` (``mode="fleet"``) does the
+    identical dance through :func:`~fognetsimpp_tpu.parallel.fleet
+    .run_fleet` on a replica-sharded batch.  The warm runs must trigger
+    ZERO compile events AND zero program-cache misses (both deltas ride
+    the JSON) and beat the cold compile by the same >= 10x bar the
+    single-device ``--reconfig`` row ships under —
+    ``tools/bench_trend.py --check`` gates the sharded rows via the
+    ``tp_reconfig_s`` / ``fleet_reconfig_s`` columns, like-for-like
+    with the ISSUE 13 gate.
+
+    Env knobs: BENCH_RECONFIG_TP_USERS / BENCH_RECONFIG_FLEET_USERS /
+    BENCH_RECONFIG_FOGS / BENCH_RECONFIG_HORIZON /
+    BENCH_RECONFIG_INTERVAL (shared with the single-device row).
+    """
+    import jax
+    import numpy as np
+
+    from fognetsimpp_tpu import compile_cache
+    from fognetsimpp_tpu.compile_cache import (
+        compile_stats,
+        enable_compile_cache,
+        note_compile,
+    )
+    from fognetsimpp_tpu.dynspec import registry_stats
+    from fognetsimpp_tpu.parallel import (
+        make_mesh,
+        replicate_state,
+        run_fleet,
+        run_tp_sharded,
+    )
+    from fognetsimpp_tpu.parallel import fleet as _fleet_mod
+    from fognetsimpp_tpu.parallel import taskshard as _ts_mod
+    from fognetsimpp_tpu.scenarios import smoke
+
+    assert mode in ("tp", "fleet"), mode
+    enable_compile_cache()
+    backend = jax.default_backend()
+    D = int(n_devices or len(jax.devices()))
+
+    # CPU-friendly sharded shapes: the TP world's user axis spans the
+    # mesh (users divisible by D); the fleet runs D replicas of a small
+    # world.  The warm wall includes the re-configured run itself (the
+    # number an operator waits for at a serve chunk boundary).
+    if mode == "tp":
+        n_users = _env_int("BENCH_RECONFIG_TP_USERS", 1024)
+    else:
+        n_users = _env_int("BENCH_RECONFIG_FLEET_USERS", 256)
+    n_fogs = _env_int("BENCH_RECONFIG_FOGS", 8)
+    # shorter horizons than the single-device row: the warm wall
+    # includes the re-configured run itself, and a chunk-boundary
+    # retune advances ONE serve chunk (tens of ticks), not a batch
+    # horizon — sized so the speedup quotes retune cost, not run cost
+    if mode == "tp":
+        horizon = _env_float("BENCH_RECONFIG_HORIZON", 0.03)
+        interval = _env_float("BENCH_RECONFIG_INTERVAL", 0.0015)
+    else:
+        horizon = _env_float("BENCH_RECONFIG_HORIZON", 0.05)
+        interval = _env_float("BENCH_RECONFIG_INTERVAL", 0.0025)
+
+    def build(**overrides):
+        # both knobs start in their promoted gate class (positive
+        # loss, finite send-stop) so every retune stays in ONE shape
+        # bucket — the gate flips are the recompiles, by design
+        kw = dict(
+            n_users=n_users,
+            n_fogs=n_fogs,
+            horizon=horizon,
+            send_interval=interval,
+            max_sends_per_user=int(horizon / interval) + 4,
+            uplink_loss_prob=0.01,
+            send_stop_time=horizon * 0.8,
+        )
+        kw.update(overrides)
+        return smoke.build(**kw)
+
+    if mode == "tp":
+        mesh = make_mesh(D, axis_name="node")
+
+        def run_once(sp, st, nt, bd):
+            _, final = run_tp_sharded(
+                sp, st, nt, bd, mesh, donate=True, promote=True
+            )
+            jax.block_until_ready(final.metrics.n_scheduled)
+            return int(np.asarray(final.metrics.n_scheduled))
+
+        def cache_misses():
+            return _ts_mod._tp_program.cache_info().misses
+    else:
+        mesh = make_mesh(D)
+
+        def run_once(sp, st, nt, bd):
+            batch = replicate_state(sp, st, D, seed=0)
+            final = run_fleet(
+                sp, batch, nt, bd, mesh=mesh, donate=True, promote=True
+            )
+            jax.block_until_ready(final.metrics.n_scheduled)
+            return int(np.asarray(final.metrics.n_scheduled).sum())
+
+        def cache_misses():
+            return _fleet_mod._fleet_run._cache_size()
+
+    # --- cold: the first promoted sharded program pays the compile ----
+    spec, state, net, bounds = build()
+    t0 = time.perf_counter()
+    decisions = run_once(spec, state, net, bounds)
+    compile_s = time.perf_counter() - t0
+    note_compile(compile_s)
+
+    # --- warm: re-configured knobs re-use the compiled program --------
+    knob_tweaks = {
+        "uplink_loss_prob": 0.04,
+        "send_stop_time": round(horizon * 0.3, 4),
+    }
+    walls = []
+    compiles_delta = 0.0
+    misses0 = cache_misses()
+    for _rep in range(3):
+        sp2, st2, nt2, bd2 = build(**knob_tweaks)
+        snap = compile_cache.snapshot()
+        t0 = time.perf_counter()
+        decisions = run_once(sp2, st2, nt2, bd2)
+        walls.append(time.perf_counter() - t0)
+        compiles_delta += compile_cache.delta_since(snap)["compiles"]
+    reconfig_s = sorted(walls)[len(walls) // 2]
+    miss_delta = cache_misses() - misses0
+
+    shape_extra = (
+        {"tp_shards": D} if mode == "tp" else {"n_replicas": D}
+    )
+    return {
+        "metric": f"{mode}_warm_reconfig_speedup",
+        "value": round(compile_s / reconfig_s, 1),
+        "unit": "x (cold compile / warm reconfig)",
+        "backend": backend,
+        "n_devices": D,
+        **shape_extra,
+        "n_users": n_users,
+        "n_fogs": n_fogs,
+        "horizon_s": horizon,
+        "dt": 1e-3,
+        "policy": "min_busy",
+        "compile_s": round(compile_s, 2),
+        "reconfig_s": round(reconfig_s, 4),
+        f"{mode}_reconfig_s": round(reconfig_s, 4),
+        "reconfig_walls_s": [round(w, 4) for w in walls],
+        "reconfig_compile_events": compiles_delta,
+        "program_cache_misses_delta": int(miss_delta),
+        "knob_tweaks": knob_tweaks,
+        "decisions": decisions,
+        "program_registry": registry_stats(),
+        "compile_cache": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in compile_stats().items()
+            if not isinstance(v, dict)
+        },
+        "promoted": "sharded DynSpec operand (ISSUE 20): shape key "
+        "static, promoted knobs mesh-replicated; bit-exact vs "
+        "FNS_SPEC_PROMOTE=0 (tests/test_sharded_dynspec.py)",
+    }
+
+
 def twin_measurement() -> dict:
     """``bench.py --twin`` (ISSUE 17): the live-twin door latencies.
 
@@ -1322,6 +1492,16 @@ def reconfig_main() -> None:
     print(json.dumps(reconfig_measurement()))
 
 
+def sharded_reconfig_main(mode: str) -> None:
+    """``python bench.py --reconfig --tp`` / ``--reconfig --fleet``
+    (ISSUE 20): cold sharded compile vs zero-compile warm knob tweak on
+    the promoted TP tick / fleet scan.  Provisions BENCH_DEVICES
+    virtual CPU devices when needed, like the throughput entries."""
+    n = _env_int("BENCH_DEVICES", 8)
+    ensure_mesh_devices(n)
+    print(json.dumps(sharded_reconfig_measurement(mode, n)))
+
+
 def chaos_main() -> None:
     """``python bench.py --chaos`` (or ``BENCH_CHAOS=1``): the
     hostile-world headline — the bench world under fog churn + link
@@ -1358,7 +1538,17 @@ def fleet_main() -> None:
 if __name__ == "__main__":
     import sys
 
-    if "--fleet" in sys.argv or os.environ.get("BENCH_FLEET"):
+    _reconfig = "--reconfig" in sys.argv or os.environ.get("BENCH_RECONFIG")
+    # --reconfig composes with --tp/--fleet (ISSUE 20): the sharded
+    # warm-retune rows — checked FIRST so the modifier flags don't
+    # swallow the reconfig entry
+    if _reconfig and ("--tp" in sys.argv or os.environ.get("BENCH_TP")):
+        sharded_reconfig_main("tp")
+    elif _reconfig and (
+        "--fleet" in sys.argv or os.environ.get("BENCH_FLEET")
+    ):
+        sharded_reconfig_main("fleet")
+    elif "--fleet" in sys.argv or os.environ.get("BENCH_FLEET"):
         fleet_main()
     elif "--tp" in sys.argv or os.environ.get("BENCH_TP"):
         tp_main()
@@ -1366,7 +1556,7 @@ if __name__ == "__main__":
         chaos_main()
     elif "--hier" in sys.argv or os.environ.get("BENCH_HIER"):
         hier_main()
-    elif "--reconfig" in sys.argv or os.environ.get("BENCH_RECONFIG"):
+    elif _reconfig:
         reconfig_main()
     elif "--twin" in sys.argv or os.environ.get("BENCH_TWIN"):
         twin_main()

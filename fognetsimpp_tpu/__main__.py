@@ -330,7 +330,6 @@ def main(argv=None) -> int:
         from .twin.gates import whatif_reject_reason
 
         reason = whatif_reject_reason(
-            tp=args.tp is not None,
             fleet=args.replicas is not None or args.mesh is not None,
             promote=promote_default(),
         )
@@ -768,11 +767,29 @@ def main(argv=None) -> int:
 
     if args.tp is not None and args.serve is not None:
         # ---- sharded health plane: --serve --tp N (ISSUE 11) ----------
+        from .dynspec import promote_default
         from .parallel import make_mesh
-        from .telemetry.live import serve_tp_run
+        from .telemetry.live import HealthServer, ReconfigDoor, serve_tp_run
         from .telemetry.profile import profile_trace
 
         t0 = time.perf_counter()
+        if args.whatif is not None and spec.n_users % args.tp:
+            # pre-pad at the CLI so the --whatif fork's net matches the
+            # padded population the session runs (the runner's own
+            # padding is idempotent on an already-padded world)
+            from .parallel.taskshard import pad_users_to_multiple
+
+            spec, state, net = pad_users_to_multiple(
+                spec, state, net, args.tp
+            )
+        # live retuning (ISSUE 20): POST /reconfigure queues promoted
+        # knobs that the TP chunk loop applies at the next boundary
+        # with ZERO compile events; needs the promoted runners
+        door = server = None
+        if promote_default():
+            door = ReconfigDoor(spec)
+            server = HealthServer(port=args.serve)
+            server.set_handler(door.handle_http)
         try:
             with profile_trace(args.profile) as prof:
                 mesh = make_mesh(args.tp, axis_name="node")
@@ -784,10 +801,30 @@ def main(argv=None) -> int:
                     slo_ms=args.slo,
                     dump_dir=args.postmortem,
                     on_chunk=_announce,
+                    server=server,
+                    **(
+                        {"reconfigure": door.as_reconfigure()}
+                        if door is not None else {}
+                    ),
                 )
         except ValueError as e:
             # e.g. a policy outside the dense-broker TP family, or more
             # shards than devices: one actionable line
+            if server is not None:
+                server.close()
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        try:
+            # the --whatif one-shot forks the final sharded carry onto
+            # the knob grid: unstamp gathers it off the mesh (ISSUE 20)
+            if args.whatif is not None:
+                from .parallel.taskshard import unstamp_tp_carry
+
+                sp_w, carry = unstamp_tp_carry(spec, final)
+                wi = _whatif_extra(sp_w, carry)
+            else:
+                wi = {}
+        except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
         return _finish_serve(
@@ -795,6 +832,11 @@ def main(argv=None) -> int:
             extra={
                 "tp_shards": args.tp,
                 "n_users": spec.n_users,  # post-padding population
+                **(
+                    {"reconfigured": door.applied_batches}
+                    if door is not None else {}
+                ),
+                **wi,
             },
         )
 
@@ -807,6 +849,15 @@ def main(argv=None) -> int:
         from .telemetry.profile import profile_trace
 
         t0 = time.perf_counter()
+        if args.whatif is not None and spec.n_users % args.tp:
+            # pre-pad at the CLI so the --whatif fork's net matches the
+            # padded population the session runs (the runner's own
+            # padding is idempotent on an already-padded world)
+            from .parallel.taskshard import pad_users_to_multiple
+
+            spec, state, net = pad_users_to_multiple(
+                spec, state, net, args.tp
+            )
         try:
             with profile_trace(args.profile) as prof:
                 mesh = make_mesh(args.tp, axis_name="node")
@@ -821,11 +872,25 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
         wall = time.perf_counter() - t0
+        try:
+            # the --whatif one-shot forks the final sharded carry onto
+            # the knob grid: unstamp gathers it off the mesh (ISSUE 20)
+            if args.whatif is not None:
+                from .parallel.taskshard import unstamp_tp_carry
+
+                sp_w, carry = unstamp_tp_carry(spec, final)
+                wi = _whatif_extra(sp_w, carry)
+            else:
+                wi = {}
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         out = {
             "scenario": cfg.lookup("scenario", "smoke"),
             "wall_s": round(wall, 3),
             "tp_shards": args.tp,
             "n_users": spec.n_users,  # post-padding population
+            **wi,
         }
         outdir = args.out or cfg.lookup("output.dir")
         if outdir:
@@ -954,8 +1019,17 @@ def main(argv=None) -> int:
                 extra={"ingest": status["ingest"], **wi},
             )
 
-        from .telemetry.live import serve_run
+        from .dynspec import promote_default
+        from .telemetry.live import HealthServer, ReconfigDoor, serve_run
 
+        # live retuning (ISSUE 20): POST /reconfigure queues promoted
+        # knobs that run_chunked applies at the next chunk boundary
+        # with ZERO compile events; needs the promoted runners
+        door = server = None
+        if promote_default():
+            door = ReconfigDoor(spec)
+            server = HealthServer(port=args.serve)
+            server.set_handler(door.handle_http)
         with profile_trace(args.profile) as prof:
             final, status = serve_run(
                 spec, state, net, bounds,
@@ -964,12 +1038,19 @@ def main(argv=None) -> int:
                 slo_ms=args.slo,
                 dump_dir=args.postmortem,
                 on_chunk=_announce,
+                server=server,
+                **(
+                    {"reconfigure": door.as_reconfigure()}
+                    if door is not None else {}
+                ),
             )
         try:
             wi = _whatif_extra(spec, final)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+        if door is not None:
+            wi = {"reconfigured": door.applied_batches, **wi}
         return _finish_serve(spec, final, status, t0, prof, extra=wi)
 
     if args.replicas is not None or args.mesh is not None:

@@ -52,5 +52,6 @@ from .taskshard import (  # noqa: F401
     run_tp_chunked,
     run_tp_sharded,
     shard_state_by_node,
+    unstamp_tp_carry,
 )
 from .tp import sharded_min_busy  # noqa: F401

@@ -40,14 +40,15 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.engine import _dealias_for_donation, run
+from ..dynspec import DynSpec, promote_default, registry_note, split_spec
 from ..net.mobility import MobilityBounds
 from ..net.topology import NetParams
 from ..spec import WorldSpec
 from ..state import WorldState
-from .mesh import REPLICA_AXIS, make_mesh, shard_world
+from .mesh import REPLICA_AXIS, make_mesh, replica_sharding, shard_world
 
 
 #: The fleet's headline sharding claim, made statically checkable: the
@@ -98,12 +99,49 @@ def _check_divisible(n_replicas: int, mesh: Mesh) -> None:
 def _fleet_run(
     spec: WorldSpec, n_ticks: Optional[int], batch: WorldState,
     net: NetParams, bounds: MobilityBounds,
+    dyn_rows: Optional[DynSpec] = None,
 ) -> WorldState:
-    def run_one(s, net_, bounds_):
-        final, _ = run(spec, s, net_, bounds_, n_ticks=n_ticks)
+    def run_one(s, net_, bounds_, dyn_):
+        final, _ = run(spec, s, net_, bounds_, n_ticks=n_ticks, dyn=dyn_)
         return final
 
-    return jax.vmap(run_one, in_axes=(0, None, None))(batch, net, bounds)
+    return jax.vmap(
+        run_one,
+        in_axes=(0, None, None, 0 if dyn_rows is not None else None),
+    )(batch, net, bounds, dyn_rows)
+
+
+def _fleet_dyn_rows(
+    spec: WorldSpec, R: int, mesh: Mesh, dyn_rows, donate: bool,
+):
+    """Shared promotion front half of the fleet entries (ISSUE 20):
+    split the spec on its shape key, note the program, and return
+    ``(run_spec, dyn_rows)`` with ``dyn_rows`` leading-axis ``R`` and
+    replica-sharded like the batch.  A ``None`` ``dyn_rows`` broadcasts
+    the spec's own promoted leaves to every replica — the plain
+    promoted fleet and a ``sweep_dyn`` grid then share ONE compiled
+    program (the rows are the only difference, and they are operands).
+    """
+    run_spec, dyn = split_spec(spec)
+    registry_note(run_spec, jax.default_backend(), donated=donate)
+    if dyn_rows is None:
+        dyn_rows = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x)[None, ...], (R,) + jnp.shape(x)
+            ),
+            dyn,
+        )
+    else:
+        Rd = int(jnp.shape(jax.tree.leaves(dyn_rows)[0])[0])
+        if Rd != R:
+            raise ValueError(
+                f"dyn_rows carries {Rd} replica rows for a {R}-replica "
+                "batch — one promoted-knob row per replica"
+            )
+    leaf = replica_sharding(mesh)
+    return run_spec, jax.tree.map(
+        lambda x: jax.device_put(x, leaf(x)), dyn_rows
+    )
 
 
 def run_fleet(
@@ -114,6 +152,8 @@ def run_fleet(
     mesh: Optional[Mesh] = None,
     n_ticks: Optional[int] = None,
     donate: bool = True,
+    promote: Optional[bool] = None,
+    dyn_rows: Optional[DynSpec] = None,
 ) -> WorldState:
     """Advance every replica of ``batch`` over the mesh; returns the
     sharded final batch.
@@ -126,21 +166,42 @@ def run_fleet(
     across calls (the jit is module-level, keyed on ``(spec,
     n_ticks)``), and carry-donated by default: do not reuse ``batch``
     after calling unless ``donate=False``.
+
+    ``promote`` (default: ``FNS_SPEC_PROMOTE``, on) runs the promoted
+    program: the jit keys on the spec's SHAPE KEY and every promoted
+    knob rides a replica-sharded DynSpec row operand — so a warm knob
+    retune (or a whole ``sweep_dyn`` grid via ``dyn_rows``, one
+    promoted-leaf row per replica) re-executes the cached program with
+    ZERO compile events.  Bit-exact vs ``promote=False`` and the vmap
+    reference (``tests/test_sharded_dynspec.py``).
     """
+    if promote is None:
+        promote = promote_default()
+    if dyn_rows is not None and not promote:
+        raise ValueError(
+            "dyn_rows carries per-replica promoted knobs; it needs the "
+            "promoted path (promote=True)"
+        )
     if mesh is None:
         mesh = make_mesh()
     R = int(jnp.shape(jax.tree.leaves(batch)[0])[0])
     _check_fleet_spec(spec)
     _check_divisible(R, mesh)
     batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
+    if promote:
+        run_spec, dyn_rows = _fleet_dyn_rows(
+            spec, R, mesh, dyn_rows, donate
+        )
+    else:
+        run_spec = spec
     if not donate:
         # one donating jit entry either way (no second compile cache):
         # the keep path hands the donation a private copy, so the
         # caller's batch — typically shared with the vmap path by the
         # equivalence tests — survives
         batch = jax.tree.map(jnp.copy, batch)
-    return _fleet_run(spec, n_ticks, _dealias_for_donation(batch),
-                      net, bounds)
+    return _fleet_run(run_spec, n_ticks, _dealias_for_donation(batch),
+                      net, bounds, dyn_rows)
 
 
 # simlint: disable=R6 -- donation is semantically wrong here: the batch
@@ -152,12 +213,18 @@ def run_fleet(
 def _fleet_pipeline(
     spec: WorldSpec, n_replicas: int, batch: WorldState,
     net: NetParams, bounds: MobilityBounds, keys: jax.Array,
+    dyn: Optional[DynSpec] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     def body(_, k):
         b = batch.replace(key=fold_replica_keys(k, n_replicas))
 
         def run_one(s, net_, bounds_):
-            final, _ = run(spec, s, net_, bounds_)
+            # dyn (the replicated promoted-knob operand) is closed over:
+            # every replica of every pipelined fleet shares one spec, so
+            # one scalar set broadcasts through the vmap — and because
+            # it is a jit OPERAND, a warm knob retune re-executes this
+            # scan instead of re-tracing it
+            final, _ = run(spec, s, net_, bounds_, dyn=dyn)
             return final.metrics
 
         m = jax.vmap(run_one, in_axes=(0, None, None))(b, net, bounds)
@@ -174,6 +241,7 @@ def fleet_decisions(
     bounds: MobilityBounds,
     keys: jax.Array,
     mesh: Optional[Mesh] = None,
+    promote: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Pipelined fleet throughput kernel: ONE jitted call runs
     ``len(keys)`` complete fleets (fresh folded keys each, same compiled
@@ -186,15 +254,25 @@ def fleet_decisions(
 
     ``batch`` is a pristine template (each pipeline iteration re-keys
     it); it is NOT donated — timed callers reuse one batch across
-    repeated calls.
+    repeated calls.  Under promotion (the default) the pipeline is
+    keyed on the spec's shape key with the promoted knobs riding a
+    mesh-replicated DynSpec operand — a retuned rerun is compile-free.
     """
+    if promote is None:
+        promote = promote_default()
     if mesh is None:
         mesh = make_mesh()
     R = int(jnp.shape(jax.tree.leaves(batch)[0])[0])
     _check_fleet_spec(spec)
     _check_divisible(R, mesh)
     batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
-    return _fleet_pipeline(spec, R, batch, net, bounds, keys)
+    if promote:
+        run_spec, dyn = split_spec(spec)
+        registry_note(run_spec, jax.default_backend(), donated=False)
+        dyn = jax.device_put(dyn, NamedSharding(mesh, P()))
+    else:
+        run_spec, dyn = spec, None
+    return _fleet_pipeline(run_spec, R, batch, net, bounds, keys, dyn)
 
 
 def fleet_busy_fractions_per_replica(
@@ -275,9 +353,12 @@ def fleet_busy_fractions(
 def _fleet_series_chunk(
     spec: WorldSpec, n_ticks: int, batch: WorldState,
     net: NetParams, bounds: MobilityBounds,
+    dyn: Optional[DynSpec] = None,
 ):
     def run_one(s, net_, bounds_):
-        return run(spec, s, net_, bounds_, n_ticks=n_ticks)
+        # one replicated DynSpec set shared by every replica (closure
+        # capture broadcasts through the vmap, same as _fleet_pipeline)
+        return run(spec, s, net_, bounds_, n_ticks=n_ticks, dyn=dyn)
 
     return jax.vmap(run_one, in_axes=(0, None, None))(batch, net, bounds)
 
@@ -289,6 +370,7 @@ def run_fleet_series(
     bounds: MobilityBounds,
     mesh: Optional[Mesh] = None,
     chunk_ticks: int = 4096,
+    promote: Optional[bool] = None,
 ) -> Tuple[WorldState, Dict[str, np.ndarray]]:
     """Fleet run with per-tick series recording, chunked for bounded
     device memory.
@@ -303,19 +385,29 @@ def run_fleet_series(
     batched analog of ``run``'s series dict.  The carry is DONATED
     between chunks (do not reuse ``batch``); results are bit-identical
     to one straight ``run_replicated`` with recording
-    (``tests/test_fleet.py``).
+    (``tests/test_fleet.py``).  Promotion (the default) keys the chunk
+    program on the shape key with one mesh-replicated DynSpec operand,
+    so equal-size chunks AND warm knob retunes share one compile.
     """
     if not spec.record_tick_series:
         raise ValueError(
             "run_fleet_series needs spec.record_tick_series=True; for "
             "counters-only fleets use run_fleet"
         )
+    if promote is None:
+        promote = promote_default()
     if mesh is None:
         mesh = make_mesh()
     R = int(jnp.shape(jax.tree.leaves(batch)[0])[0])
     _check_fleet_spec(spec)
     _check_divisible(R, mesh)
     batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
+    if promote:
+        run_spec, dyn = split_spec(spec)
+        registry_note(run_spec, jax.default_backend(), donated=True)
+        dyn = jax.device_put(dyn, NamedSharding(mesh, P()))
+    else:
+        run_spec, dyn = spec, None
     total = spec.n_ticks
     chunk = min(chunk_ticks, total)
     chunks = []
@@ -323,7 +415,7 @@ def run_fleet_series(
     while done < total:
         n = min(chunk, total - done)
         batch, series = _fleet_series_chunk(
-            spec, n, _dealias_for_donation(batch), net, bounds
+            run_spec, n, _dealias_for_donation(batch), net, bounds, dyn
         )
         # host offload per chunk: frees the chunk's device buffers
         # before the next chunk runs (bounded device memory)

@@ -229,6 +229,7 @@ def sweep_dyn(
     n_replicas_per_cell: int = 1,
     seed: int = 0,
     n_ticks: Optional[int] = None,
+    mesh=None,
     **build_kwargs,
 ) -> List[Dict]:
     """Dynamic-knob grid under ONE compile (ISSUE 13).
@@ -242,6 +243,13 @@ def sweep_dyn(
     numeric knob now grids for free (a chaos-amplitude × loss-prob grid
     is one compiled program, asserted via ``_run_replicated._cache_
     size()`` in tests).
+
+    ``mesh`` (ISSUE 20) lays the same grid replica-sharded over a
+    device mesh via :func:`~fognetsimpp_tpu.parallel.fleet.run_fleet`:
+    still ONE compiled program (the per-cell DynSpec rows ride the
+    fleet runner's sharded row operand), with cells × replicas spread
+    ``R / D`` per device.  The grid size must divide the mesh — pad
+    ``n_replicas_per_cell`` to align.
 
     Every cell must land in the SAME shape bucket: a grid that crosses
     a trace gate (e.g. ``uplink_loss_prob`` values mixing 0 and 0.2)
@@ -317,9 +325,17 @@ def sweep_dyn(
         lambda *xs: jnp.repeat(jnp.stack(xs), nrc, axis=0),
         *(dyn_of(sp) for sp in cells),
     )
-    final = run_replicated(
-        key0, batch, net, bounds, n_ticks=n_ticks, dyn_rows=dyn_rows
-    )
+    if mesh is not None:
+        from .fleet import run_fleet
+
+        final = run_fleet(
+            key0, batch, net, bounds, mesh=mesh, n_ticks=n_ticks,
+            promote=True, dyn_rows=dyn_rows,
+        )
+    else:
+        final = run_replicated(
+            key0, batch, net, bounds, n_ticks=n_ticks, dyn_rows=dyn_rows
+        )
     counters = replica_counters(final)
     out: List[Dict] = []
     for i, cell in enumerate(grid):

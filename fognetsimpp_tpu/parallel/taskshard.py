@@ -101,6 +101,13 @@ from ..core.engine import (
     tp_ok,
     tp_reject_reason,
 )
+from ..dynspec import (
+    DynSpec,
+    apply_knobs,
+    promote_default,
+    registry_note,
+    split_spec,
+)
 from ..net.mobility import MobilityBounds
 from ..net.topology import LinkCache, NetParams, associate
 from ..ops.queues import (
@@ -337,6 +344,33 @@ def stamp_tp_telemetry(
             "telemetry exchange fold loses f32 integer exactness — "
             "run telemetry off at this shape or raise the shard count"
         )
+    return spec, state
+
+
+def unstamp_tp_carry(
+    spec: WorldSpec, state: WorldState
+) -> Tuple[WorldSpec, WorldState]:
+    """Gather a row-sharded TP chunk-boundary carry onto the default
+    device and re-describe it with the UNSHARDED spec — the fork point
+    of the TP what-if rail (ISSUE 20).
+
+    The what-if grid vmaps ONE device-resident carry over the knob rows
+    (:func:`fognetsimpp_tpu.parallel.sweep.fork_state`), so the TP
+    carry must leave the mesh: one host gather, ``tp_shards`` back to
+    0, and the per-shard exchange-plane telemetry leaves re-initialized
+    at the unsharded (zero-row) shape — the exchange gauges describe
+    the sharded execution substrate, not the forked world, and the
+    what-if report reads counter DELTAS that never cross the fork.
+    Padded users stay: they are inert rows, and keeping them means the
+    forked population equals the population the session actually ran.
+    """
+    state = jax.tree.map(jnp.asarray, jax.device_get(state))
+    if spec.tp_shards:
+        spec = dataclasses.replace(spec, tp_shards=0).validate()
+        if spec.telemetry:
+            state = state.replace(
+                telem=state.telem.replace(**init_exchange_leaves(spec))
+            )
     return spec, state
 
 
@@ -1005,6 +1039,7 @@ def _zero_buf(U: int, F: int) -> TickBuf:
 def _tp_tick(
     spec: WorldSpec, tp: TpCtx, state: WorldState, net: NetParams,
     cache: LinkCache, k_exchange: int, window_k: Optional[int] = None,
+    dyn: Optional[DynSpec] = None,
 ) -> WorldState:
     """One sharded tick over the LOCAL world view.
 
@@ -1017,6 +1052,14 @@ def _tp_tick(
     latency-histogram deltas (ISSUE 11) — the telemetry-OFF tick
     compiles to exactly the PR 8 program (bit-exact, per-tick
     collective count unchanged).
+
+    ``dyn`` (ISSUE 20): the promoted-knob operand, replicated across
+    the mesh axis.  On the TP-admitted family the only phases that
+    consume promoted values are the spawn pair (send/link scalars,
+    uplink loss — the chaos/hier/learn/energy subsystems are gated off
+    this tick), so the operand threads there and ``spec`` may be the
+    bucket's shape key; ``None`` keeps the spec's own values as trace
+    constants (the ``FNS_SPEC_PROMOTE=0`` reference path).
     """
     t0 = state.tick.astype(jnp.float32) * spec.dt
     t1 = (state.tick + 1).astype(jnp.float32) * spec.dt
@@ -1090,11 +1133,11 @@ def _tp_tick(
     with jax.named_scope("phase_spawn"):
         if spec.max_sends_per_tick > 1:
             state, buf_p = _phase_spawn_multi(
-                spec, state, net, cache, buf_p, t0, t1, tp=tp
+                spec, state, net, cache, buf_p, t0, t1, tp=tp, dyn=dyn
             )
         else:
             state, buf_p = _phase_spawn(
-                spec, state, net, cache, buf_p, t0, t1, tp=tp
+                spec, state, net, cache, buf_p, t0, t1, tp=tp, dyn=dyn
             )
     if telem_on:
         _book("spawn", a0, _act(state.metrics, m_rep))
@@ -1283,8 +1326,17 @@ def _tp_tick(
 def _tp_program(
     spec: WorldSpec, n_ticks: int, mesh: Mesh, axis_name: str,
     k_exchange: int, donate: bool, window_k: Optional[int] = None,
+    promoted: bool = False,
 ):
-    """Build (and cache) the jitted sharded-horizon program for ``spec``."""
+    """Build (and cache) the jitted sharded-horizon program for ``spec``.
+
+    ``promoted`` (ISSUE 20): ``spec`` is then a shape key
+    (``dynspec.shape_key``) and the program takes a trailing
+    :class:`~fognetsimpp_tpu.dynspec.DynSpec` operand, replicated
+    across the mesh axis — every world in the bucket (and every warm
+    knob retune) reuses this one cache entry, the ``run_jit`` contract
+    extended to the sharded runner.
+    """
     n = mesh.shape[axis_name]
     U_g, S = spec.n_users, spec.max_sends_per_user
     U_loc = U_g // n
@@ -1293,7 +1345,8 @@ def _tp_program(
     hist_on = spec.telemetry and spec.telemetry_hist
     jour_on = spec.journey_active
 
-    def run_shard(users, tasks, nodes_u, lat_seen, jour, rep, net, cache):
+    def run_shard(users, tasks, nodes_u, lat_seen, jour, rep, net, cache,
+                  dyn):
         shard = jax.lax.axis_index(axis_name)
         u_off = shard * U_loc
         tp = TpCtx(
@@ -1357,7 +1410,7 @@ def _tp_program(
         def tick(st, _):
             return (
                 _tp_tick(spec_l, tp, st, net_l, cache_l, k_exchange,
-                         window_k),
+                         window_k, dyn=dyn),
                 None,
             )
 
@@ -1410,11 +1463,12 @@ def _tp_program(
     def body(*args):
         users, tasks, nodes_u = args[:3]
         rest = list(args[3:k_sh])
-        rep, net, cache = args[k_sh:]
+        rep, net, cache = args[k_sh:k_sh + 3]
+        dyn = args[k_sh + 3] if promoted else None
         lat_seen = rest.pop(0) if hist_on else None
         jour = rest.pop(0) if jour_on else None
         u, t, nu, ls, jo, r = run_shard(
-            users, tasks, nodes_u, lat_seen, jour, rep, net, cache
+            users, tasks, nodes_u, lat_seen, jour, rep, net, cache, dyn
         )
         out = [u, t, nu]
         if hist_on:
@@ -1424,7 +1478,12 @@ def _tp_program(
         out.append(r)
         return tuple(out)
 
-    in_specs = (P(axis_name),) * k_sh + (P(), P(), P())
+    # the DynSpec operand (promoted) is replicated like the rep tree:
+    # every shard reads identical knob values, so the traced tick is
+    # the static program with loads where the constants were
+    in_specs = (P(axis_name),) * k_sh + (P(), P(), P()) + (
+        (P(),) if promoted else ()
+    )
     out_specs = (P(axis_name),) * k_sh + (P(),)
 
     shmapped = shard_map(
@@ -1441,11 +1500,18 @@ def _tp_program(
     # nothing and whose builder-aliased zero/full leaves (smoke seeds
     # pool_avail with the mips array itself) XLA's allocation-level
     # donation tracking rejects even after pointer-level dealiasing.
-    @functools.partial(
-        jax.jit, donate_argnums=(0,) if donate else ()
-    )
-    def go(sharded, rep, net, cache):
-        return shmapped(*sharded, rep, net, cache)
+    if promoted:
+        @functools.partial(
+            jax.jit, donate_argnums=(0,) if donate else ()
+        )
+        def go(sharded, rep, net, cache, dyn):
+            return shmapped(*sharded, rep, net, cache, dyn)
+    else:
+        @functools.partial(
+            jax.jit, donate_argnums=(0,) if donate else ()
+        )
+        def go(sharded, rep, net, cache):
+            return shmapped(*sharded, rep, net, cache)
 
     return go
 
@@ -1506,6 +1572,7 @@ def run_tp_sharded(
     donate: bool = False,
     pad: bool = True,
     stamp: bool = True,
+    promote: Optional[bool] = None,
 ) -> Tuple[WorldSpec, WorldState]:
     """Advance ONE world whose user/task axis spans the mesh.
 
@@ -1545,14 +1612,26 @@ def run_tp_sharded(
     exchange leaves; phase attribution and the latency histogram still
     book).  :func:`run_node_sharded` uses it to keep its
     single-return dispatch API consistent.
+
+    ``promote`` (ISSUE 20, default on; ``FNS_SPEC_PROMOTE=0`` flips the
+    default): the sharded program takes the promoted knobs as a
+    replicated DynSpec operand, keyed on the spec's shape key — a warm
+    retune of any promoted knob (loss probabilities, send/link
+    scalars...) re-uses the compiled program with ZERO compile events,
+    exactly the ``run_jit`` contract.  ``promote=False`` is the
+    bit-exact static reference path (tests/test_sharded_dynspec.py
+    A/Bs the two).
     """
     del bounds  # static worlds only (tp gate): mobility never runs
-    go, parts, net_r, cache_r, spec = _tp_setup(
+    go, parts, net_r, cache_r, spec, dyn = _tp_setup(
         spec, state, net, mesh, n_ticks, axis_name, exchange_window,
-        donate, pad, stamp,
+        donate, pad, stamp, promote,
     )
     with _donation_safe_compile(donate):
-        out = go(*parts, net_r, cache_r)
+        if dyn is not None:
+            out = go(*parts, net_r, cache_r, dyn)
+        else:
+            out = go(*parts, net_r, cache_r)
     users, tasks, nodes_u_f, rep = out[0], out[1], out[2], out[-1]
     telem = rep["telem"]
     i = 3
@@ -1598,6 +1677,8 @@ def run_tp_chunked(
     axis_name: str = NODE_AXIS,
     exchange_window: Optional[int] = None,
     donate: bool = True,
+    promote: Optional[bool] = None,
+    reconfigure: Optional[Callable[[int], Optional[dict]]] = None,
 ) -> Tuple[WorldSpec, WorldState]:
     """TP analog of ``engine.run_chunked``: the sharded horizon in
     fixed-size chunks, ``callback(state, ticks_done)`` between chunks.
@@ -1617,7 +1698,25 @@ def run_tp_chunked(
     callback may read the PASSED state freely (the fetch completes
     before the next chunk consumes it) but must not retain device
     references across chunks.
+
+    ``promote`` / ``reconfigure`` (ISSUE 20, the sharded what-if door):
+    with promotion on (the default), ``reconfigure(ticks_done)`` —
+    called at every INTERIOR chunk boundary, after ``callback`` — may
+    return a ``{field: value}`` dict of promoted WorldSpec knobs to
+    apply to the remaining horizon with ZERO recompiles: the knobs
+    land in the spec (``dynspec.apply_knobs`` rejects shape-key
+    changes with a one-line error), the next chunk's ``_tp_setup``
+    re-splits it onto the SAME shape bucket, and the cached sharded
+    program re-runs with new operand values only
+    (``compile_stats()``-delta-provable, gated in ``bench_trend``).
     """
+    if promote is None:
+        promote = promote_default()
+    if reconfigure is not None and not promote:
+        raise ValueError(
+            "reconfigure re-configures the DynSpec operand between "
+            "chunks; it needs the promoted path (promote=True)"
+        )
     total = spec.n_ticks if n_ticks is None else n_ticks
     chunk = max(1, min(chunk_ticks, total))
     done = 0
@@ -1626,11 +1725,19 @@ def run_tp_chunked(
         spec, state = run_tp_sharded(
             spec, state, net, bounds, mesh, n_ticks=ticks,
             axis_name=axis_name, exchange_window=exchange_window,
-            donate=donate,
+            donate=donate, promote=promote,
         )
         done += ticks
         if callback is not None:
             callback(state, done)
+        if reconfigure is not None and done < total:
+            knobs = reconfigure(done)
+            if knobs:
+                # compile-free by construction: apply_knobs rejects any
+                # change that would leave the shape bucket, and the next
+                # chunk re-uses the cached sharded program with the new
+                # operand values only
+                spec = apply_knobs(spec, knobs)
     return spec, state
 
 
@@ -1645,11 +1752,20 @@ def _tp_setup(
     donate: bool,
     pad: bool,
     stamp: bool = True,
+    promote: Optional[bool] = None,
 ):
     """Shared front half of :func:`run_tp_sharded`: gate, pad, place,
     build the jitted program.  ``tools/hloaudit``/``tools/op_budget``
     call this too and ``.lower(...).compile()`` the returned program —
     so the audited artifact IS the production program, never a twin.
+
+    Returns ``(go, (sharded, rep), net_r, cache_r, spec, dyn)`` where
+    ``dyn`` is the replicated DynSpec operand under promotion (append
+    it to the call: ``go(*parts, net_r, cache_r, dyn)``) and ``None``
+    on the static path (``FNS_SPEC_PROMOTE=0`` or ``promote=False``).
+    Under promotion the program is keyed on the padded/stamped spec's
+    SHAPE KEY (``dynspec.split_spec``), so every world in the bucket —
+    and every warm knob retune — lands on one ``_tp_program`` entry.
     """
     spec.validate()
     reason = tp_reject_reason(spec)
@@ -1695,6 +1811,19 @@ def _tp_setup(
 
     if stamp:
         spec, state = stamp_tp_telemetry(spec, state, n)
+
+    # ---- DynSpec operand promotion (ISSUE 20) -------------------------
+    # Split AFTER pad/stamp so the shape key describes the world the
+    # program actually runs (padded population, stamped shard axis);
+    # dyn leaves are population-independent, so one host-side dyn_of
+    # covers every shard's local view.
+    if promote is None:
+        promote = promote_default()
+    if promote:
+        run_spec, dyn = split_spec(spec)
+        registry_note(run_spec, jax.default_backend(), donated=donate)
+    else:
+        run_spec, dyn = spec, None
 
     # the run-constant association/delay cache (assume_static is part of
     # the TP gate), computed once OUTSIDE the audited sharded program
@@ -1763,12 +1892,17 @@ def _tp_setup(
     )
     net_r = replicated(net)
     cache_r = replicated(cache)
+    if dyn is not None:
+        dyn = replicated(dyn)
     if donate:
         from ..core.engine import _dealias_for_donation
 
         sharded = _dealias_for_donation(sharded)
-    go = _tp_program(spec, ticks, mesh, axis_name, k_ex, donate, window_k)
-    return go, (sharded, rep), net_r, cache_r, spec
+    go = _tp_program(
+        run_spec, ticks, mesh, axis_name, k_ex, donate, window_k,
+        promoted=promote,
+    )
+    return go, (sharded, rep), net_r, cache_r, spec, dyn
 
 
 # ----------------------------------------------------------------------

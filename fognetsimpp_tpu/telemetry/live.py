@@ -521,6 +521,132 @@ class HealthServer:
         self._httpd.server_close()
 
 
+class ReconfigDoor:
+    """The live session's retune endpoint: queued promoted-knob changes.
+
+    ``POST /reconfigure`` with ``{"knobs": {"<field>": value, ...}}``
+    or the CLI's own ``--set`` shape, ``{"set":
+    ["spec.<field>=<value>", ...]}``.  Knobs are validated EAGERLY
+    against the door's live-spec shadow
+    (:func:`fognetsimpp_tpu.dynspec.apply_knobs`: unknown fields,
+    shape-defining fields and trace-gate flips answer 400 with the
+    one-line error — the serving loop never sees them) and queued; the
+    chunk runner pops the queue at the next chunk boundary via
+    :meth:`as_reconfigure`.  An accepted retune therefore costs ZERO
+    compile events on the promoted runners, and every accepted field
+    answers ``"recompile": "no"`` — the CLI ``--set`` classification,
+    served over HTTP.
+
+    One door serves both substrates unchanged (ISSUE 20):
+    ``serve_run`` → ``run_chunked`` and ``serve_tp_run`` →
+    ``run_tp_chunked`` take the same ``reconfigure`` hook.  The POST
+    thread only ever touches the spec shadow and the queue under the
+    door lock; the chunk loop applies knobs between chunks, so a
+    mid-chunk POST races nothing and lands one boundary later.
+    """
+
+    def __init__(self, spec: WorldSpec):
+        self._lock = threading.Lock()
+        self._spec = spec
+        self._pending: Dict = {}
+        self.accepted = 0
+        self.rejected = 0
+        self.applied_batches = 0
+
+    # ---- HTTP (the HealthServer route hook) --------------------------
+    def handle_http(self, method: str, path: str, body: bytes):
+        """``POST /reconfigure`` handler; None for any other route."""
+        if not path.split("?", 1)[0].rstrip("/").endswith("/reconfigure"):
+            return None
+        if method != "POST":
+            with self._lock:
+                pending = sorted(self._pending)
+            return (
+                200, "application/json",
+                json.dumps({
+                    "usage": 'POST {"knobs": {"<promoted field>": '
+                             'value, ...}} or {"set": '
+                             '["spec.<field>=<value>", ...]}',
+                    "pending": pending,
+                }) + "\n",
+            )
+        status, payload = self._post(body)
+        return (status, "application/json", json.dumps(payload) + "\n")
+
+    def _post(self, body: bytes):
+        from ..dynspec import apply_knobs, classify_field
+
+        try:
+            doc = json.loads(body.decode() or "null")
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            return 400, {"error": f"invalid JSON ({e})"}
+        if not isinstance(doc, dict):
+            return 400, {
+                "error": "POST a JSON object with 'knobs' and/or 'set'"
+            }
+        knobs = dict(doc.get("knobs") or {})
+        for item in doc.get("set") or []:
+            if not isinstance(item, str) or "=" not in item:
+                return 400, {
+                    "error": "'set' entries are 'spec.<field>=<value>' "
+                             f"strings, got {item!r}"
+                }
+            key, val = item.split("=", 1)
+            key = key.strip()
+            if key.startswith("spec."):
+                key = key[5:]
+            try:
+                knobs[key] = json.loads(val.strip())
+            except json.JSONDecodeError:
+                return 400, {
+                    "error": f"could not parse the value for {key!r}: "
+                             f"{val.strip()!r}"
+                }
+        if not knobs:
+            return 400, {
+                "error": "no knobs given: pass 'knobs' (field->value "
+                         "object) and/or 'set' (a list of "
+                         "'spec.<field>=<value>' strings)"
+            }
+        bad = [
+            k for k, v in knobs.items()
+            if isinstance(v, bool) or not isinstance(v, (int, float))
+        ]
+        if bad:
+            return 400, {"error": f"knob {bad[0]!r} needs a number"}
+        with self._lock:
+            try:
+                # the shadow accumulates accepted retunes, so gate
+                # checks always run against the values the loop will
+                # actually be carrying at the next boundary
+                self._spec = apply_knobs(self._spec, knobs)
+            except ValueError as e:
+                self.rejected += 1
+                return 400, {"error": str(e)}
+            self._pending.update(knobs)
+            pending = sorted(self._pending)
+        self.accepted += 1
+        return 200, {
+            "accepted": {k: knobs[k] for k in sorted(knobs)},
+            "recompile": "no",
+            "why": {k: classify_field(k)[1] for k in sorted(knobs)},
+            "pending": pending,
+        }
+
+    def as_reconfigure(self) -> Callable[[int], Optional[Dict]]:
+        """The chunk-boundary hook: pops the queued knobs (applied once)."""
+
+        def reconfigure(ticks_done: int) -> Optional[Dict]:
+            with self._lock:
+                if not self._pending:
+                    return None
+                knobs, self._pending = self._pending, {}
+            self.applied_batches += 1
+            return knobs
+
+        return reconfigure
+
+
 def serve_run(
     spec: WorldSpec,
     state,
@@ -570,13 +696,15 @@ def serve_run(
     triggers still fire).
 
     ``reconfigure`` (ISSUE 13, the live what-if door): forwarded to
-    ``run_chunked`` — called at every chunk boundary with the tick
+    the chunk runner — called at every chunk boundary with the tick
     count, may return a dict of PROMOTED WorldSpec knobs (chaos
     amplitudes, loss probabilities, energy budgets...) to apply to the
     remaining horizon with zero recompiles, so an operator can steer a
     live twin between scrapes without ever paying the compile wall.
-    Only the default ``run_chunked`` runner supports it (the TP chunk
-    runner gates promotion off).
+    The default ``run_chunked`` runner and any ``run_fn`` with an
+    explicit ``reconfigure`` parameter support it (``serve_tp_run``'s
+    TP chunk runner does, since the ISSUE 20 operand promotion); a
+    ``run_fn`` without the parameter still raises up front.
 
     ``inject`` / ``ingest`` (ISSUE 17, the digital-twin input door):
     ``inject`` is forwarded to ``run_chunked``'s chunk-boundary hook
@@ -590,10 +718,21 @@ def serve_run(
     default ``run_chunked`` runner.
     """
     if reconfigure is not None and run_fn is not None:
-        raise ValueError(
-            "reconfigure rides run_chunked's DynSpec operand; custom "
-            "run_fn runners (the TP chunk loop) do not take it"
-        )
+        # a runner opts in by NAMING the parameter (VAR_KEYWORD does not
+        # count: swallowing the hook silently would serve stale knobs)
+        import inspect
+
+        try:
+            _params = inspect.signature(run_fn).parameters
+        except (TypeError, ValueError):
+            _params = {}
+        if "reconfigure" not in _params:
+            raise ValueError(
+                "reconfigure rides the chunk runner's DynSpec operand; "
+                "this run_fn runner does not take it (declare an "
+                "explicit reconfigure= parameter, like the TP chunk "
+                "loop's)"
+            )
     if inject is not None and run_fn is not None:
         raise ValueError(
             "inject rides run_chunked's chunk-boundary hook; custom "
@@ -848,10 +987,17 @@ def serve_tp_run(
         spec, state, net = pad_users_to_multiple(spec, state, net, n_shards)
     spec, state = stamp_tp_telemetry(spec, state, n_shards)
 
-    def _runner(sp, st, nt, bd, chunk_ticks, callback):
-        _, final = run_tp_chunked(
+    # the chunk loop applies reconfigure knobs to the live spec between
+    # chunks (ISSUE 20, zero compile events — the promoted TP program
+    # re-runs with new operand values); capture the retuned spec so the
+    # caller's returned spec describes the state it actually served
+    live = {"spec": spec}
+
+    def _runner(sp, st, nt, bd, chunk_ticks, callback, reconfigure=None):
+        live["spec"], final = run_tp_chunked(
             sp, st, nt, bd, mesh, chunk_ticks=chunk_ticks,
             callback=callback, exchange_window=exchange_window,
+            reconfigure=reconfigure,
         )
         return final
 
@@ -864,4 +1010,4 @@ def serve_tp_run(
         **kw,
     )
     status["tp_shards"] = n_shards
-    return spec, final, status
+    return live["spec"], final, status

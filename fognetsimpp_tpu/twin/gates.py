@@ -52,16 +52,15 @@ def ingest_needs_serve_error() -> str:
 
 
 def whatif_reject_reason(
-    *, fleet: bool = False, promote: bool = True, tp: bool = False
+    *, fleet: bool = False, promote: bool = True
 ) -> Optional[str]:
-    """Why a what-if fork cannot be served (``None`` = it can)."""
-    if tp:
-        return (
-            "[TWIN-WHATIF-TP] what-if forks vmap ONE device-resident "
-            "carry over the knob grid; the TP runner's row-sharded "
-            "carry cannot fork into the replica batch — answer "
-            "what-ifs from an unsharded session (drop --tp)"
-        )
+    """Why a what-if fork cannot be served (``None`` = it can).
+
+    The TP clause ([TWIN-WHATIF-TP]) was deleted by ISSUE 20: a TP
+    chunk-boundary carry now leaves the mesh through
+    ``parallel.taskshard.unstamp_tp_carry`` and forks onto the knob
+    grid like any single-device carry.
+    """
     if fleet:
         return (
             "[TWIN-WHATIF-FLEET] what-if forks already vmap the live "

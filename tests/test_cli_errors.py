@@ -6,6 +6,8 @@ Composition rejections assert the bracketed clause ID ([TP-CHAOS],
 [CLI-SWEEP-*], ...) rather than the prose: the ID is the stable
 machine-parseable contract (tools/featmat extracts the composition
 matrix from it), the wording may change freely."""
+import json
+
 import pytest
 
 from fognetsimpp_tpu.__main__ import main
@@ -367,6 +369,30 @@ def test_set_prints_recompile_classification(capsys):
     assert "shape-defining" in lines[1]
 
 
+def test_set_under_tp_prints_recompile_no(capsys):
+    """Promoted knobs keep their 'recompile: no' classification under
+    --tp (ISSUE 20): the sharded runner reads them from the DynSpec
+    operand, so a --set retune reuses the compiled TP program."""
+    rc = main([
+        "--scenario", "smoke",
+        "--set", "scenario.n_users=16",
+        "--set", "scenario.horizon=0.002",
+        "--set", "spec.send_stop_time=0.001",
+        "--tp", "8",
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    lines = [
+        ln for ln in captured.err.splitlines()
+        if ln.startswith("recompile:")
+    ]
+    assert len(lines) == 1
+    assert lines[0].startswith("recompile: no (spec.send_stop_time:")
+    assert "dynamic operand" in lines[0]
+    out = json.loads(captured.out.splitlines()[-1])
+    assert out["tp_shards"] == 8
+
+
 def test_set_unknown_spec_field_is_clear_error(capsys):
     rc = main(["--scenario", "smoke", "--set", "spec.bogus_knob=1"])
     captured = capsys.readouterr()
@@ -461,12 +487,24 @@ def test_ingest_capacity_below_one_is_clear_error(capsys):
     assert "capacity must be >= 1" in capsys.readouterr().err
 
 
-def test_whatif_with_tp_is_clear_error(capsys):
-    with pytest.raises(SystemExit) as e:
-        main(["--scenario", "smoke",
-              "--whatif", "uplink_loss_prob=0.1", "--tp", "8"])
-    assert e.value.code == 2
-    assert "[TWIN-WHATIF-TP]" in capsys.readouterr().err
+@pytest.mark.slow  # compiles a (tiny) TP program + the what-if grid:
+#   the [TWIN-WHATIF-TP] wall was deleted by ISSUE 20 — the positive
+#   path is gated here, the bit-exactness contract in
+#   tests/test_sharded_dynspec.py
+def test_whatif_with_tp_runs(capsys):
+    """--whatif now rides --tp: the chunk-boundary carry leaves the
+    mesh through unstamp_tp_carry and answers the grid."""
+    rc = main(["--scenario", "smoke",
+               "--set", "scenario.n_users=16",
+               "--set", "scenario.horizon=0.01",
+               "--set", "spec.uplink_loss_prob=0.05",
+               "--whatif", "uplink_loss_prob=0.1,0.2 ticks=5",
+               "--tp", "8"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "[TWIN-WHATIF-TP]" not in captured.err
+    out = json.loads(captured.out.splitlines()[-1])
+    assert out["whatif"]["n_cells"] == 2
 
 
 def test_whatif_with_replicas_is_clear_error(capsys):

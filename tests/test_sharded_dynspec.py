@@ -1,0 +1,464 @@
+"""ISSUE 20 — zero-recompile sharded runners: DynSpec promotion on the
+TP and fleet paths.
+
+The acceptance contract: the shard_map'd TP tick and the fleet scan
+take ``(shape_key static, DynSpec operand)`` exactly like ``run_jit``
+— bit-exact by construction vs the ``FNS_SPEC_PROMOTE=0`` static path
+AND vs the single-device reference, warm knob retunes compile ZERO
+programs (asserted on the runners' own program caches, with
+``compile_cache.delta_since`` as belt-and-suspenders), chunk-boundary
+``reconfigure=`` composes exactly like manual ``apply_knobs`` between
+``run_tp_sharded`` calls, a ``sweep_dyn(mesh=)`` grid is ONE compiled
+fleet program, and a TP chunk-boundary carry leaves the mesh through
+``unstamp_tp_carry`` and forks onto a what-if grid like any
+single-device carry (the deleted ``[TWIN-WHATIF-TP]`` wall).
+
+Donated TP dyn-operand programs route through
+``_donation_safe_compile`` (the PR 17 persistent-cache aliasing bomb):
+the regression here re-chunks a promoted donated carry after dropping
+the in-memory program cache, the exact shape that corrupted when a
+deserialized executable lost its donation aliasing.
+"""
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fognetsimpp_tpu import Policy, compile_cache, run
+from fognetsimpp_tpu.core.engine import run_chunked, run_jit
+from fognetsimpp_tpu.dynspec import apply_knobs, split_spec
+from fognetsimpp_tpu.parallel import (
+    make_mesh,
+    replicate_state,
+    run_fleet,
+    run_tp_chunked,
+    run_tp_sharded,
+    sweep_dyn,
+    unstamp_tp_carry,
+)
+from fognetsimpp_tpu.parallel import taskshard
+from fognetsimpp_tpu.parallel.fleet import _fleet_run
+from fognetsimpp_tpu.scenarios import smoke
+from fognetsimpp_tpu.telemetry.health import state_hash
+from fognetsimpp_tpu.telemetry.live import ReconfigDoor
+from fognetsimpp_tpu.twin.whatif import run_whatif
+
+
+def _hash(s) -> str:
+    return state_hash(jax.device_get(s))
+
+
+def _copy(s):
+    return jax.tree.map(jnp.copy, s)
+
+
+#: TP worlds are built with ``send_stop_time`` FINITE (gate on): the
+#: retune tests then stay inside the finite-vs-inf trace gate, and the
+#: knob demonstrably changes results (cutting sends mid-horizon).
+SMALL = dict(
+    n_users=16, n_fogs=3, send_interval=0.01, horizon=0.2,
+    start_time_max=0.05, send_stop_time=0.12,
+)
+
+#: The three dense-broker policy-family worlds the TP tick admits
+#: (test_tp.py's acceptance families).
+TP_WORLDS = [
+    dict(policy=int(Policy.MIN_BUSY)),
+    dict(policy=int(Policy.MIN_LATENCY), send_interval_jitter=0.1),
+    dict(policy=int(Policy.MAX_MIPS)),
+]
+
+#: The three policy-family worlds of the ISSUE 13 acceptance gate
+#: (test_dynspec.py's FAMILIES) — the fleet admits all of them.
+FLEET_FAMILIES = {
+    "argmin_chaos": dict(
+        chaos=True, chaos_mtbf_s=0.01, chaos_mttr_s=0.005,
+        chaos_mode=1, chaos_rtt_amp=0.5, chaos_rtt_period_s=0.7,
+        chaos_rtt_burst_prob=0.1, chaos_rtt_burst_mult=3.0,
+        chaos_max_retries=2, uplink_loss_prob=0.05,
+    ),
+    "learned_ducb": dict(
+        policy=9, learn_discount=0.99, learn_reward_scale=0.3,
+    ),
+    "pool_v2_energy": dict(
+        policy=5, app_gen=2, fog_model=1, broker_mips=3000.0,
+        v2_local_broker=True, required_time=0.01, energy_enabled=True,
+        idle_power_w=3e-3, harvest_duty=0.4,
+    ),
+}
+
+
+def _build(**kw):
+    args = dict(SMALL)
+    args.update(kw)
+    return smoke.build(**args)
+
+
+def _build_fleet(**kw):
+    kw.setdefault("n_users", 32)
+    kw.setdefault("n_fogs", 4)
+    kw.setdefault("horizon", 0.05)
+    kw.setdefault("send_interval", 5e-3)
+    return smoke.build(**kw)
+
+
+@pytest.fixture(scope="module")
+def node_mesh():
+    assert len(jax.devices()) == 8, "conftest must provision 8 devices"
+    return make_mesh(8, axis_name="node")
+
+
+@pytest.fixture(scope="module")
+def replica_mesh():
+    return make_mesh(8)
+
+
+def _tp(spec, state, net, bounds, mesh, **kw):
+    kw.setdefault("donate", True)
+    return run_tp_sharded(spec, _copy(state), net, bounds, mesh, **kw)
+
+
+# ----------------------------------------------------------------------
+# TP: promoted == static == single-device reference
+# ----------------------------------------------------------------------
+
+def test_tp_promoted_bitexact_vs_static(node_mesh):
+    """State-hash A/B over the three dense policy-family worlds: the
+    promoted TP tick == the FNS_SPEC_PROMOTE=0 static TP tick == the
+    single-device reference; the first world also pins run_jit and
+    run_chunked (the remaining single-device entries)."""
+    for i, kw in enumerate(TP_WORLDS):
+        spec, state, net, bounds = _build(**kw)
+        ref, _ = run(spec, _copy(state), net, bounds)
+        spec_p, prom = _tp(spec, state, net, bounds, node_mesh,
+                           promote=True)
+        _, stat = _tp(spec, state, net, bounds, node_mesh,
+                      promote=False)
+        assert _hash(ref) == _hash(prom), kw
+        assert _hash(prom) == _hash(stat), kw
+        assert spec_p == spec
+        if i == 0:
+            jit_ref = run_jit(spec, _copy(state), net, bounds)
+            assert _hash(jit_ref) == _hash(prom)
+            chunk_ref = run_chunked(
+                spec, _copy(state), net, bounds,
+                chunk_ticks=spec.n_ticks // 2,
+            )
+            assert _hash(chunk_ref) == _hash(prom)
+
+
+def test_tp_env_optout_matches_promoted(monkeypatch, node_mesh):
+    """FNS_SPEC_PROMOTE=0 reverts the TP runner (promote=None resolves
+    to the static path) with identical results."""
+    spec, state, net, bounds = _build(**TP_WORLDS[0])
+    _, prom = _tp(spec, state, net, bounds, node_mesh, promote=True)
+    monkeypatch.setenv("FNS_SPEC_PROMOTE", "0")
+    _, off = _tp(spec, state, net, bounds, node_mesh)  # promote=None
+    assert _hash(prom) == _hash(off)
+
+
+# ----------------------------------------------------------------------
+# TP: warm retune = zero compiles, and the retune has effect
+# ----------------------------------------------------------------------
+
+def test_tp_warm_retune_zero_compiles(node_mesh):
+    """Retuning a promoted knob on the warm TP program compiles ZERO
+    programs (the lru program cache does not miss), changes the result,
+    and matches the static path's fresh recompile bit-for-bit."""
+    spec, state, net, bounds = _build(**TP_WORLDS[0])
+    _, base = _tp(spec, state, net, bounds, node_mesh, promote=True)
+    spec2 = apply_knobs(spec, {"send_stop_time": 0.04})
+    info0 = taskshard._tp_program.cache_info()
+    before = compile_cache.snapshot()
+    _, got = _tp(spec2, state, net, bounds, node_mesh, promote=True)
+    assert taskshard._tp_program.cache_info().misses == info0.misses, (
+        "warm promoted retune recompiled the TP program"
+    )
+    assert compile_cache.delta_since(before)["compiles"] == 0
+    # the retuned knob is not decorative: cutting send_stop_time
+    # mid-horizon changes the trajectory
+    assert _hash(got) != _hash(base)
+    # and the promoted retune equals a static-path recompile
+    _, ref = _tp(spec2, state, net, bounds, node_mesh, promote=False)
+    assert _hash(got) == _hash(ref)
+
+
+def test_tp_chunked_reconfigure_composes(node_mesh):
+    """``run_tp_chunked(reconfigure=)`` retunes at the chunk boundary
+    with zero compile events, equals the manual apply_knobs-between-
+    run_tp_sharded-calls composition, and refuses the static path."""
+    spec, state, net, bounds = _build(**TP_WORLDS[0])
+    n = spec.n_ticks
+    assert n % 2 == 0
+    calls = []
+
+    def reconf(done):
+        calls.append(done)
+        return {"send_stop_time": 0.04}
+
+    info0 = taskshard._tp_program.cache_info()
+    sp_f, got = run_tp_chunked(
+        spec, _copy(state), net, bounds, node_mesh,
+        chunk_ticks=n // 2, promote=True, reconfigure=reconf,
+    )
+    # interior boundary only: the final boundary retunes nothing
+    assert calls == [n // 2]
+    assert float(sp_f.send_stop_time) == pytest.approx(0.04)
+    # both chunks (and the retuned second chunk) reuse ONE program
+    assert taskshard._tp_program.cache_info().misses \
+        <= info0.misses + 1
+    spec_a, half = _tp(spec, state, net, bounds, node_mesh,
+                       n_ticks=n // 2, promote=True)
+    spec_b = apply_knobs(spec_a, {"send_stop_time": 0.04})
+    _, full = run_tp_sharded(
+        spec_b, half, net, bounds, node_mesh, n_ticks=n // 2,
+        donate=True, promote=True,
+    )
+    assert _hash(got) == _hash(full)
+    with pytest.raises(ValueError, match="promote"):
+        run_tp_chunked(
+            spec, _copy(state), net, bounds, node_mesh,
+            chunk_ticks=n // 2, promote=False, reconfigure=reconf,
+        )
+
+
+def test_tp_donated_promoted_program_keeps_aliases(node_mesh):
+    """PR 17 regression, promoted edition: a donated TP dyn-operand
+    program must compile through ``_donation_safe_compile`` — after
+    dropping the in-memory program cache (so a persistent-cache hit
+    would otherwise deserialize an alias-stripped executable), the
+    re-chunked promoted run still aliases its donated carry and stays
+    bit-exact."""
+    spec, state, net, bounds = _build(**TP_WORLDS[0])
+    ref, _ = run(spec, _copy(state), net, bounds)
+    _, first = run_tp_chunked(
+        spec, _copy(state), net, bounds, node_mesh,
+        chunk_ticks=spec.n_ticks // 2, promote=True,
+    )
+    taskshard._tp_program.cache_clear()
+    _, again = run_tp_chunked(
+        spec, _copy(state), net, bounds, node_mesh,
+        chunk_ticks=spec.n_ticks // 2, promote=True,
+    )
+    assert _hash(ref) == _hash(first) == _hash(again)
+    # the compiled promoted program really does alias the donated carry
+    go, parts, net_r, cache_r, _, dyn = taskshard._tp_setup(
+        spec, _copy(state), net, node_mesh, spec.n_ticks, "node",
+        None, True, True, promote=True,
+    )
+    assert dyn is not None
+    txt = go.lower(*parts, net_r, cache_r, dyn).compile().as_text()
+    assert "input_output_alias" in txt
+
+
+# ----------------------------------------------------------------------
+# fleet: promoted == static, warm retune = zero compiles
+# ----------------------------------------------------------------------
+
+def test_fleet_promoted_bitexact_vs_static(replica_mesh):
+    """State-hash A/B over the three policy-family worlds: the
+    promoted fleet scan (per-replica DynSpec rows) == the
+    FNS_SPEC_PROMOTE=0 static fleet scan."""
+    for name, kw in FLEET_FAMILIES.items():
+        spec, state, net, bounds = _build_fleet(**kw)
+        batch = replicate_state(spec, state, 8, seed=3)
+        ref = run_fleet(spec, _copy(batch), net, bounds, replica_mesh,
+                        promote=False)
+        got = run_fleet(spec, _copy(batch), net, bounds, replica_mesh,
+                        promote=True)
+        assert _hash(ref) == _hash(got), name
+
+
+def test_fleet_env_optout_matches_promoted(monkeypatch, replica_mesh):
+    spec, state, net, bounds = _build_fleet(
+        **FLEET_FAMILIES["argmin_chaos"]
+    )
+    batch = replicate_state(spec, state, 8, seed=3)
+    prom = run_fleet(spec, _copy(batch), net, bounds, replica_mesh,
+                     promote=True)
+    monkeypatch.setenv("FNS_SPEC_PROMOTE", "0")
+    off = run_fleet(spec, _copy(batch), net, bounds, replica_mesh)
+    assert _hash(prom) == _hash(off)
+
+
+def test_fleet_warm_retune_zero_compiles(replica_mesh):
+    """A same-bucket knob retune on the warm promoted fleet program
+    compiles nothing (the jit cache does not grow) and matches the
+    static path's fresh recompile."""
+    spec, state, net, bounds = _build_fleet(
+        **FLEET_FAMILIES["argmin_chaos"]
+    )
+    batch = replicate_state(spec, state, 8, seed=3)
+    run_fleet(spec, _copy(batch), net, bounds, replica_mesh,
+              promote=True)
+    size0 = _fleet_run._cache_size()
+    before = compile_cache.snapshot()
+    spec2 = apply_knobs(
+        spec, {"uplink_loss_prob": 0.4, "chaos_rtt_amp": 0.25}
+    )
+    got = run_fleet(spec2, _copy(batch), net, bounds, replica_mesh,
+                    promote=True)
+    assert _fleet_run._cache_size() == size0, (
+        "warm promoted fleet retune compiled a new program"
+    )
+    assert compile_cache.delta_since(before)["compiles"] == 0
+    ref = run_fleet(spec2, _copy(batch), net, bounds, replica_mesh,
+                    promote=False)
+    assert _hash(got) == _hash(ref)
+
+
+def test_fleet_dyn_rows_require_promote(replica_mesh):
+    spec, state, net, bounds = _build_fleet()
+    batch = replicate_state(spec, state, 8, seed=3)
+    _, dyn = split_spec(spec)
+    rows = jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            jnp.asarray(x)[None, ...], (8,) + jnp.shape(x)
+        ),
+        dyn,
+    )
+    with pytest.raises(ValueError, match="promote"):
+        run_fleet(spec, _copy(batch), net, bounds, replica_mesh,
+                  promote=False, dyn_rows=rows)
+
+
+# ----------------------------------------------------------------------
+# sweep_dyn(mesh=): one sharded compile, vmap-identical cells
+# ----------------------------------------------------------------------
+
+def test_sweep_dyn_mesh_single_compile(replica_mesh):
+    """A ``sweep_dyn`` grid laid over the mesh is ONE fleet compile,
+    and every cell's counters equal the unsharded vmap grid's."""
+    kw = dict(
+        n_users=16, n_fogs=4, horizon=0.02, send_interval=2.5e-3,
+        **FLEET_FAMILIES["argmin_chaos"],
+    )
+    knobs = {
+        "chaos_rtt_amp": [0.25, 0.5],
+        "uplink_loss_prob": [0.05, 0.1],
+    }
+    size0 = _fleet_run._cache_size()
+    grid = sweep_dyn(
+        smoke.build, knobs, n_replicas_per_cell=2,
+        mesh=replica_mesh, **kw,
+    )
+    assert len(grid) == 4
+    assert _fleet_run._cache_size() == size0 + 1, (
+        "the sharded grid must be ONE compiled fleet program"
+    )
+    # warm re-ask: zero compiles
+    before = compile_cache.snapshot()
+    sweep_dyn(
+        smoke.build, knobs, n_replicas_per_cell=2,
+        mesh=replica_mesh, **kw,
+    )
+    assert _fleet_run._cache_size() == size0 + 1
+    assert compile_cache.delta_since(before)["compiles"] == 0
+    ref = sweep_dyn(smoke.build, knobs, n_replicas_per_cell=2, **kw)
+    for cell_s, cell_r in zip(grid, ref):
+        for k in knobs:
+            assert cell_s[k] == cell_r[k]
+        for k, v in cell_r["counters"].items():
+            assert np.array_equal(
+                np.asarray(cell_s["counters"][k]), np.asarray(v)
+            ), k
+
+
+# ----------------------------------------------------------------------
+# TP what-if: the deleted [TWIN-WHATIF-TP] wall
+# ----------------------------------------------------------------------
+
+def test_tp_whatif_fork_matches_cold_runs(node_mesh):
+    """A promoted TP chunk-boundary carry leaves the mesh through
+    ``unstamp_tp_carry`` and answers a what-if grid whose every cell is
+    bit-identical to a direct single-device run of the retuned spec
+    from the same carry."""
+    spec, state, net, bounds = _build(**TP_WORLDS[0])
+    n = spec.n_ticks
+    spec_tp, carry_sh = _tp(
+        spec, state, net, bounds, node_mesh, n_ticks=n // 2,
+        promote=True,
+    )
+    sp_w, carry = unstamp_tp_carry(spec_tp, carry_sh)
+    assert sp_w.tp_shards == 0
+    values = [0.04, 0.08]
+    report, batch = run_whatif(
+        sp_w, carry, net, bounds, {"send_stop_time": values}, n // 2,
+        return_state=True,
+    )
+    assert report["n_cells"] == 2
+    assert json.loads(json.dumps(report))
+    key_spec, _ = split_spec(sp_w)
+    for i, v in enumerate(values):
+        _, dyn_v = split_spec(
+            dataclasses.replace(sp_w, send_stop_time=v)
+        )
+        ref, _ = run(key_spec, carry, net, bounds, n_ticks=n // 2,
+                     dyn=dyn_v)
+        row = jax.tree.map(lambda a, _i=i: a[_i], batch)
+        assert _hash(ref) == _hash(row), v
+
+
+# ----------------------------------------------------------------------
+# the live retune door
+# ----------------------------------------------------------------------
+
+def _door_spec():
+    spec, *_ = smoke.build(
+        n_users=8, n_fogs=2, horizon=0.01, send_interval=2.5e-3,
+        send_stop_time=0.008, uplink_loss_prob=0.05,
+    )
+    return spec
+
+
+def test_reconfig_door_accepts_promoted_knobs():
+    door = ReconfigDoor(_door_spec())
+    status, ctype, body = door.handle_http(
+        "POST", "/reconfigure",
+        json.dumps({"set": ["spec.send_stop_time=0.004"]}).encode(),
+    )
+    assert status == 200 and ctype == "application/json"
+    payload = json.loads(body)
+    assert payload["recompile"] == "no"
+    assert payload["accepted"] == {"send_stop_time": 0.004}
+    assert "dynamic operand" in payload["why"]["send_stop_time"]
+    assert door.accepted == 1
+    # the chunk hook pops the queue exactly once
+    hook = door.as_reconfigure()
+    assert hook(100) == {"send_stop_time": 0.004}
+    assert hook(200) is None
+    assert door.applied_batches == 1
+
+
+def test_reconfig_door_rejects_gate_flips_eagerly():
+    door = ReconfigDoor(_door_spec())
+    # crossing the 0-vs-positive trace gate: 400 before the loop sees it
+    status, _, body = door.handle_http(
+        "POST", "/reconfigure",
+        json.dumps({"knobs": {"uplink_loss_prob": 0.0}}).encode(),
+    )
+    assert status == 400
+    assert "gate" in json.loads(body)["error"]
+    # shape-defining fields are refused too
+    status, _, body = door.handle_http(
+        "POST", "/reconfigure",
+        json.dumps({"knobs": {"n_users": 64}}).encode(),
+    )
+    assert status == 400
+    assert door.rejected == 2
+    assert door.as_reconfigure()(10) is None  # nothing queued
+
+
+def test_reconfig_door_validates_payloads():
+    door = ReconfigDoor(_door_spec())
+    assert door.handle_http("POST", "/metrics", b"{}") is None
+    status, _, body = door.handle_http("GET", "/reconfigure", b"")
+    assert status == 200 and "usage" in json.loads(body)
+    for bad in (b"not json", b"[]", b"{}",
+                json.dumps({"set": ["no-equals"]}).encode(),
+                json.dumps({"knobs": {"send_stop_time": "x"}}).encode()):
+        status, _, _ = door.handle_http("POST", "/reconfigure", bad)
+        assert status == 400, bad

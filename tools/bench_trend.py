@@ -112,10 +112,26 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     d = json.load(f)
             except (OSError, json.JSONDecodeError):
                 continue
-            parsed = d.get("parsed")
-            if not isinstance(parsed, dict) or "value" not in parsed:
-                continue
-            rows.append(
+            # a wrapper normally carries ONE parsed capture; since
+            # ISSUE 20 a round may ride along extra captures taken the
+            # same session (``extra_parsed``: the --reconfig --tp and
+            # --reconfig --fleet rows of MULTICHIP_r09) — each becomes
+            # its own trajectory row at its own shape
+            blocks = [
+                p
+                for p in [d.get("parsed"), *(d.get("extra_parsed") or [])]
+                if isinstance(p, dict) and "value" in p
+            ]
+            for parsed in blocks:
+                rows.append(
+                    _row_of(rnd, path, parsed)
+                )
+    rows.sort(key=lambda r: (r["file"].split("_r")[0], r["round"]))
+    return rows
+
+
+def _row_of(rnd: int, path: str, parsed: Dict) -> Dict:
+    return (
                 {
                     "round": rnd,
                     "file": os.path.basename(path),
@@ -127,8 +143,17 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     "unit": parsed.get("unit", ""),
                     "compile_s": parsed.get("compile_s"),
                     "reconfig_s": parsed.get("reconfig_s"),
+                    # sharded warm-reconfig columns (ISSUE 20,
+                    # bench.py --reconfig --tp / --reconfig --fleet):
+                    # the promoted TP tick / fleet scan retune walls,
+                    # gated like-for-like with the ISSUE 13 row
+                    "tp_reconfig_s": parsed.get("tp_reconfig_s"),
+                    "fleet_reconfig_s": parsed.get("fleet_reconfig_s"),
                     "reconfig_compile_events": parsed.get(
                         "reconfig_compile_events"
+                    ),
+                    "program_cache_misses_delta": parsed.get(
+                        "program_cache_misses_delta"
                     ),
                     "telemetry_overhead": parsed.get("telemetry_overhead"),
                     # journey-ring overhead (ISSUE 15): interleaved
@@ -153,9 +178,7 @@ def load_rounds(root: str = ".") -> List[Dict]:
                     ),
                     "parsed": parsed,
                 }
-            )
-    rows.sort(key=lambda r: (r["file"].split("_r")[0], r["round"]))
-    return rows
+    )
 
 
 def _shape_str(shape: Tuple) -> str:
@@ -199,13 +222,26 @@ def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
         # warm-reconfig bars (ISSUE 13): every capture that measured a
         # reconfig_s must (a) have compiled NOTHING during the warm
         # runs and (b) beat the cold compile by RECONFIG_SPEEDUP_BAR
-        rc = r.get("reconfig_s")
-        if rc is not None:
+        # the sharded rows (ISSUE 20) mirror reconfig_s into their own
+        # tp_reconfig_s / fleet_reconfig_s column — gate whichever
+        # columns the capture recorded, once each (a sharded capture
+        # carries both the generic and the named column at one value)
+        rc_cols = [
+            ("reconfig_s", "re-configure"),
+            ("tp_reconfig_s", "TP re-configure"),
+            ("fleet_reconfig_s", "fleet re-configure"),
+        ]
+        gated_vals = set()
+        for field, what in rc_cols:
+            rc = r.get(field)
+            if rc is None or float(rc) in gated_vals:
+                continue
+            gated_vals.add(float(rc))
             ev = r.get("reconfig_compile_events")
             if ev:
                 problems.append(
                     f"{r['file']}: {ev:.0f} compile event(s) during the "
-                    "warm re-configure runs — the dynamic-operand "
+                    f"warm {what} runs — the dynamic-operand "
                     "promotion is recompiling (compile_stats delta "
                     "must be 0)"
                 )
@@ -214,11 +250,22 @@ def check(rows: List[Dict], tolerance: float = TOLERANCE) -> List[str]:
                 float(comp) / float(rc) < RECONFIG_SPEEDUP_BAR
             ):
                 problems.append(
-                    f"{r['file']}: warm reconfig {float(rc):.3f}s is "
+                    f"{r['file']}: warm {what} {float(rc):.3f}s is "
                     f"only {float(comp) / float(rc):.1f}x faster than "
                     f"the {float(comp):.1f}s cold compile (bar: "
                     f">= {RECONFIG_SPEEDUP_BAR:.0f}x)"
                 )
+        # sharded program-cache misses (ISSUE 20): a warm retune that
+        # missed the TP/fleet program cache recompiled even if the
+        # compile-event listener missed it — delta must be 0
+        pcm = r.get("program_cache_misses_delta")
+        if pcm:
+            problems.append(
+                f"{r['file']}: {float(pcm):.0f} program-cache miss(es) "
+                "during the warm sharded re-configure runs — the "
+                "promoted runner re-keyed its program (delta must "
+                "be 0)"
+            )
         # warm what-if bar (ISSUE 17): every capture that measured a
         # whatif_latency_s must have compiled NOTHING during the warm
         # asks — the grid rides the live session's fork program
@@ -338,11 +385,16 @@ def table(rows: List[Dict], markdown: bool = False) -> str:
                     if r.get("tp_journey_overhead") is not None
                     else ""
                 )
-                rcs = (
-                    f", reconfig {rc}s"
-                    if r.get("reconfig_s") is not None
-                    else ""
-                )
+                # sharded rows label their column (ISSUE 20); the
+                # generic label covers the single-device ISSUE 13 row
+                if r.get("tp_reconfig_s") is not None:
+                    rcs = f", tp-reconfig {rc}s"
+                elif r.get("fleet_reconfig_s") is not None:
+                    rcs = f", fleet-reconfig {rc}s"
+                elif r.get("reconfig_s") is not None:
+                    rcs = f", reconfig {rc}s"
+                else:
+                    rcs = ""
                 rcs += (
                     f", whatif {r['whatif_latency_s']:.3f}s"
                     if r.get("whatif_latency_s") is not None

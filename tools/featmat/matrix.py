@@ -138,14 +138,16 @@ CELLS: Tuple[Cell, ...] = (
        "test:test_tp_journey_chains_bit_match_single_device"),
     _a("journeys", "fleet", "test:test_fleet_vmap_carries_journey_rings"),
     _a("dynspec", "run", "variant:tick_dyn"),
-    _u("dynspec", "tp"),
-    _u("dynspec", "fleet"),
+    _a("dynspec", "tp", "variant:tp_tick_dyn",
+       "test:test_tp_promoted_bitexact_vs_static"),
+    _a("dynspec", "fleet", "variant:fleet_step_dyn",
+       "test:test_fleet_promoted_bitexact_vs_static"),
     _a("ingest", "run", "variant:tick_ingest",
        "test:test_replay_from_arrival_log"),
     _r("ingest", "tp", "TWIN-INGEST-TP"),
     _r("ingest", "fleet", "TWIN-INGEST-FLEET"),
     _a("whatif", "run", "test:test_whatif_fork_matches_cold_runs"),
-    _r("whatif", "tp", "TWIN-WHATIF-TP"),
+    _a("whatif", "tp", "test:test_tp_whatif_fork_matches_cold_runs"),
     _r("whatif", "fleet", "TWIN-WHATIF-FLEET"),
     _a("front", "run", "test:test_front_door_shared_program"),
     _r("front", "tp", "TWIN-FRONT-TP"),

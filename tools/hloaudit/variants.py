@@ -223,11 +223,17 @@ def _compile_tick_dyn():
     return _artifact(compiled, key_spec)
 
 
-def _compile_fleet():
-    """Compile the replica-sharded fleet scan on the 8-device mesh."""
+def _compile_fleet(promote=False):
+    """Compile the replica-sharded fleet scan on the 8-device mesh.
+
+    ``promote=True`` compiles the ISSUE 20 promoted variant: the spec
+    split on its shape key and every promoted knob fed as a per-replica
+    ``dyn_rows`` operand (the ``sweep_dyn(mesh=)`` one-compile program).
+    The default stays the constant-folded sibling, byte-stable.
+    """
     import jax
 
-    from fognetsimpp_tpu.parallel.fleet import _fleet_run
+    from fognetsimpp_tpu.parallel.fleet import _fleet_dyn_rows, _fleet_run
     from fognetsimpp_tpu.parallel.mesh import make_mesh, shard_world
     from fognetsimpp_tpu.parallel.replicas import replicate_state
     from fognetsimpp_tpu.scenarios import smoke
@@ -236,9 +242,17 @@ def _compile_fleet():
     mesh = make_mesh(_N_DEVICES)
     batch = replicate_state(spec, state, _N_DEVICES)
     batch, net, bounds, _ = shard_world(batch, net, bounds, mesh)
-    compiled = _fleet_run.lower(
-        spec, _FLEET_TICKS, batch, net, bounds
-    ).compile()
+    if promote:
+        run_spec, dyn_rows = _fleet_dyn_rows(
+            spec, _N_DEVICES, mesh, None, True
+        )
+        compiled = _fleet_run.lower(
+            run_spec, _FLEET_TICKS, batch, net, bounds, dyn_rows
+        ).compile()
+    else:
+        compiled = _fleet_run.lower(
+            spec, _FLEET_TICKS, batch, net, bounds
+        ).compile()
     return _artifact(compiled, spec)
 
 
@@ -265,14 +279,21 @@ def _compile_tp():
     return _artifact(compiled, None)
 
 
-def _compile_tp_tick(**build_overrides):
+def _compile_tp_tick(promote=False, **build_overrides):
     """Compile the shard_map'd TP sharded tick (the ISSUE 9 production
     path) through taskshard's OWN program builder — the audited
     artifact is the program ``run_tp_sharded`` executes, never a twin.
 
     ``build_overrides`` select the variant: ``telemetry=True`` compiles
     the ISSUE 11 telemetry-on tick (exchange-plane gauges + the
-    phase-work/histogram fold psums riding the shard_map body)."""
+    phase-work/histogram fold psums riding the shard_map body).
+
+    ``promote=True`` compiles the ISSUE 20 promoted tick — the DynSpec
+    operand rides the shard_map body replicated, and the audited
+    artifact is the zero-recompile program warm retunes re-execute.
+    The constant-folded (``promote=False``) siblings stay byte-stable:
+    promotion is a separate ``_tp_program`` cache entry, not a rewrite
+    of the static path."""
     from fognetsimpp_tpu.parallel.mesh import make_mesh
     from fognetsimpp_tpu.parallel.taskshard import NODE_AXIS, _tp_setup
     from fognetsimpp_tpu.scenarios import smoke
@@ -281,11 +302,14 @@ def _compile_tp_tick(**build_overrides):
         **{**_TP_TICK, **build_overrides}
     )
     mesh = make_mesh(_N_DEVICES, axis_name=NODE_AXIS)
-    go, parts, net_r, cache_r, spec = _tp_setup(
+    go, parts, net_r, cache_r, spec, dyn = _tp_setup(
         spec, state, net, mesh, _TP_TICK_TICKS, NODE_AXIS,
-        None, False, False,
+        None, False, False, promote=promote,
     )
-    compiled = go.lower(*parts, net_r, cache_r).compile()
+    if dyn is not None:
+        compiled = go.lower(*parts, net_r, cache_r, dyn).compile()
+    else:
+        compiled = go.lower(*parts, net_r, cache_r).compile()
     return _artifact(compiled, spec)
 
 
@@ -474,6 +498,18 @@ def variants() -> List[Variant]:
             donated=(2,),  # _fleet_run's donate_argnums
         ),
         Variant(
+            "fleet_step_dyn",
+            "the replica-sharded fleet scan with the promoted DynSpec "
+            "operand (ISSUE 20): shape key static, per-replica knob "
+            "rows run-time data — the sweep_dyn(mesh=) one-compile "
+            "program; declared collectives and the donated-batch alias "
+            "contract must match the constant-folded fleet_step",
+            lambda: _compile_fleet(promote=True),
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from fleet.py
+            donated=(2,),  # _fleet_run's donate_argnums
+        ),
+        Variant(
             "tp_dryrun",
             "TP fog-sharded argmin (parallel/tp.sharded_min_busy)",
             _compile_tp,
@@ -486,6 +522,18 @@ def variants() -> List[Variant]:
             "(parallel/taskshard.run_tp_sharded: psum combines + ring "
             "arrival exchange)",
             lambda: _compile_tp_tick(),
+            sharded=True,
+            declared_collectives=None,  # resolved lazily from taskshard.py
+        ),
+        Variant(
+            "tp_tick_dyn",
+            "the shard_map'd TP sharded tick with the promoted DynSpec "
+            "operand (ISSUE 20): shape key static, every promoted knob "
+            "read from a replicated operand inside the sharded phases "
+            "— the warm-reconfig TP program; collective kinds/counts "
+            "and the ppermute payload must stay byte-identical to the "
+            "constant-folded tp_tick",
+            lambda: _compile_tp_tick(promote=True),
             sharded=True,
             declared_collectives=None,  # resolved lazily from taskshard.py
         ),
@@ -534,12 +582,12 @@ def declared_for(v: Variant) -> Optional[Dict[str, Set[str]]]:
     (kept next to the sharded code, not in this registry)."""
     if v.declared_collectives is not None:
         return v.declared_collectives
-    if v.name == "fleet_step":
+    if v.name in ("fleet_step", "fleet_step_dyn"):
         return _fleet_declared()
     if v.name == "tp_dryrun":
         return _tp_declared()
     if v.name in (
-        "tp_tick", "tp_tick_telemetry", "tp_tick_window",
+        "tp_tick", "tp_tick_dyn", "tp_tick_telemetry", "tp_tick_window",
         "tp_tick_journeys",
     ):
         from fognetsimpp_tpu.parallel.taskshard import (

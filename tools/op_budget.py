@@ -181,7 +181,8 @@ def _result_bytes(result: str) -> int:
 
 
 def compile_tp_counts(
-    telemetry: bool = False, window: bool = False, journeys: bool = False
+    telemetry: bool = False, window: bool = False,
+    journeys: bool = False, promote: bool = False,
 ) -> dict:
     """Compile the shard_map'd TP sharded tick and count its HLO ops +
     collectives (ISSUE 9).
@@ -209,6 +210,12 @@ def compile_tp_counts(
     the shard-local ring tap must add ZERO collectives (its only
     cross-shard scalar rides the established end-of-tick psum), so the
     pinned collective count equals the windowed telemetry tick's.
+
+    ``promote=True`` compiles the ISSUE 20 promoted-operand TP tick
+    (the DynSpec operand replicated across the node mesh): promotion
+    must be communication-free, so its collective counts AND per-hop
+    ppermute payload are pinned byte-identical to the constant-folded
+    ``tp_tick``.
     """
     from tools.hloaudit.hlo import (
         COLLECTIVE_OPS,
@@ -229,6 +236,8 @@ def compile_tp_counts(
         text = _compile_tp_tick(
             telemetry=True, telemetry_hist=True, derive_acks=False
         ).text
+    elif promote:
+        text = _compile_tp_tick(promote=True).text
     else:
         text = _compile_tp_tick().text
     mod = parse_hlo(text)
@@ -276,6 +285,7 @@ def measure(
     out_tp = {}
     if tp:
         for key, kw in (("tp_tick", {}),
+                        ("tp_tick_dyn", dict(promote=True)),
                         ("tp_tick_telemetry", dict(telemetry=True)),
                         ("tp_tick_window", dict(window=True)),
                         ("tp_tick_journeys", dict(journeys=True))):
@@ -382,9 +392,9 @@ def check(measured: dict, budget: dict) -> list:
                 )
     # --- the TP sharded ticks (ISSUE 9; telemetry-on since ISSUE 11;
     # windowed hop-pruned exchange since ISSUE 18; journey rings since
-    # ISSUE 19) ---
-    for key in ("tp_tick", "tp_tick_telemetry", "tp_tick_window",
-                "tp_tick_journeys"):
+    # ISSUE 19; promoted DynSpec operand since ISSUE 20) ---
+    for key in ("tp_tick", "tp_tick_dyn", "tp_tick_telemetry",
+                "tp_tick_window", "tp_tick_journeys"):
         tp = measured.get(key)
         btp = budget.get(key)
         if tp is None:

@@ -110,6 +110,10 @@ TRACING_COMBINATORS: Set[str] = {
     "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
     "jax.checkpoint", "jax.remat", "jax.eval_shape", "jax.linearize",
     "jax.custom_jvp", "jax.custom_vjp",
+    # the sharded runners' explicit-collective combinator (ISSUE 20):
+    # a shard_map body is device code like any scanned/jitted fn, so
+    # R13 sees promoted-knob reads inside parallel/ shard_map bodies
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
 }
 
 _SUPPRESS_RE = re.compile(r"#\s*simlint:\s*disable=([A-Za-z0-9_,\s]+)")

@@ -14,3 +14,14 @@ def degrade_links(spec, d2b, t0):
     # R13: same rot through an intermediate assignment
     scale = spec.learn_reward_scale
     return d2b * fac * scale
+
+
+def sharded_tick(spec, mesh, parts):
+    from jax import shard_map
+
+    def body(rows):
+        # R13: the same rot inside a shard_map body — the sharded
+        # runners' promoted knobs must ride the replicated operand
+        return rows * spec.uplink_loss_prob
+
+    return shard_map(body, mesh=mesh)(parts)

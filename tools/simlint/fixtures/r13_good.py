@@ -13,3 +13,17 @@ def degrade_links(spec, dv, d2b, t0):
     if spec.queue_capacity > 4:  # non-promoted field: out of scope
         fac = fac * 2.0
     return d2b * fac
+
+
+def sharded_tick(spec, mesh, parts, dyn):
+    from jax import shard_map
+
+    def body(rows, dv):
+        # value read through the replicated operand view: the sharded
+        # runners' compile-free reconfig path (ISSUE 20)
+        scale = dv.uplink_loss_prob
+        if spec.uplink_loss_prob > 0:  # gate read: trace structure, ok
+            rows = rows * scale
+        return rows
+
+    return shard_map(body, mesh=mesh)(parts, dyn)
